@@ -1,0 +1,28 @@
+"""kubedtn_tpu — a TPU-native digital-twin-network framework.
+
+Same capabilities as the reference (dtn-dslab/kube-dtn): declarative Topology
+graphs of point-to-point links with emulated properties (latency, jitter, loss,
+rate, reorder, corrupt, duplicate), reconciled from spec to steady state with a
+live data plane — but realized as batched edge-state arrays on TPU
+(JAX/XLA/pallas) instead of Linux kernel state (veth/VXLAN/netem/tbf/eBPF).
+
+Layer map (mirrors reference SURVEY.md §1, re-architected TPU-first):
+
+    L5  api/        Topology schema + golden-parity parsers  (ref: api/v1/)
+    L4  topology/   store + reconciler                       (ref: controllers/)
+    L3  wire/       gRPC control plane + engine facade       (ref: daemon/kubedtn/)
+    L2  ops/        edge-state arrays, shaping & queue kernels
+                                            (ref: common/qdisc.go, daemon/vxlan|grpcwire, bpf/)
+    L1  parallel/   device mesh, shard_map, collectives      (ref: kernel/netlink)
+
+Everything per-link the reference does with netlink/tc becomes a row in
+structure-of-arrays edge state advanced by vmapped / shard_map-sharded kernels.
+"""
+
+__version__ = "0.1.0"
+
+# Group/version identity kept parity-compatible with the reference CRD
+# (ref: api/v1/groupversion_info.go:28-36).
+GROUP = "y-young.github.io"
+VERSION = "v1"
+GROUP_VERSION = f"{GROUP}/{VERSION}"

@@ -1,0 +1,326 @@
+"""netem + TBF shaping as pure per-edge JAX functions.
+
+The reference shapes each veth end with a Linux netem qdisc at the root and a
+TBF qdisc as its child (reference common/qdisc.go:94-126, 239-272:
+netem handle 1:0, tbf parent 1:1 handle 10:0, tc latency fixed at 50ms).
+This module reproduces the *kernel semantics* of that chain as a pure
+function over one packet on one edge, designed to be `vmap`-ed across every
+edge of the topology and `scan`-ned across packet sequences:
+
+- netem stage (order matches sch_netem enqueue): correlated loss →
+  duplicate → corrupt → delay/jitter with reorder+gap.
+- Correlated randomness matches netem's get_crandom AR(1) blend:
+  x' = u*(1-ρ) + x*ρ on uniforms in [0,1), state updated only when ρ>0 and
+  the property is in use — this realizes the CRD's *_corr fields
+  (reference api/v1/topology_types.go:119-176).
+- Jitter uses netem's default uniform distribution:
+  delay = latency + jitter*(2x-1).
+- Reorder follows the kernel rule: a packet is a reorder candidate when
+  gap==0 or counter >= gap-1; candidates jump the delay line (delay=0) with
+  correlated probability `reorder_prob`, resetting the counter.
+- TBF stage: token bucket with burst = max(rate/250, 5000) bytes
+  (reference common/qdisc.go:360-370) refilled at rate bytes/µs; packets
+  whose projected queue wait exceeds the 50ms qdisc latency are dropped —
+  byte-for-byte the queue limit the reference's fixed `latency 50ms`
+  implies (common/qdisc.go:264).
+
+All times are float32 microseconds relative to the current step's start;
+`roll_epoch` shifts the time-carrying state back each step so magnitudes stay
+small and f32-precise regardless of total simulated time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kubedtn_tpu.ops.edge_state import (
+    C_CORRUPT,
+    C_DELAY,
+    C_DUP,
+    C_LOSS,
+    C_REORDER,
+    EdgeState,
+    NCORR,
+    P_CORRUPT_CORR,
+    P_CORRUPT_PROB,
+    P_DUPLICATE,
+    P_DUPLICATE_CORR,
+    P_GAP,
+    P_JITTER_US,
+    P_LATENCY_CORR,
+    P_LATENCY_US,
+    P_LOSS,
+    P_LOSS_CORR,
+    P_RATE_BPS,
+    P_REORDER_CORR,
+    P_REORDER_PROB,
+    burst_bytes,
+)
+
+from kubedtn_tpu.api.parsers import TBF_LATENCY_US
+
+# tc "latency 50ms" (common/qdisc.go:264), shared with the control plane.
+TBF_QUEUE_LATENCY_US = float(TBF_LATENCY_US)
+
+# Uniform-draw lanes per packet.
+U_LOSS = 0
+U_DUP = 1
+U_CORRUPT = 2
+U_REORDER = 3
+U_DELAY = 4
+NU = 5
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeResult:
+    """Per-packet shaping outcome (times µs relative to step start)."""
+
+    depart_us: jax.Array   # egress time; +inf when dropped
+    delivered: jax.Array   # bool — left the qdisc chain
+    dropped_loss: jax.Array    # bool — netem loss
+    dropped_queue: jax.Array   # bool — TBF queue overflow
+    corrupted: jax.Array   # bool — delivered but corrupted
+    duplicated: jax.Array  # bool — a copy should be enqueued
+    reordered: jax.Array   # bool — jumped the delay line
+
+
+jax.tree_util.register_dataclass(
+    ShapeResult,
+    data_fields=[f.name for f in dataclasses.fields(ShapeResult)],
+    meta_fields=[],
+)
+
+
+def crandom(u: jax.Array, last: jax.Array, rho: jax.Array):
+    """netem get_crandom: AR(1)-blended uniform in [0,1).
+
+    `u` fresh uniform, `last` previous output, `rho` in [0,1]. When rho==0
+    the state passes through unchanged (kernel skips the store).
+    """
+    val = u * (1.0 - rho) + last * rho
+    new_last = jnp.where(rho > 0.0, val, last)
+    return val, new_last
+
+
+def netem_packet(props: jax.Array, corr: jax.Array, pkt_count: jax.Array,
+                 u: jax.Array):
+    """netem enqueue for one packet on one edge.
+
+    Args:
+      props: float32[NPROP] property row.
+      corr: float32[NCORR] correlated-uniform memory.
+      pkt_count: int32 packets-since-reorder counter.
+      u: float32[NU] fresh uniforms.
+
+    Returns:
+      (delay_us, dropped, duplicated, corrupted, reordered, corr', pkt_count')
+    """
+    latency = props[P_LATENCY_US]
+    jitter = props[P_JITTER_US]
+    loss = props[P_LOSS]
+    dup = props[P_DUPLICATE]
+    corrupt = props[P_CORRUPT_PROB]
+    reorder = props[P_REORDER_PROB]
+    gap = props[P_GAP].astype(jnp.int32)
+
+    pct = 1.0 / 100.0
+
+    # 1. duplicate, then loss — kernel order (sch_netem enqueue keeps a
+    #    packet count: duplication increments it, loss decrements it, so a
+    #    packet that triggers BOTH is transmitted exactly once). Both
+    #    crandom streams advance before the drop decision.
+    x_dup, dup_state = crandom(u[U_DUP], corr[C_DUP],
+                               props[P_DUPLICATE_CORR] * pct)
+    dup_hit = (dup > 0.0) & (x_dup * 100.0 < dup)
+    dup_state = jnp.where(dup > 0.0, dup_state, corr[C_DUP])
+
+    x_loss, loss_state = crandom(u[U_LOSS], corr[C_LOSS],
+                                 props[P_LOSS_CORR] * pct)
+    loss_hit = (loss > 0.0) & (x_loss * 100.0 < loss)
+    loss_state = jnp.where(loss > 0.0, loss_state, corr[C_LOSS])
+
+    dropped = loss_hit & ~dup_hit      # count 1-1 == 0
+    duplicated = dup_hit & ~loss_hit   # count 1+1 == 2
+    # dup_hit & loss_hit -> count 1: delivered once, no copy.
+
+    # A dropped packet early-returns in the kernel: corrupt/delay/reorder
+    # randomness and the gap counter are never touched for it.
+    survives = ~dropped
+
+    # 2. corrupt
+    x_cor, cor_state = crandom(u[U_CORRUPT], corr[C_CORRUPT],
+                               props[P_CORRUPT_CORR] * pct)
+    corrupted = (corrupt > 0.0) & (x_cor * 100.0 < corrupt) & survives
+    cor_state = jnp.where((corrupt > 0.0) & survives, cor_state, corr[C_CORRUPT])
+
+    # 3. delay with jitter (netem tabledist, default uniform distribution);
+    #    the delay correlation state advances only when jitter != 0, matching
+    #    tabledist's early return for sigma == 0.
+    x_del, del_state = crandom(u[U_DELAY], corr[C_DELAY],
+                               props[P_LATENCY_CORR] * pct)
+    delay = jnp.where(jitter > 0.0,
+                      latency + jitter * (2.0 * x_del - 1.0),
+                      latency)
+    delay = jnp.maximum(delay, 0.0)
+    del_state = jnp.where((jitter > 0.0) & survives, del_state, corr[C_DELAY])
+
+    # 4. reorder/gap (sch_netem: candidates are every packet when gap==0,
+    #    else packets past the gap window; winners are sent with no delay
+    #    and reset the counter).
+    x_reo, reo_state = crandom(u[U_REORDER], corr[C_REORDER],
+                               props[P_REORDER_CORR] * pct)
+    reorder_on = reorder > 0.0
+    candidate = (gap == 0) | (pkt_count >= gap - 1)
+    do_reorder = reorder_on & candidate & (x_reo * 100.0 <= reorder) & survives
+    reo_state = jnp.where(reorder_on & survives, reo_state, corr[C_REORDER])
+
+    delay = jnp.where(do_reorder, 0.0, delay)
+    new_count = jnp.where(do_reorder, 0,
+                          jnp.where(survives, pkt_count + 1, pkt_count))
+
+    new_corr = corr
+    new_corr = new_corr.at[C_LOSS].set(loss_state)
+    new_corr = new_corr.at[C_DUP].set(dup_state)
+    new_corr = new_corr.at[C_CORRUPT].set(cor_state)
+    new_corr = new_corr.at[C_DELAY].set(del_state)
+    new_corr = new_corr.at[C_REORDER].set(reo_state)
+
+    return delay, dropped, duplicated, corrupted, do_reorder, new_corr, new_count
+
+
+def tbf_packet(rate_bps: jax.Array, tokens: jax.Array, t_last: jax.Array,
+               next_free: jax.Array, size_bytes: jax.Array, t_ready: jax.Array):
+    """TBF dequeue for one packet: token bucket + 50ms queue limit.
+
+    Args:
+      rate_bps: configured rate (0 disables shaping, as the reference only
+        installs TBF when rate != 0 — common/qdisc.go:115-123).
+      tokens: bucket fill in bytes at time `t_last`.
+      t_last: µs timestamp of the fill snapshot.
+      next_free: µs when the queue ahead drains.
+      size_bytes: packet length.
+      t_ready: µs when the packet exits netem and reaches TBF.
+
+    Returns:
+      (t_depart, dropped_queue, tokens', t_last', next_free')
+    """
+    rate_on = rate_bps > 0.0
+    rate_b_us = rate_bps / 8e6  # bytes per µs
+    burst = burst_bytes(rate_bps)
+
+    start = jnp.maximum(t_ready, next_free)
+    avail = jnp.minimum(burst, tokens + (start - t_last) *
+                        jnp.where(rate_on, rate_b_us, 0.0))
+    need = size_bytes - avail
+    wait = jnp.where(need > 0.0, need / jnp.maximum(rate_b_us, 1e-30), 0.0)
+    depart = start + wait
+
+    # tc latency 50ms == max time a packet may sit in the TBF queue.
+    dropped = rate_on & ((depart - t_ready) > TBF_QUEUE_LATENCY_US)
+
+    accept = rate_on & ~dropped
+    new_tokens = jnp.where(accept, jnp.maximum(avail - size_bytes, 0.0), tokens)
+    new_t_last = jnp.where(accept, depart, t_last)
+    new_next_free = jnp.where(accept, depart, next_free)
+
+    t_depart = jnp.where(rate_on, depart, t_ready)
+    return t_depart, dropped, new_tokens, new_t_last, new_next_free
+
+
+def shape_packet(props: jax.Array, tokens: jax.Array, t_last: jax.Array,
+                 next_free: jax.Array, corr: jax.Array, pkt_count: jax.Array,
+                 size_bytes: jax.Array, t_arrival: jax.Array, u: jax.Array):
+    """Full qdisc chain (netem root → TBF child) for one packet.
+
+    Returns (ShapeResult, tokens', t_last', next_free', corr', pkt_count').
+    """
+    (delay, drop_loss, duplicated, corrupted, reordered,
+     new_corr, new_count) = netem_packet(props, corr, pkt_count, u)
+
+    t_ready = t_arrival + delay
+    t_depart, drop_q, tk, tl, nf = tbf_packet(
+        props[P_RATE_BPS], tokens, t_last, next_free, size_bytes, t_ready
+    )
+
+    # A netem-dropped packet never reaches TBF: suppress its bucket effects.
+    tk = jnp.where(drop_loss, tokens, tk)
+    tl = jnp.where(drop_loss, t_last, tl)
+    nf = jnp.where(drop_loss, next_free, nf)
+    drop_q = drop_q & ~drop_loss
+
+    delivered = ~drop_loss & ~drop_q
+    inf = jnp.float32(jnp.inf)
+    result = ShapeResult(
+        depart_us=jnp.where(delivered, t_depart, inf),
+        delivered=delivered,
+        dropped_loss=drop_loss,
+        dropped_queue=drop_q,
+        corrupted=corrupted & delivered,
+        duplicated=duplicated & delivered,
+        reordered=reordered & delivered,
+    )
+    return result, tk, tl, nf, new_corr, new_count
+
+
+# Vectorized over every edge: one packet per edge per call.
+_shape_vmapped = jax.vmap(shape_packet)
+
+
+@partial(jax.jit, donate_argnums=0)
+def shape_step(state: EdgeState, sizes: jax.Array, have_pkt: jax.Array,
+               t_arrival: jax.Array, key: jax.Array):
+    """Advance every edge by one packet slot.
+
+    Args:
+      state: EdgeState (donated).
+      sizes: float32[E] packet bytes per edge.
+      have_pkt: bool[E] — which edges carry a packet this call.
+      t_arrival: float32[E] arrival times (µs, step-relative).
+      key: PRNG key for this step.
+
+    Returns: (state', ShapeResult[E]) — lanes without a packet report
+      delivered=False and leave state untouched.
+    """
+    E = state.capacity
+    u = jax.random.uniform(key, (E, NU), dtype=jnp.float32)
+
+    res, tk, tl, nf, corr, cnt = _shape_vmapped(
+        state.props, state.tokens, state.t_last, state.backlog_until,
+        state.corr, state.pkt_count, sizes, t_arrival, u,
+    )
+
+    act = have_pkt & state.active
+    keep = lambda new, old: jnp.where(act, new, old)  # noqa: E731
+    new_state = dataclasses.replace(
+        state,
+        tokens=keep(tk, state.tokens),
+        t_last=keep(tl, state.t_last),
+        backlog_until=keep(nf, state.backlog_until),
+        corr=jnp.where(act[:, None], corr, state.corr),
+        pkt_count=keep(cnt, state.pkt_count),
+    )
+    res = ShapeResult(
+        depart_us=jnp.where(act, res.depart_us, jnp.inf),
+        delivered=res.delivered & act,
+        dropped_loss=res.dropped_loss & act,
+        dropped_queue=res.dropped_queue & act,
+        corrupted=res.corrupted & act,
+        duplicated=res.duplicated & act,
+        reordered=res.reordered & act,
+    )
+    return new_state, res
+
+
+@partial(jax.jit, donate_argnums=0, static_argnums=2)
+def roll_epoch(state: EdgeState, dt_us: jax.Array, floor_us: float = -1e7):
+    """Shift step-relative clocks back by `dt_us` at the end of a step so
+    times stay small and f32-exact over unbounded simulated time."""
+    return dataclasses.replace(
+        state,
+        t_last=jnp.maximum(state.t_last - dt_us, floor_us),
+        backlog_until=jnp.maximum(state.backlog_until - dt_us, floor_us),
+    )

@@ -1,0 +1,118 @@
+"""Tests for EdgeState and batched link ops."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubedtn_tpu.api.types import LinkProperties
+from kubedtn_tpu.ops import edge_state as es
+
+
+def make_props_batch(prop_list):
+    return jnp.stack([es.props_row(p.to_numeric()) for p in prop_list])
+
+
+def test_init_state():
+    s = es.init_state(64)
+    assert s.capacity == 64
+    assert int(s.num_active) == 0
+    assert np.all(np.asarray(s.uid) == -1)
+
+
+def test_apply_and_delete_links():
+    s = es.init_state(16)
+    props = make_props_batch([
+        LinkProperties(latency="10ms", rate="100Mbit"),
+        LinkProperties(loss="25"),
+    ])
+    rows = jnp.array([0, 1], dtype=jnp.int32)
+    s = es.apply_links(
+        s, rows,
+        uids=jnp.array([1, 2], dtype=jnp.int32),
+        src=jnp.array([0, 0], dtype=jnp.int32),
+        dst=jnp.array([1, 2], dtype=jnp.int32),
+        props=props,
+        valid=jnp.array([True, True]),
+    )
+    assert int(s.num_active) == 2
+    assert int(s.uid[0]) == 1 and int(s.uid[1]) == 2
+    assert float(s.props[0, es.P_LATENCY_US]) == 10_000
+    assert float(s.props[0, es.P_RATE_BPS]) == 100e6
+    # bucket starts full: burst = max(rate/250, 5000) = 400_000
+    assert float(s.tokens[0]) == pytest.approx(400_000)
+    assert float(s.tokens[1]) == pytest.approx(5000)  # rate 0 -> floor
+
+    s = es.delete_links(s, jnp.array([0], dtype=jnp.int32),
+                        jnp.array([True]))
+    assert int(s.num_active) == 1
+    assert int(s.uid[0]) == -1
+    assert float(s.props[0, es.P_LATENCY_US]) == 0
+
+
+def test_padding_lanes_dropped():
+    s = es.init_state(8)
+    props = make_props_batch([LinkProperties(), LinkProperties(latency="1ms")])
+    s = es.apply_links(
+        s,
+        rows=jnp.array([3, 0], dtype=jnp.int32),
+        uids=jnp.array([7, 99], dtype=jnp.int32),
+        src=jnp.zeros(2, jnp.int32),
+        dst=jnp.zeros(2, jnp.int32),
+        props=props,
+        valid=jnp.array([True, False]),  # second lane is padding
+    )
+    assert int(s.num_active) == 1
+    assert int(s.uid[3]) == 7
+    assert int(s.uid[0]) == -1  # padding lane did not write
+
+
+def test_update_links_resets_shaping_state():
+    s = es.init_state(8)
+    props = make_props_batch([LinkProperties(latency="10ms", rate="1Gbit")])
+    rows = jnp.array([2], dtype=jnp.int32)
+    ok = jnp.array([True])
+    s = es.apply_links(s, rows, jnp.array([5], jnp.int32),
+                       jnp.zeros(1, jnp.int32), jnp.ones(1, jnp.int32),
+                       props, ok)
+    # dirty the shaping state
+    s = s.__class__(**{**{f: getattr(s, f) for f in (
+        "uid", "src", "dst", "active", "props", "t_last", "backlog_until")},
+        "tokens": s.tokens.at[2].set(1.0),
+        "corr": s.corr.at[2].set(0.5),
+        "pkt_count": s.pkt_count.at[2].set(42)})
+
+    new_props = make_props_batch([LinkProperties(latency="50ms", rate="20Mbit")])
+    s = es.update_links(s, rows, new_props, ok)
+    assert float(s.props[2, es.P_LATENCY_US]) == 50_000
+    assert float(s.tokens[2]) == pytest.approx(80_000)  # 20e6/250
+    assert float(s.corr[2, 0]) == 0.0
+    assert int(s.pkt_count[2]) == 0
+    assert int(s.uid[2]) == 5  # identity untouched
+
+
+def test_grow_state_preserves_rows():
+    s = es.init_state(4)
+    props = make_props_batch([LinkProperties(latency="10ms")])
+    s = es.apply_links(s, jnp.array([1], jnp.int32), jnp.array([9], jnp.int32),
+                       jnp.zeros(1, jnp.int32), jnp.ones(1, jnp.int32),
+                       props, jnp.array([True]))
+    g = es.grow_state(s, 16)
+    assert g.capacity == 16
+    assert int(g.uid[1]) == 9
+    assert float(g.props[1, es.P_LATENCY_US]) == 10_000
+    assert int(g.num_active) == 1
+
+
+def test_no_recompile_on_same_shapes():
+    s = es.init_state(32)
+    props = make_props_batch([LinkProperties(latency="5ms")] * 4)
+    rows = jnp.arange(4, dtype=jnp.int32)
+    ok = jnp.ones(4, dtype=bool)
+    uids = jnp.arange(4, dtype=jnp.int32)
+    zeros = jnp.zeros(4, jnp.int32)
+    with jax.log_compiles(False):
+        s = es.apply_links(s, rows, uids, zeros, zeros, props, ok)
+        n0 = es.apply_links._cache_size()
+        s = es.apply_links(s, rows + 4, uids + 4, zeros, zeros, props, ok)
+        assert es.apply_links._cache_size() == n0

@@ -1,0 +1,241 @@
+"""Numeric tests for the netem + TBF shaping kernels.
+
+Statistical expectations follow the Linux netem/tbf behavior the reference
+installs per link (reference common/qdisc.go): loss/duplicate/corrupt rates,
+uniform jitter in [latency-jitter, latency+jitter], AR(1) correlation,
+reorder-with-gap, token-bucket serialization and the 50ms queue limit.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubedtn_tpu.api.types import LinkProperties
+from kubedtn_tpu.ops import edge_state as es
+from kubedtn_tpu.ops import netem
+
+
+def state_with(prop: LinkProperties, n_edges: int = 1, capacity: int = 8):
+    s = es.init_state(capacity)
+    rows = jnp.arange(n_edges, dtype=jnp.int32)
+    props = jnp.stack([es.props_row(prop.to_numeric())] * n_edges)
+    return es.apply_links(
+        s, rows, rows, jnp.zeros(n_edges, jnp.int32),
+        jnp.ones(n_edges, jnp.int32), props,
+        jnp.ones(n_edges, dtype=bool),
+    )
+
+
+@jax.jit
+def _run_scan(s, sizes, have, arrivals, keys):
+    def body(carry, inp):
+        st = carry
+        t_arr, key = inp
+        st, res = netem.shape_step(st, sizes, have, t_arr, key)
+        return st, res
+
+    return jax.lax.scan(body, s, (arrivals, keys))
+
+
+def run_packets(s, n_pkts, size=1000.0, spacing_us=0.0, seed=0):
+    """Send n_pkts sequential packets on edge 0 via one lax.scan."""
+    E = s.capacity
+    sizes = jnp.full((E,), size, jnp.float32)
+    have = jnp.zeros((E,), bool).at[0].set(True)
+    arrivals = (jnp.arange(n_pkts, dtype=jnp.float32) * spacing_us)[:, None]
+    arrivals = jnp.broadcast_to(arrivals, (n_pkts, E))
+    keys = jax.random.split(jax.random.key(seed), n_pkts)
+    s, stacked = _run_scan(s, sizes, have, arrivals, keys)
+    stacked = jax.tree.map(np.asarray, stacked)
+    outs = [
+        jax.tree.map(lambda x, i=i: x[i, 0], stacked) for i in range(n_pkts)
+    ]
+    return s, outs
+
+
+def test_pure_latency():
+    s = state_with(LinkProperties(latency="10ms"))
+    _, outs = run_packets(s, 5)
+    for o in outs:
+        assert o.delivered
+        assert o.depart_us == pytest.approx(10_000.0)
+
+
+def test_jitter_uniform_range_and_mean():
+    s = state_with(LinkProperties(latency="10ms", jitter="2ms"))
+    _, outs = run_packets(s, 2000)
+    d = np.array([o.depart_us for o in outs])
+    assert d.min() >= 8_000.0 - 1e-3
+    assert d.max() <= 12_000.0 + 1e-3
+    assert d.mean() == pytest.approx(10_000.0, rel=0.02)
+    # uniform distribution: std = (b-a)/sqrt(12) = 4000/3.464 ≈ 1154.7
+    assert d.std() == pytest.approx(4000 / np.sqrt(12), rel=0.1)
+
+
+def test_loss_rate():
+    s = state_with(LinkProperties(loss="25"))
+    _, outs = run_packets(s, 4000)
+    lost = np.mean([o.dropped_loss for o in outs])
+    assert lost == pytest.approx(0.25, abs=0.03)
+
+
+def test_loss_correlation():
+    # netem's get_crandom is an AR(1) blend of uniforms, whose stationary
+    # law concentrates around 0.5 — a 50% threshold keeps the marginal rate
+    # at ~50% while making drops bursty. (This also reproduces the known
+    # kernel quirk that correlation skews rates away from nominal for
+    # thresholds far from 50%.)
+    s = state_with(LinkProperties(loss="50", loss_corr="50"))
+    _, outs = run_packets(s, 6000)
+    drops = np.array([o.dropped_loss for o in outs], dtype=float)
+    assert drops.mean() == pytest.approx(0.5, abs=0.05)
+    x = drops - drops.mean()
+    ac1 = (x[:-1] * x[1:]).mean() / (x.var() + 1e-12)
+    assert ac1 > 0.15  # bursty vs ~0 for uncorrelated
+
+    # the quirk itself: high correlation + low threshold => far fewer drops
+    s2 = state_with(LinkProperties(loss="30", loss_corr="90"))
+    _, outs2 = run_packets(s2, 4000)
+    drops2 = np.mean([o.dropped_loss for o in outs2])
+    assert drops2 < 0.10
+
+
+def test_duplicate_and_corrupt_rates():
+    s = state_with(LinkProperties(duplicate="10", corrupt_prob="5"))
+    _, outs = run_packets(s, 4000)
+    dup = np.mean([o.duplicated for o in outs])
+    cor = np.mean([o.corrupted for o in outs])
+    assert dup == pytest.approx(0.10, abs=0.02)
+    assert cor == pytest.approx(0.05, abs=0.015)
+
+
+def test_reorder_with_gap():
+    # netem: reorder 25% gap 5 — every 5th packet is a candidate to jump
+    # the 10ms delay line; candidates jump with p=0.25.
+    s = state_with(LinkProperties(latency="10ms", reorder_prob="25", gap=5))
+    _, outs = run_packets(s, 4000)
+    reo = np.array([o.reordered for o in outs])
+    # only candidates can reorder; steady-state candidate fraction with
+    # gap=5 and p=.25 is governed by renewal theory: E[cycle] = 4 + 1/p
+    # packets per reorder... just sanity-check the rate is between the
+    # naive bounds (0.25/5 ≈ 0.05 lower, 0.25 upper) and nonzero.
+    assert 0.01 < reo.mean() < 0.25
+    d = np.array([o.depart_us for o in outs])
+    assert np.all(d[reo] == 0.0)        # reordered packets jump the line
+    assert np.all(d[~reo] == 10_000.0)  # everyone else takes full latency
+
+
+def test_reorder_gap0_rate():
+    s = state_with(LinkProperties(latency="10ms", reorder_prob="20"))
+    _, outs = run_packets(s, 4000)
+    reo = np.mean([o.reordered for o in outs])
+    assert reo == pytest.approx(0.20, abs=0.03)
+
+
+def test_tbf_serialization():
+    # 8 Mbit/s = 1 byte/µs; burst = rate/250 = 32000 bytes. After the
+    # initial burst is spent, 1000-byte packets serialize at 1000 µs each.
+    s = state_with(LinkProperties(rate="8Mbit"))
+    _, outs = run_packets(s, 40)
+    d = np.array([o.depart_us for o in outs])
+    # first 32 packets ride the initial 32000-byte burst: depart immediately
+    np.testing.assert_allclose(d[:32], 0.0, atol=1e-2)
+    # each subsequent packet waits for 1000 fresh tokens
+    np.testing.assert_allclose(np.diff(d[32:]), 1000.0, rtol=1e-3)
+
+
+def test_tbf_burst_floor():
+    # 1 Mbit/s: rate/250 = 4000 < 5000 => the 5000-byte floor applies
+    # (common/qdisc.go:364-367). 0.125 B/µs => 8000 µs per 1000-byte packet.
+    s = state_with(LinkProperties(rate="1Mbit"))
+    _, outs = run_packets(s, 8)
+    d = np.array([o.depart_us for o in outs])
+    np.testing.assert_allclose(d[:5], 0.0, atol=1e-2)
+    np.testing.assert_allclose(np.diff(d[5:]), 8000.0, rtol=1e-3)
+
+
+def test_tbf_queue_limit_drops():
+    # 50ms queue at 1 byte/µs: after the 32-packet burst, queued packets
+    # wait (i-31)*1000 µs; waits beyond 50ms are dropped (packet ~83 on).
+    s = state_with(LinkProperties(rate="8Mbit"))
+    _, outs = run_packets(s, 100)
+    dropped = np.array([o.dropped_queue for o in outs])
+    assert dropped.any()
+    assert not dropped[:80].any()  # early packets fit in burst + queue
+    assert dropped[85:].all()
+    d = np.array([o.depart_us for o in outs])
+    assert np.all(np.isinf(d[dropped]))
+
+
+def test_netem_then_tbf_composition():
+    # latency 10ms + 8Mbit rate: depart = 10ms + serialization.
+    s = state_with(LinkProperties(latency="10ms", rate="8Mbit"))
+    _, outs = run_packets(s, 40)
+    d = np.array([o.depart_us for o in outs])
+    assert d[0] == pytest.approx(10_000.0, rel=1e-5)
+    np.testing.assert_allclose(np.diff(d[32:]), 1000.0, rtol=1e-3)
+
+
+def test_loss_does_not_consume_tokens():
+    s = state_with(LinkProperties(loss="100", rate="8Mbit"))
+    s1, outs = run_packets(s, 20)
+    assert all(o.dropped_loss for o in outs)
+    # bucket untouched: still full at burst = 8e6/250
+    assert float(s1.tokens[0]) == pytest.approx(32000.0)
+
+
+def test_inactive_edges_untouched():
+    s = state_with(LinkProperties(latency="1ms"), n_edges=1, capacity=4)
+    sizes = jnp.full((4,), 100.0, jnp.float32)
+    have = jnp.ones((4,), bool)  # claim packets everywhere...
+    s2, res = netem.shape_step(s, sizes, have,
+                               jnp.zeros((4,), jnp.float32),
+                               jax.random.key(0))
+    r = jax.tree.map(np.asarray, res)
+    assert r.delivered[0]
+    assert not r.delivered[1:].any()  # ...but only active edges deliver
+
+
+def test_roll_epoch():
+    s = state_with(LinkProperties(rate="8Mbit"))
+    s = dataclasses.replace(
+        s, t_last=s.t_last.at[0].set(500.0),
+        backlog_until=s.backlog_until.at[0].set(700.0))
+    s = netem.roll_epoch(s, jnp.float32(300.0))
+    assert float(s.t_last[0]) == pytest.approx(200.0)
+    assert float(s.backlog_until[0]) == pytest.approx(400.0)
+
+
+def test_determinism():
+    s1 = state_with(LinkProperties(loss="50", latency="1ms", jitter="1ms"))
+    s2 = state_with(LinkProperties(loss="50", latency="1ms", jitter="1ms"))
+    _, o1 = run_packets(s1, 50, seed=7)
+    _, o2 = run_packets(s2, 50, seed=7)
+    for a, b in zip(o1, o2):
+        assert a.depart_us == b.depart_us
+        assert a.dropped_loss == b.dropped_loss
+
+
+def test_duplicate_loss_interaction_kernel_parity():
+    # sch_netem keeps a packet count: duplicate increments, loss decrements.
+    # duplicate=100 + loss=100 => every packet triggers both => delivered
+    # exactly once, never dropped, never duplicated.
+    s = state_with(LinkProperties(duplicate="100", loss="100"))
+    _, outs = run_packets(s, 200)
+    assert all(o.delivered for o in outs)
+    assert not any(o.dropped_loss for o in outs)
+    assert not any(o.duplicated for o in outs)
+
+
+def test_drop_does_not_advance_gap_counter():
+    # Kernel early-returns dropped packets before the reorder counter:
+    # with loss=50 and gap=1000 (no packet ever reaches the gap window in
+    # 100 packets), pkt_count must equal delivered-only count.
+    s = state_with(LinkProperties(latency="1ms", loss="50",
+                                  reorder_prob="1", gap=1000))
+    s1, outs = run_packets(s, 100)
+    delivered = sum(int(o.delivered) for o in outs)
+    assert int(s1.pkt_count[0]) == delivered

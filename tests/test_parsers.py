@@ -1,0 +1,113 @@
+"""Golden tests for property-string parsers.
+
+Expected values derived from the reference parser semantics
+(reference common/qdisc.go:128-199): Go time.ParseDuration truncated to µs,
+strconv.ParseFloat for percentages, integer + prefix/suffix rate grammar.
+"""
+
+import pytest
+
+from kubedtn_tpu.api.parsers import (
+    parse_duration_us,
+    parse_percentage,
+    parse_rate_bps,
+    tbf_burst_bytes,
+)
+
+
+class TestParsePercentage:
+    @pytest.mark.parametrize(
+        "s,expected",
+        [
+            ("", 0.0),
+            (None, 0.0),
+            ("0", 0.0),
+            ("100", 100.0),
+            ("25.5", 25.5),
+            ("0.001", 0.001),
+            ("1e1", 10.0),  # strconv.ParseFloat accepts scientific notation
+        ],
+    )
+    def test_valid(self, s, expected):
+        assert parse_percentage(s) == pytest.approx(expected)
+
+    @pytest.mark.parametrize("s", ["-1", "100.1", "abc", "NaN", "nan"])
+    def test_invalid(self, s):
+        with pytest.raises(ValueError):
+            parse_percentage(s)
+
+
+class TestParseDuration:
+    @pytest.mark.parametrize(
+        "s,expected_us",
+        [
+            ("", 0),
+            (None, 0),
+            ("0", 0),
+            ("300ms", 300_000),
+            ("10ms", 10_000),
+            ("50ms", 50_000),
+            ("1.5s", 1_500_000),
+            ("1s", 1_000_000),
+            ("100us", 100),
+            ("100µs", 100),
+            ("100μs", 100),
+            ("1500ns", 1),     # 1500ns = 1.5µs, Microseconds() truncates
+            ("999ns", 0),
+            ("1h", 3_600_000_000),
+            ("1m", 60_000_000),
+            ("1h2m3s", 3_723_000_000),
+            ("1.5ms", 1_500),
+            (".5s", 500_000),  # Go allows leading-dot decimals
+            ("2m30s", 150_000_000),
+        ],
+    )
+    def test_valid(self, s, expected_us):
+        assert parse_duration_us(s) == expected_us
+
+    @pytest.mark.parametrize("s", ["10", "ms", "10x", "-10ms", "10 ms", "1.5"])
+    def test_invalid(self, s):
+        with pytest.raises(ValueError):
+            parse_duration_us(s)
+
+
+class TestParseRate:
+    @pytest.mark.parametrize(
+        "s,expected_bps",
+        [
+            ("", 0),
+            (None, 0),
+            ("1000", 1000),
+            ("100kbit", 100_000),
+            ("100Mbit", 100_000_000),
+            ("1Gbit", 1_000_000_000),
+            ("100Mbps", 800_000_000),
+            ("1Gibps", 8 * 1024**3),
+            ("1Kibit", 1024),
+            ("20Mbit", 20_000_000),
+            ("50Mbit", 50_000_000),
+            ("1Tbit", 10**12),
+            ("5", 5),
+            ("8bps", 64),
+            ("10bit", 10),
+            (" 100kbit ", 100_000),  # reference trims whitespace
+        ],
+    )
+    def test_valid(self, s, expected_bps):
+        assert parse_rate_bps(s) == expected_bps
+
+    @pytest.mark.parametrize("s", ["1.5Mbit", "abc", "k", "-5", "1.5"])
+    def test_invalid(self, s):
+        # Go strconv.ParseUint rejects decimals and signs.
+        with pytest.raises(ValueError):
+            parse_rate_bps(s)
+
+
+class TestTbfBurst:
+    def test_floor(self):
+        # below 1.25 Mbit/s the 5000-byte floor wins (qdisc.go:364-367)
+        assert tbf_burst_bytes(1_000_000) == 5000
+
+    def test_rate_over_hz(self):
+        assert tbf_burst_bytes(1_000_000_000) == 4_000_000
+        assert tbf_burst_bytes(20_000_000) == 80_000

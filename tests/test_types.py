@@ -1,0 +1,132 @@
+"""Tests for Topology API types: schema parity, validation, YAML loading."""
+
+import os
+
+import pytest
+
+from kubedtn_tpu.api.types import (
+    Link,
+    LinkProperties,
+    Topology,
+    links_equal_without_properties,
+    load_yaml,
+)
+
+THREE_NODE_YAML = """
+apiVersion: v1
+kind: List
+items:
+  - apiVersion: y-young.github.io/v1
+    kind: Topology
+    metadata:
+      name: r1
+    spec:
+      links:
+        - uid: 1
+          peer_pod: r2
+          local_intf: eth1
+          peer_intf: eth1
+          local_ip: 12.12.12.1/24
+          peer_ip: 12.12.12.2/24
+        - uid: 2
+          peer_pod: r3
+          local_intf: eth2
+          peer_intf: eth1
+          local_ip: 13.13.13.1/24
+          peer_ip: 13.13.13.3/24
+          properties:
+            latency: 10ms
+            rate: 100Mbit
+  - apiVersion: y-young.github.io/v1
+    kind: Topology
+    metadata:
+      name: r2
+    spec:
+      links:
+        - uid: 1
+          peer_pod: r1
+          local_intf: eth1
+          peer_intf: eth1
+          local_ip: 12.12.12.2/24
+          peer_ip: 12.12.12.1/24
+  - apiVersion: v1
+    kind: Pod
+    metadata:
+      name: r1
+"""
+
+
+def test_load_yaml_list():
+    topos = load_yaml(THREE_NODE_YAML)
+    assert [t.name for t in topos] == ["r1", "r2"]
+    r1 = topos[0]
+    assert len(r1.spec.links) == 2
+    assert r1.spec.links[0].uid == 1
+    assert r1.spec.links[1].properties.latency == "10ms"
+    assert r1.status.links is None  # first-seen semantics preserved
+
+
+def test_numeric_conversion():
+    props = LinkProperties(latency="10ms", jitter="1ms", loss="25.5",
+                           rate="100Mbit", gap=5)
+    n = props.to_numeric()
+    assert n["latency_us"] == 10_000
+    assert n["jitter_us"] == 1_000
+    assert n["loss"] == pytest.approx(25.5)
+    assert n["rate_bps"] == 100_000_000
+    assert n["gap"] == 5
+
+
+def test_equal_without_properties():
+    a = Link(local_intf="eth1", peer_intf="eth1", peer_pod="r2", uid=1,
+             properties=LinkProperties(latency="10ms"))
+    b = Link(local_intf="eth1", peer_intf="eth1", peer_pod="r2", uid=1,
+             properties=LinkProperties(latency="50ms"))
+    c = Link(local_intf="eth2", peer_intf="eth1", peer_pod="r2", uid=1)
+    assert links_equal_without_properties(a, b)
+    assert not links_equal_without_properties(a, c)
+
+
+def test_validation():
+    Link(local_intf="eth1", peer_intf="eth1", peer_pod="r2", uid=1,
+         local_ip="10.0.0.1/24", local_mac="00:00:5e:00:53:01").validate()
+    with pytest.raises(ValueError):
+        Link(local_intf="e", peer_intf="e", peer_pod="p", uid=1,
+             local_ip="999.0.0.1").validate()
+    with pytest.raises(ValueError):
+        Link(local_intf="e", peer_intf="e", peer_pod="p", uid=1,
+             local_mac="zz:00:5e:00:53:01").validate()
+    with pytest.raises(ValueError):
+        LinkProperties(latency="10 ms").validate()
+    with pytest.raises(ValueError):
+        LinkProperties(loss="101").validate()
+
+
+def test_special_peers():
+    mv = Link(local_intf="eth1", peer_intf="eth0", peer_pod="localhost", uid=1)
+    assert mv.is_macvlan()
+    ph = Link(local_intf="eth1", peer_intf="eth0",
+              peer_pod="physical/10.0.0.5", uid=2)
+    assert ph.is_physical()
+    assert ph.physical_peer_ip() == "10.0.0.5"
+
+
+def test_manifest_roundtrip():
+    topos = load_yaml(THREE_NODE_YAML)
+    r1 = topos[0]
+    m = r1.to_manifest()
+    r1b = Topology.from_manifest(m)
+    assert r1b.spec == r1.spec
+    assert r1b.name == r1.name
+
+
+def test_load_reference_sample_if_present():
+    path = "/root/reference/config/samples/3node.yml"
+    if not os.path.exists(path):
+        pytest.skip("reference samples not mounted")
+    topos = load_yaml(path)
+    assert [t.name for t in topos] == ["r1", "r2", "r3"]
+    # full-mesh: uids {1,2,3}, two links per pod
+    assert all(len(t.spec.links) == 2 for t in topos)
+    uids = {l.uid for t in topos for l in t.spec.links}
+    assert uids == {1, 2, 3}
