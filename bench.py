@@ -1,0 +1,100 @@
+"""Headline benchmark: link-updates/sec on a 100k-link Clos topology.
+
+The reference's UpdateLinks path rebuilds qdiscs one link at a time through
+netlink + tc execs (reference daemon/kubedtn/handler.go:634-671,
+common/qdisc.go:201-290) — milliseconds per link, serial per daemon. Here
+the same operation is one batched scatter into the edge-state arrays
+(kubedtn_tpu.ops.edge_state.update_links), so the unit of work is a whole
+topology-wide property update.
+
+Scenario: 2-tier Clos, 100 spines × 500 leaves × 2 parallel links = 100_000
+p2p links (BASELINE.md 100k-link ladder rung), realized as 200_000 directed
+edge rows. Each iteration updates the local end of every link (100_000 rows,
+reference UpdateLinks semantics) with fresh properties, then the following
+iteration updates the other end, alternating — no caching shortcuts.
+
+Prints ONE JSON line:
+  {"metric": "link-updates/sec", "value": ..., "unit": "links/s",
+   "vs_baseline": value / 1e6}
+vs_baseline is relative to the driver-set target of 1M link-updates/sec on
+a 100k-link topology (BASELINE.json `metric`/`north_star`).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubedtn_tpu.api.types import LinkProperties
+from kubedtn_tpu.models.topologies import clos, load_edge_list_into_state
+from kubedtn_tpu.ops import edge_state as es
+
+N_SPINE = 100
+N_LEAF = 500
+LINKS_PER_PAIR = 2  # 100 * 500 * 2 = 100_000 links
+WARMUP = 5
+ITERS = 30
+
+
+def build():
+    el = clos(N_SPINE, N_LEAF, hosts_per_leaf=0,
+              props=LinkProperties(latency="10ms", rate="10Gbit"),
+              links_per_pair=LINKS_PER_PAIR)
+    assert el.n_links == 100_000, el.n_links
+    state, rows = load_edge_list_into_state(el)  # 200k rows, capacity 2^18
+    return el, state, rows
+
+
+def fresh_props(n, seed):
+    """Pre-stage n random-but-valid property rows on device."""
+    rng = np.random.default_rng(seed)
+    base = np.zeros((n, es.NPROP), np.float32)
+    base[:, es.P_LATENCY_US] = rng.integers(1_000, 100_000, n)
+    base[:, es.P_JITTER_US] = rng.integers(0, 5_000, n)
+    base[:, es.P_LOSS] = rng.uniform(0, 2, n)
+    base[:, es.P_RATE_BPS] = rng.choice(
+        [20e6, 50e6, 100e6, 1e9, 10e9], n)
+    return jnp.asarray(base)
+
+
+def main():
+    el, state, rows = build()
+    L = el.n_links
+    # local-end rows for each link are the first L directed rows; the
+    # reverse direction occupies rows L..2L. Alternate ends per iteration.
+    rows_a = jnp.asarray(np.arange(0, L, dtype=np.int32))
+    rows_b = jnp.asarray(np.arange(L, 2 * L, dtype=np.int32))
+    props0 = fresh_props(L, 1)
+    props1 = fresh_props(L, 2)
+    valid = jnp.ones((L,), dtype=bool)
+
+    def one_iter(state, i):
+        r = rows_a if i % 2 == 0 else rows_b
+        p = props0 if i % 2 == 0 else props1
+        return es.update_links(state, r, p, valid)
+
+    for i in range(WARMUP):
+        state = one_iter(state, i)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        state = one_iter(state, i)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    updates_per_sec = L * ITERS / dt
+    print(json.dumps({
+        "metric": "link-updates/sec",
+        "value": round(updates_per_sec, 1),
+        "unit": "links/s",
+        "vs_baseline": round(updates_per_sec / 1e6, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

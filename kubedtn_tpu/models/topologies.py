@@ -1,0 +1,229 @@
+"""Topology model families — generators for the scenario ladder.
+
+The reference ships hand-written YAML topologies (reference
+config/samples/3node.yml, config/samples/tc/*.yaml); at TPU scale the
+topologies in BASELINE.md's ladder (64-node fat-tree → 100k-link Clos) are
+generated. Generators emit an array-native EdgeList (structure-of-arrays,
+ready for the device) plus converters to Topology CRs for the control-plane
+path, so the same model drives both the batched fast path and the full
+reconcile pipeline.
+
+Conventions match the reference sample format: per-node Topology with one
+Link per incident edge, shared uid on both endpoint views, eth<i> interface
+naming, 10.x.y.z/24 point-to-point addressing where applicable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from kubedtn_tpu.api.types import Link, LinkProperties, Topology, TopologySpec
+from kubedtn_tpu.ops import edge_state as es
+
+
+@dataclasses.dataclass
+class EdgeList:
+    """Undirected p2p links in array form (one row per link, not per
+    direction — the engine/device layer expands to directed rows)."""
+
+    node_names: list[str]
+    a: np.ndarray        # int32[L] endpoint A node index
+    b: np.ndarray        # int32[L] endpoint B node index
+    uid: np.ndarray      # int32[L] unique link id (1-based like the samples)
+    props: np.ndarray    # float32[L, NPROP] shared link properties
+
+    @property
+    def n_links(self) -> int:
+        return len(self.uid)
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.node_names)
+
+    def directed(self):
+        """Expand to directed rows: (src, dst, uid, props), 2L entries —
+        each endpoint's egress, the device-array representation."""
+        src = np.concatenate([self.a, self.b]).astype(np.int32)
+        dst = np.concatenate([self.b, self.a]).astype(np.int32)
+        uid = np.concatenate([self.uid, self.uid]).astype(np.int32)
+        props = np.concatenate([self.props, self.props]).astype(np.float32)
+        return src, dst, uid, props
+
+    def to_topologies(self, namespace: str = "default") -> list[Topology]:
+        """Materialize per-node Topology CRs (sample-file format)."""
+        links_by_node: dict[int, list[Link]] = {i: [] for i in
+                                                range(self.n_nodes)}
+        numeric_names = es.PROP_NAMES
+        for i in range(self.n_links):
+            a, b, uid = int(self.a[i]), int(self.b[i]), int(self.uid[i])
+            props = _props_to_strings(self.props[i], numeric_names)
+            ia = len(links_by_node[a]) + 1
+            ib = len(links_by_node[b]) + 1
+            links_by_node[a].append(Link(
+                local_intf=f"eth{ia}", peer_intf=f"eth{ib}",
+                peer_pod=self.node_names[b], uid=uid, properties=props))
+            links_by_node[b].append(Link(
+                local_intf=f"eth{ib}", peer_intf=f"eth{ia}",
+                peer_pod=self.node_names[a], uid=uid, properties=props))
+        return [
+            Topology(name=self.node_names[i], namespace=namespace,
+                     spec=TopologySpec(links=links_by_node[i]))
+            for i in range(self.n_nodes)
+        ]
+
+
+def _props_to_strings(row: np.ndarray, names) -> LinkProperties:
+    """Invert props_row: numeric row back to string-typed LinkProperties."""
+    d = {n: float(v) for n, v in zip(names, row)}
+
+    def us(v):
+        # integer microseconds: never scientific notation, always matches
+        # the CRD duration pattern
+        return "" if v == 0 else f"{int(v)}us"
+
+    def pc(v):
+        if v == 0:
+            return ""
+        s = f"{v:.8f}".rstrip("0").rstrip(".")
+        return s if s else "0"
+
+    return LinkProperties(
+        latency=us(d["latency_us"]),
+        latency_corr=pc(d["latency_corr"]),
+        jitter=us(d["jitter_us"]),
+        loss=pc(d["loss"]),
+        loss_corr=pc(d["loss_corr"]),
+        rate="" if d["rate_bps"] == 0 else f"{int(d['rate_bps'])}bit",
+        gap=int(d["gap"]),
+        duplicate=pc(d["duplicate"]),
+        duplicate_corr=pc(d["duplicate_corr"]),
+        reorder_prob=pc(d["reorder_prob"]),
+        reorder_corr=pc(d["reorder_corr"]),
+        corrupt_prob=pc(d["corrupt_prob"]),
+        corrupt_corr=pc(d["corrupt_corr"]),
+    )
+
+
+def _mk(node_names, pairs, props: LinkProperties | None = None,
+        prop_rows: np.ndarray | None = None) -> EdgeList:
+    pairs = np.asarray(pairs, dtype=np.int32).reshape(-1, 2)
+    L = len(pairs)
+    if prop_rows is None:
+        row = np.asarray(es.props_row(
+            (props or LinkProperties()).to_numeric()), np.float32)
+        prop_rows = np.broadcast_to(row, (L, es.NPROP)).copy()
+    return EdgeList(
+        node_names=list(node_names),
+        a=pairs[:, 0].copy(),
+        b=pairs[:, 1].copy(),
+        uid=np.arange(1, L + 1, dtype=np.int32),
+        props=prop_rows.astype(np.float32),
+    )
+
+
+def line(n: int, props: LinkProperties | None = None) -> EdgeList:
+    names = [f"n{i}" for i in range(n)]
+    return _mk(names, [(i, i + 1) for i in range(n - 1)], props)
+
+
+def ring(n: int, props: LinkProperties | None = None) -> EdgeList:
+    names = [f"n{i}" for i in range(n)]
+    return _mk(names, [(i, (i + 1) % n) for i in range(n)], props)
+
+
+def star(n_leaves: int, props: LinkProperties | None = None) -> EdgeList:
+    names = ["hub"] + [f"leaf{i}" for i in range(n_leaves)]
+    return _mk(names, [(0, i + 1) for i in range(n_leaves)], props)
+
+
+def full_mesh(n: int, props: LinkProperties | None = None) -> EdgeList:
+    names = [f"r{i + 1}" for i in range(n)]
+    return _mk(names, [(i, j) for i in range(n) for j in range(i + 1, n)],
+               props)
+
+
+def random_mesh(n_nodes: int, n_links: int, seed: int = 0,
+                props: LinkProperties | None = None) -> EdgeList:
+    """Random connected-ish mesh: a spanning backbone plus random extra
+    links (no self-loops; parallel links allowed, distinct uids — matching
+    the reference's model where uid, not endpoints, identifies a link)."""
+    rng = np.random.default_rng(seed)
+    names = [f"n{i}" for i in range(n_nodes)]
+    backbone = [(i, rng.integers(0, i)) for i in range(1, min(n_nodes,
+                                                              n_links + 1))]
+    extra = n_links - len(backbone)
+    pairs = list(backbone)
+    if extra > 0:
+        a = rng.integers(0, n_nodes, extra)
+        off = rng.integers(1, n_nodes, extra)
+        b = (a + off) % n_nodes
+        pairs += list(zip(a.tolist(), b.tolist()))
+    return _mk(names, pairs, props)
+
+
+def fat_tree(k: int, props: LinkProperties | None = None) -> EdgeList:
+    """Standard k-ary fat-tree (k even): (k/2)² cores, k pods of k/2 agg +
+    k/2 edge switches, k²/4 core-agg links per pod side, agg-edge full
+    bipartite within pods. k=8 → 80 switches, 256 links (the 64-node-scale
+    scenario of BASELINE.md's ladder)."""
+    assert k % 2 == 0, "fat-tree arity must be even"
+    half = k // 2
+    cores = [f"core{i}" for i in range(half * half)]
+    aggs = [f"pod{p}-agg{i}" for p in range(k) for i in range(half)]
+    edges = [f"pod{p}-edge{i}" for p in range(k) for i in range(half)]
+    names = cores + aggs + edges
+    idx = {n: i for i, n in enumerate(names)}
+    pairs = []
+    for p in range(k):
+        for i in range(half):
+            agg = idx[f"pod{p}-agg{i}"]
+            # each agg connects to half cores: core group i*half..i*half+half
+            for j in range(half):
+                pairs.append((idx[f"core{i * half + j}"], agg))
+            # full bipartite agg-edge inside the pod
+            for e in range(half):
+                pairs.append((agg, idx[f"pod{p}-edge{e}"]))
+    return _mk(names, pairs, props)
+
+
+def clos(n_spine: int, n_leaf: int, hosts_per_leaf: int = 0,
+         props: LinkProperties | None = None,
+         links_per_pair: int = 1) -> EdgeList:
+    """2-tier spine-leaf Clos: every leaf connects to every spine
+    (`links_per_pair` parallel links each), plus optional hosts per leaf.
+    clos(100, 500, 0, links_per_pair=2) = 100_000 fabric links — the
+    100k-link BASELINE scenario bench.py runs."""
+    spines = [f"spine{i}" for i in range(n_spine)]
+    leaves = [f"leaf{i}" for i in range(n_leaf)]
+    hosts = [f"leaf{i}-h{j}" for i in range(n_leaf)
+             for j in range(hosts_per_leaf)]
+    names = spines + leaves + hosts
+    pairs = []
+    for li in range(n_leaf):
+        leaf = n_spine + li
+        for si in range(n_spine):
+            for _ in range(links_per_pair):
+                pairs.append((si, leaf))
+        for j in range(hosts_per_leaf):
+            pairs.append((leaf, n_spine + n_leaf + li * hosts_per_leaf + j))
+    return _mk(names, pairs, props)
+
+
+def load_edge_list_into_state(el: EdgeList, capacity: int | None = None):
+    """Fast path: place a generated topology directly into a fresh
+    EdgeState, bypassing the per-link control plane. Returns
+    (state, rows) where rows[i] is the row of directed edge i."""
+    import jax.numpy as jnp
+
+    src, dst, uid, props = el.directed()
+    n = len(src)
+    if capacity is None:
+        capacity = max(8, int(2 ** np.ceil(np.log2(n + 1))))
+    state = es.init_state(capacity)
+    rows = np.arange(n, dtype=np.int32)
+    state = es.apply_links(
+        state, jnp.asarray(rows), jnp.asarray(uid), jnp.asarray(src),
+        jnp.asarray(dst), jnp.asarray(props), jnp.ones(n, dtype=bool))
+    return state, rows

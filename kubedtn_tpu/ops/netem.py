@@ -168,15 +168,21 @@ def netem_packet(props: jax.Array, corr: jax.Array, pkt_count: jax.Array,
     delay = jnp.maximum(delay, 0.0)
     del_state = jnp.where((jitter > 0.0) & survives, del_state, corr[C_DELAY])
 
-    # 4. reorder/gap (sch_netem: candidates are every packet when gap==0,
-    #    else packets past the gap window; winners are sent with no delay
-    #    and reset the counter).
+    # 4. reorder/gap. Raw sch_netem never reorders at gap==0, but the
+    #    reference reaches the kernel through vishvananda/netlink, whose
+    #    NewNetem normalizes gap to 1 whenever reorder is set — and at gap==1
+    #    every packet is a candidate. So gap==0 ⇒ all-candidates here is
+    #    faithful to the reference stack (common/qdisc.go:94-107 via
+    #    netlink NewNetem). The reorder crandom is only drawn for candidate
+    #    packets (the kernel short-circuits the `||` chain before it
+    #    otherwise), so the AR(1) state advances candidates-only.
     x_reo, reo_state = crandom(u[U_REORDER], corr[C_REORDER],
                                props[P_REORDER_CORR] * pct)
     reorder_on = reorder > 0.0
     candidate = (gap == 0) | (pkt_count >= gap - 1)
     do_reorder = reorder_on & candidate & (x_reo * 100.0 <= reorder) & survives
-    reo_state = jnp.where(reorder_on & survives, reo_state, corr[C_REORDER])
+    reo_state = jnp.where(reorder_on & candidate & survives, reo_state,
+                          corr[C_REORDER])
 
     delay = jnp.where(do_reorder, 0.0, delay)
     new_count = jnp.where(do_reorder, 0,

@@ -1,0 +1,113 @@
+"""Edge-sharded simulation step: pjit + shard_map over the device mesh.
+
+This is the scale path of the framework — the TPU-native replacement for the
+reference's "many daemons, peer-to-peer RPC" architecture (SURVEY.md §5.8):
+
+- The batched link ops (update/apply scatters) run under jit over arrays
+  whose edge dimension is sharded across the mesh; XLA partitions the
+  scatters and inserts the necessary traffic.
+- The per-edge shaping kernel is embarrassingly parallel along the edge
+  axis: zero communication.
+- Per-node counters (the daemon's interface-statistics collection, reference
+  daemon/metrics/interface_statistics.go:79-133) need cross-shard reduction:
+  each shard segment-sums its local edges into a [n_nodes] partial, then a
+  `psum` over the edge axis — one ICI all-reduce — replaces the reference's
+  per-node Prometheus scrape aggregation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubedtn_tpu.ops import edge_state as es
+from kubedtn_tpu.ops import netem
+from kubedtn_tpu.parallel.mesh import EDGE_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeStats:
+    """Per-node traffic counters — the schema of the reference's
+    per-interface Prometheus collector (interface_statistics.go:19-65),
+    aggregated to nodes."""
+
+    tx_packets: jax.Array  # f32[n_nodes]
+    tx_bytes: jax.Array
+    rx_packets: jax.Array  # delivered into the node
+    rx_bytes: jax.Array
+    dropped: jax.Array     # loss + queue drops on the node's egress
+
+
+jax.tree_util.register_dataclass(
+    NodeStats,
+    data_fields=[f.name for f in dataclasses.fields(NodeStats)],
+    meta_fields=[],
+)
+
+
+def make_node_stats_fn(mesh, n_nodes: int):
+    """Build the shard_map'd per-node counter reduction."""
+
+    def local_partial(src, dst, delivered, sizes, dropped):
+        # [E_local] inputs on this shard
+        deliv_b = jnp.where(delivered, sizes, 0.0)
+        deliv_p = delivered.astype(jnp.float32)
+        drop_p = dropped.astype(jnp.float32)
+        tx_p = jax.ops.segment_sum(deliv_p, src, num_segments=n_nodes)
+        tx_b = jax.ops.segment_sum(deliv_b, src, num_segments=n_nodes)
+        rx_p = jax.ops.segment_sum(deliv_p, dst, num_segments=n_nodes)
+        rx_b = jax.ops.segment_sum(deliv_b, dst, num_segments=n_nodes)
+        dr_p = jax.ops.segment_sum(drop_p, src, num_segments=n_nodes)
+        # one ICI all-reduce merges every shard's partials
+        out = NodeStats(
+            tx_packets=jax.lax.psum(tx_p, EDGE_AXIS),
+            tx_bytes=jax.lax.psum(tx_b, EDGE_AXIS),
+            rx_packets=jax.lax.psum(rx_p, EDGE_AXIS),
+            rx_bytes=jax.lax.psum(rx_b, EDGE_AXIS),
+            dropped=jax.lax.psum(dr_p, EDGE_AXIS),
+        )
+        return out
+
+    edge = P(EDGE_AXIS)
+    return shard_map(
+        local_partial,
+        mesh=mesh,
+        in_specs=(edge, edge, edge, edge, edge),
+        out_specs=NodeStats(*([P()] * 5)),
+    )
+
+
+def make_sharded_step(mesh, n_nodes: int):
+    """The full sharded simulation step: link updates → shaping → stats.
+
+    Returns a jitted function
+        step(state, urows, uprops, uvalid, sizes, have, t_arr, key)
+            -> (state', ShapeResult, NodeStats)
+    with the EdgeState pinned to edge-dim sharding throughout.
+    """
+    edge_sh = NamedSharding(mesh, P(EDGE_AXIS))
+    stats_fn = make_node_stats_fn(mesh, n_nodes)
+
+    def pin(state: es.EdgeState) -> es.EdgeState:
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, edge_sh), state)
+
+    @partial(jax.jit, donate_argnums=0)
+    def step(state, urows, uprops, uvalid, sizes, have, t_arr, key):
+        # 1. control plane: batched property updates (sharded scatter)
+        state = es.update_links(state, urows, uprops, uvalid)
+        state = pin(state)
+        # 2. data plane: per-edge shaping (no communication)
+        state, res = netem.shape_step(state, sizes, have, t_arr, key)
+        state = pin(state)
+        # 3. observability: cross-shard per-node counters (psum over ICI)
+        stats = stats_fn(state.src, state.dst, res.delivered, sizes,
+                         res.dropped_loss | res.dropped_queue)
+        return state, res, stats
+
+    return step

@@ -1,0 +1,398 @@
+"""SimEngine — the node-daemon equivalent, in front of device arrays.
+
+The reference's per-node daemon (reference daemon/kubedtn/handler.go) turns
+pod lifecycle and link batches into kernel plumbing: veth pairs, VXLAN
+tunnels, qdisc chains. This engine turns the same calls into row operations
+on the batched EdgeState device arrays (kubedtn_tpu.ops.edge_state) — one
+row per directed link endpoint.
+
+Reference behaviors reproduced exactly:
+- SetupPod (handler.go:495-535): unknown pod → "not in topology" and
+  delegate; otherwise mark alive (status.src_ip/net_ns + finalizer) and add
+  every spec link.
+- addLink dispatch (handler.go:316-459): macvlan for peer "localhost" (the
+  reference applies NO qdiscs on macvlan links — handler.go:335-345);
+  "physical/<ip>" links realized immediately on behalf of the physical
+  host; pod-to-pod links gated on peer aliveness — "whoever comes up last
+  does the plumbing" (handler.go:386-395), and the plumbing pod's declared
+  properties are applied to BOTH ends (common/veth.go:44-62 applies
+  link.Properties to self and peer; common/utils.go:39-68 ships the same
+  properties to the remote end).
+- UpdateLinks (handler.go:634-671): rebuilds only the LOCAL end's qdiscs.
+- DestroyPod (handler.go:538-590): clear alive status + finalizers, then
+  delete the pod's link rows; deleting a local veth end kills the pair, so
+  both directions of its links are deactivated.
+
+Batched device ops are padded to power-of-two bucket sizes so the jitted
+scatters compile O(log n) distinct shapes, never per batch.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubedtn_tpu.api.types import LOCALHOST, Link, Topology
+from kubedtn_tpu.ops import edge_state as es
+from kubedtn_tpu.topology.store import (
+    NotFoundError,
+    TopologyStore,
+    retry_on_conflict,
+)
+
+# VXLAN VNI base kept for wire-level parity (reference common/constants.go:8,
+# common/utils.go:29-36: vni = 5000 + uid).
+VXLAN_BASE = 5000
+
+
+def vni_from_uid(uid: int) -> int:
+    return VXLAN_BASE + uid
+
+
+def uid_from_vni(vni: int) -> int:
+    return vni - VXLAN_BASE
+
+
+def _next_pow2(n: int, floor: int = 8) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclass
+class EngineStats:
+    adds: int = 0
+    dels: int = 0
+    updates: int = 0
+    device_calls: int = 0
+    op_ms: dict[str, list[float]] = field(default_factory=dict)
+
+    def observe(self, method: str, ms: float) -> None:
+        self.op_ms.setdefault(method, []).append(ms)
+
+
+class SimEngine:
+    """Single source of truth for the device-array realization of links."""
+
+    def __init__(self, store: TopologyStore, capacity: int = 1024,
+                 node_ip: str = "10.0.0.1") -> None:
+        self.store = store
+        self.node_ip = node_ip  # the daemon's HOST_IP equivalent
+        self.state = es.init_state(capacity)
+        self.stats = EngineStats()
+        # host-side registries (the daemon's managers):
+        self._pod_ids: dict[str, int] = {}   # endpoint name -> node index
+        self._rows: dict[tuple[str, int], int] = {}  # (pod_key, uid) -> row
+        self._free: list[int] = list(range(capacity - 1, -1, -1))
+        self._topology_manager: set[str] = set()  # alive pods (metrics/TopologyManager)
+
+    # -- registries ----------------------------------------------------
+
+    def pod_id(self, endpoint: str) -> int:
+        """Stable integer id for any endpoint name (pod key, "localhost",
+        "physical/<ip>")."""
+        if endpoint not in self._pod_ids:
+            self._pod_ids[endpoint] = len(self._pod_ids)
+        return self._pod_ids[endpoint]
+
+    def row_of(self, pod_key: str, uid: int) -> int | None:
+        return self._rows.get((pod_key, uid))
+
+    @property
+    def num_active(self) -> int:
+        return len(self._rows)
+
+    # -- capacity ------------------------------------------------------
+
+    def _ensure_capacity(self, extra: int) -> None:
+        need = self.num_active + extra
+        cap = self.state.capacity
+        if need <= cap:
+            return
+        new_cap = _next_pow2(need, floor=cap * 2)
+        old_cap = self.state.capacity
+        self.state = es.grow_state(self.state, new_cap)
+        self._free = list(range(new_cap - 1, old_cap - 1, -1)) + self._free
+
+    # -- device op helpers --------------------------------------------
+
+    def _pad(self, arrs: list[np.ndarray], n: int):
+        """Pad host batches to a power-of-two lane count."""
+        b = _next_pow2(max(n, 1))
+        out = []
+        for a in arrs:
+            pad_width = [(0, b - n)] + [(0, 0)] * (a.ndim - 1)
+            out.append(jnp.asarray(np.pad(a, pad_width)))
+        valid = np.zeros((b,), dtype=bool)
+        valid[:n] = True
+        return out, jnp.asarray(valid)
+
+    def _apply_rows(self, entries: list[tuple[int, int, int, int, np.ndarray]]):
+        """entries: (row, uid, src, dst, props_row)."""
+        n = len(entries)
+        if n == 0:
+            return
+        rows = np.array([e[0] for e in entries], np.int32)
+        uids = np.array([e[1] for e in entries], np.int32)
+        src = np.array([e[2] for e in entries], np.int32)
+        dst = np.array([e[3] for e in entries], np.int32)
+        props = np.stack([e[4] for e in entries]).astype(np.float32)
+        (rows, uids, src, dst, props), valid = self._pad(
+            [rows, uids, src, dst, props], n)
+        self.state = es.apply_links(self.state, rows, uids, src, dst, props,
+                                    valid)
+        self.stats.device_calls += 1
+
+    def _delete_rows(self, rows_list: list[int]) -> None:
+        n = len(rows_list)
+        if n == 0:
+            return
+        rows = np.array(rows_list, np.int32)
+        (rows,), valid = self._pad([rows], n)
+        self.state = es.delete_links(self.state, rows, valid)
+        self.stats.device_calls += 1
+
+    def _update_rows(self, entries: list[tuple[int, np.ndarray]]) -> None:
+        n = len(entries)
+        if n == 0:
+            return
+        rows = np.array([e[0] for e in entries], np.int32)
+        props = np.stack([e[1] for e in entries]).astype(np.float32)
+        (rows, props), valid = self._pad([rows, props], n)
+        self.state = es.update_links(self.state, rows, props, valid)
+        self.stats.device_calls += 1
+
+    # -- pod / link lifecycle (the Local gRPC surface) ----------------
+
+    def get_pod(self, name: str, ns: str = "default") -> Topology:
+        """Local.Get equivalent (handler.go:50-60)."""
+        return self.store.get(ns or "default", name)
+
+    def set_alive(self, name: str, ns: str, src_ip: str, net_ns: str) -> bool:
+        """Local.SetAlive equivalent (handler.go:90-147): write placement
+        into status, manage the finalizer, register with the topology
+        manager. Alive ⇔ both src_ip and net_ns set."""
+        from kubedtn_tpu import GROUP_VERSION
+
+        alive = bool(src_ip) and bool(net_ns)
+
+        def txn_status():
+            topo = self.store.get(ns, name)
+            topo.status.src_ip = src_ip
+            topo.status.net_ns = net_ns
+            self.store.update_status(topo)
+
+        retry_on_conflict(txn_status)
+
+        def txn_meta():
+            topo = self.store.get(ns, name)
+            if alive:
+                if GROUP_VERSION not in topo.finalizers:
+                    topo.finalizers.append(GROUP_VERSION)
+            else:
+                topo.finalizers = []
+            self.store.update(topo)
+
+        retry_on_conflict(txn_meta)
+
+        key = f"{ns or 'default'}/{name}"
+        if alive:
+            self._topology_manager.add(key)
+        else:
+            self._topology_manager.discard(key)
+        return True
+
+    def setup_pod(self, name: str, ns: str = "default",
+                  net_ns: str = "") -> bool:
+        """Local.SetupPod equivalent (handler.go:495-535)."""
+        t0 = time.perf_counter()
+        try:
+            topo = self.get_pod(name, ns)
+        except NotFoundError:
+            # Not a topology pod: CNI delegates to the next plugin.
+            return True
+        self.set_alive(name, ns, self.node_ip, net_ns or f"/run/netns/{name}")
+        topo = self.get_pod(name, ns)
+        self.add_links(topo, topo.spec.links)
+        self.stats.observe("setup", (time.perf_counter() - t0) * 1e3)
+        return True
+
+    def destroy_pod(self, name: str, ns: str = "default") -> bool:
+        """Local.DestroyPod equivalent (handler.go:538-590)."""
+        key = f"{ns or 'default'}/{name}"
+        self._topology_manager.discard(key)
+        try:
+            topo = self.get_pod(name, ns)
+        except NotFoundError:
+            return False
+        # Fetch links BEFORE clearing alive status: dropping the finalizer
+        # may complete a pending CR deletion, after which the object is gone
+        # (the reference reads localPod first for the same reason —
+        # handler.go:559-586).
+        links = topo.spec.links
+        self.set_alive(name, ns, "", "")
+        self.del_links(topo, links)
+        return True
+
+    def is_alive(self, pod_key: str) -> bool:
+        ns, _, name = pod_key.partition("/")
+        try:
+            topo = self.store.get(ns, name)
+        except NotFoundError:
+            return False
+        return topo.is_alive()
+
+    def add_links(self, topo: Topology, links: list[Link]) -> bool:
+        """Local.AddLinks equivalent: the reference's per-link dispatch
+        (handler.go:316-459) collapsed into one batched device op."""
+        t0 = time.perf_counter()
+        local_key = topo.key
+        self._ensure_capacity(2 * len(links))
+        entries: list[tuple[int, int, int, int, np.ndarray]] = []
+        alive_cache: dict[str, bool] = {}
+        for link in links:
+            if link.is_macvlan():
+                # macvlan uplink: realized immediately, NO shaping applied
+                # (reference handler.go:335-345 never touches qdiscs here).
+                row = self._alloc(local_key, link.uid)
+                entries.append((
+                    row, link.uid, self.pod_id(local_key),
+                    self.pod_id(LOCALHOST),
+                    np.zeros((es.NPROP,), np.float32),
+                ))
+                continue
+            if link.is_physical():
+                # Physical-virtual link: daemon handles both perspectives
+                # locally (handler.go:348-369); the physical host is always
+                # "alive".
+                row = self._alloc(local_key, link.uid)
+                props = es.props_row(link.properties.to_numeric())
+                entries.append((row, link.uid, self.pod_id(local_key),
+                                self.pod_id(link.peer_pod), np.asarray(props)))
+                continue
+
+            peer_key = f"{topo.namespace}/{link.peer_pod}"
+            if peer_key not in alive_cache:
+                alive_cache[peer_key] = self.is_alive(peer_key)
+            if not alive_cache[peer_key]:
+                # Peer not up: do nothing — the peer will plumb both ends
+                # when it arrives (handler.go:389-395).
+                continue
+            if ((local_key, link.uid) in self._rows
+                    and (peer_key, link.uid) in self._rows):
+                # Both ends already realized: do nothing, like SetupVeth's
+                # "both interfaces already exist" path (common/veth.go:73-76).
+                continue
+
+            # Both alive: this pod plumbs BOTH directions with ITS declared
+            # properties (common/veth.go:44-62, common/utils.go:39-68).
+            props = np.asarray(es.props_row(link.properties.to_numeric()))
+            row = self._alloc(local_key, link.uid)
+            entries.append((row, link.uid, self.pod_id(local_key),
+                            self.pod_id(peer_key), props))
+            prow = self._alloc(peer_key, link.uid)
+            entries.append((prow, link.uid, self.pod_id(peer_key),
+                            self.pod_id(local_key), props))
+        self._apply_rows(entries)
+        self.stats.adds += len(entries)
+        self.stats.observe("add", (time.perf_counter() - t0) * 1e3)
+        return True
+
+    def del_links(self, topo: Topology, links: list[Link]) -> bool:
+        """Local.DelLinks equivalent (handler.go:461-492, 613-632).
+
+        Removing a local veth end destroys the pair, so the peer-direction
+        row of each link dies with it.
+        """
+        t0 = time.perf_counter()
+        local_key = topo.key
+        rows: list[int] = []
+        for link in links:
+            row = self._rows.pop((local_key, link.uid), None)
+            if row is not None:
+                rows.append(row)
+                self._free.append(row)
+            if not (link.is_macvlan() or link.is_physical()):
+                peer_key = f"{topo.namespace}/{link.peer_pod}"
+                prow = self._rows.pop((peer_key, link.uid), None)
+                if prow is not None:
+                    rows.append(prow)
+                    self._free.append(prow)
+        self._delete_rows(rows)
+        self.stats.dels += len(rows)
+        self.stats.observe("del", (time.perf_counter() - t0) * 1e3)
+        return True
+
+    def update_links(self, topo: Topology, links: list[Link]) -> bool:
+        """Local.UpdateLinks equivalent (handler.go:634-671): rebuild only
+        the LOCAL end's shaping, leaving the peer direction untouched."""
+        t0 = time.perf_counter()
+        local_key = topo.key
+        entries: list[tuple[int, np.ndarray]] = []
+        for link in links:
+            row = self._rows.get((local_key, link.uid))
+            if row is None:
+                continue
+            entries.append(
+                (row, np.asarray(es.props_row(link.properties.to_numeric()))))
+        self._update_rows(entries)
+        self.stats.updates += len(entries)
+        self.stats.observe("update", (time.perf_counter() - t0) * 1e3)
+        return True
+
+    def _alloc(self, pod_key: str, uid: int) -> int:
+        k = (pod_key, uid)
+        if k in self._rows:
+            return self._rows[k]  # idempotent re-plumb (SetupVeth semantics)
+        row = self._free.pop()
+        self._rows[k] = row
+        return row
+
+    # -- queries -------------------------------------------------------
+
+    def link_row(self, pod_key: str, uid: int) -> dict | None:
+        """Host-side readout of one directed link's realized state."""
+        row = self._rows.get((pod_key, uid))
+        if row is None:
+            return None
+        props = np.asarray(self.state.props[row])
+        return {
+            "row": row,
+            "uid": int(self.state.uid[row]),
+            "active": bool(self.state.active[row]),
+            **{name: float(props[i]) for i, name in enumerate(es.PROP_NAMES)},
+        }
+
+    def ping(self, a: str, b: str, uid: int, size_bytes: float = 84.0,
+             ns: str = "default", seed: int = 0) -> dict:
+        """Ping-equivalent probe: push one ICMP-sized packet each way
+        through the shaping kernels and report the RTT — the analogue of
+        the reference's e2e smoke test (reference hack/test-3node.sh:1-10).
+        """
+        from kubedtn_tpu.ops import netem
+
+        akey, bkey = f"{ns}/{a}", f"{ns}/{b}"
+        ra = self._rows.get((akey, uid))
+        rb = self._rows.get((bkey, uid))
+        if ra is None or rb is None:
+            return {"reachable": False, "rtt_us": float("inf")}
+        E = self.state.capacity
+        sizes = jnp.full((E,), size_bytes, jnp.float32)
+        have = jnp.zeros((E,), bool).at[jnp.array([ra, rb])].set(True)
+        t0 = jnp.zeros((E,), jnp.float32)
+        self.state, res = netem.shape_step(
+            self.state, sizes, have, t0, jax.random.key(seed))
+        d_ab = float(res.depart_us[ra])
+        d_ba = float(res.depart_us[rb])
+        delivered = bool(res.delivered[ra]) and bool(res.delivered[rb])
+        return {
+            "reachable": delivered,
+            "rtt_us": d_ab + d_ba if delivered else float("inf"),
+            "fwd_us": d_ab,
+            "rev_us": d_ba,
+        }

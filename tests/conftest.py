@@ -8,7 +8,9 @@ behavior is exercised by bench.py and the driver's dryrun (__graft_entry__.py).
 import os
 import sys
 
-# Must be set before jax is imported anywhere.
+# Must be set before jax initializes its backends. Note: the env var alone
+# is not enough under the axon TPU-tunnel platform, which overrides
+# JAX_PLATFORMS — the explicit config.update below is what sticks.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
@@ -17,6 +19,10 @@ if "--xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,68 @@
+"""Tests for topology model generators."""
+
+import numpy as np
+import pytest
+
+from kubedtn_tpu.api.types import LinkProperties
+from kubedtn_tpu.models import topologies as T
+from kubedtn_tpu.ops import edge_state as es
+
+
+def test_line_ring_star_mesh_counts():
+    assert T.line(5).n_links == 4
+    assert T.ring(5).n_links == 5
+    assert T.star(6).n_links == 6
+    assert T.full_mesh(4).n_links == 6
+
+
+def test_fat_tree_k8():
+    el = T.fat_tree(8)
+    assert el.n_nodes == 80          # 16 core + 32 agg + 32 edge
+    assert el.n_links == 8 * 4 * 8   # k pods x half aggs x (half+half)
+
+
+def test_clos_100k():
+    el = T.clos(100, 500, 0, links_per_pair=2)
+    assert el.n_links == 100_000
+    assert el.n_nodes == 600
+
+
+def test_random_mesh_no_self_loops():
+    el = T.random_mesh(50, 500, seed=3)
+    assert el.n_links == 500
+    assert not np.any(el.a == el.b)
+    assert len(np.unique(el.uid)) == 500
+
+
+def test_directed_expansion():
+    el = T.line(3, LinkProperties(latency="1ms"))
+    src, dst, uid, props = el.directed()
+    assert len(src) == 4  # 2 links x 2 directions
+    assert set(zip(src.tolist(), dst.tolist())) == {(0, 1), (1, 0), (1, 2), (2, 1)}
+    assert np.all(props[:, es.P_LATENCY_US] == 1000.0)
+
+
+def test_to_topologies_roundtrip_validates():
+    el = T.fat_tree(4, LinkProperties(latency="30m", loss="0.00001",
+                                      rate="1Gbit"))
+    topos = el.to_topologies()
+    for t in topos:
+        t.validate()  # no scientific-notation strings sneak through
+    # numeric round trip preserved
+    some = [l for t in topos for l in t.spec.links][0]
+    n = some.properties.to_numeric()
+    assert n["latency_us"] == 30 * 60 * 1_000_000
+    assert n["loss"] == pytest.approx(1e-5)
+    assert n["rate_bps"] == 1_000_000_000
+    # every uid appears exactly twice (once per endpoint view)
+    uids = [l.uid for t in topos for l in t.spec.links]
+    assert sorted(set(uids)) == sorted(uids)[::2][: len(set(uids))] or True
+    from collections import Counter
+    assert all(c == 2 for c in Counter(uids).values())
+
+
+def test_load_edge_list_into_state():
+    el = T.clos(4, 8, 2)
+    state, rows = T.load_edge_list_into_state(el)
+    assert int(state.num_active) == 2 * el.n_links
+    assert state.capacity >= 2 * el.n_links

@@ -1,0 +1,115 @@
+"""Tests for the in-process Topology store's K8s API semantics."""
+
+import pytest
+
+from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+from kubedtn_tpu.topology.store import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    TopologyStore,
+    retry_on_conflict,
+)
+
+
+def mk(name, uids=(1,)):
+    return Topology(name=name, spec=TopologySpec(links=[
+        Link(local_intf=f"eth{u}", peer_intf=f"eth{u}", peer_pod="p",
+             uid=u) for u in uids
+    ]))
+
+
+def test_create_get_list_delete():
+    s = TopologyStore()
+    s.create(mk("a"))
+    s.create(mk("b"))
+    assert s.get("default", "a").name == "a"
+    assert [t.name for t in s.list()] == ["a", "b"]
+    with pytest.raises(AlreadyExistsError):
+        s.create(mk("a"))
+    s.delete("default", "a")
+    with pytest.raises(NotFoundError):
+        s.get("default", "a")
+
+
+def test_conflict_on_stale_write():
+    s = TopologyStore()
+    s.create(mk("a"))
+    t1 = s.get("default", "a")
+    t2 = s.get("default", "a")
+    t1.status.src_ip = "10.0.0.1"
+    s.update_status(t1)
+    t2.status.src_ip = "10.0.0.2"
+    with pytest.raises(ConflictError):
+        s.update_status(t2)  # stale resourceVersion
+
+
+def test_retry_on_conflict_rereads():
+    s = TopologyStore()
+    s.create(mk("a"))
+    stale = s.get("default", "a")
+    other = s.get("default", "a")
+    other.status.net_ns = "/run/netns/x"
+    s.update_status(other)
+
+    calls = []
+
+    def txn():
+        calls.append(1)
+        t = s.get("default", "a")
+        if len(calls) == 1:
+            # simulate losing a race after the read
+            racer = s.get("default", "a")
+            racer.status.src_ip = "10.9.9.9"
+            s.update_status(racer)
+            t.status.src_ip = "10.0.0.1"
+            s.update_status(t)  # conflicts
+        else:
+            t.status.src_ip = "10.0.0.1"
+            s.update_status(t)
+
+    retry_on_conflict(txn)
+    assert len(calls) == 2
+    assert s.get("default", "a").status.src_ip == "10.0.0.1"
+    assert stale.resource_version < s.get("default", "a").resource_version
+
+
+def test_status_update_does_not_touch_spec():
+    s = TopologyStore()
+    s.create(mk("a", uids=(1, 2)))
+    t = s.get("default", "a")
+    t.spec.links = []  # try to sneak a spec change through update_status
+    t.status.src_ip = "1.2.3.4"
+    s.update_status(t)
+    got = s.get("default", "a")
+    assert len(got.spec.links) == 2
+    assert got.status.src_ip == "1.2.3.4"
+
+
+def test_finalizer_gates_deletion():
+    s = TopologyStore()
+    s.create(mk("a"))
+    t = s.get("default", "a")
+    t.finalizers = ["y-young.github.io/v1"]
+    s.update(t)
+    s.delete("default", "a")
+    # still present: finalizer holds it
+    held = s.get("default", "a")
+    assert held.deletion_requested
+    held.finalizers = []
+    s.update(held)
+    with pytest.raises(NotFoundError):
+        s.get("default", "a")
+
+
+def test_watch_stream():
+    s = TopologyStore()
+    w = s.watch()
+    s.create(mk("a"))
+    t = s.get("default", "a")
+    t.status.src_ip = "9.9.9.9"
+    s.update_status(t)
+    s.delete("default", "a")
+    events = [e.type for e in w.poll()]
+    assert events == ["ADDED", "MODIFIED", "DELETED"]
+    w.close()
