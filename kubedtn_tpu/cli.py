@@ -1,0 +1,204 @@
+"""tpudtn CLI — operator entry point.
+
+Subsumes the reference's operator tooling: the controller+daemon runtime
+(`daemon`), scenario loading (`apply`, like kubectl apply of the sample
+YAMLs), the ping smoke test (reference hack/test-3node.sh), the physical
+-host join CLI (reference cmd/main.go) as `physical-join`, plus the
+BASELINE scenario ladder and the headline bench.
+
+Usage:
+  python -m kubedtn_tpu.cli apply config/samples/3node.yml
+  python -m kubedtn_tpu.cli ping r1 r2 --uid 1 --file 3node.yml
+  python -m kubedtn_tpu.cli scenario clos_100k
+  python -m kubedtn_tpu.cli daemon --port 51111 --metrics-port 51112
+  python -m kubedtn_tpu.cli physical-join link.yml --daemon 127.0.0.1:51111
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+
+def _json_safe(obj):
+    """inf/nan are not valid JSON — emit null for unreachable values."""
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    return obj
+
+
+def cmd_apply(args) -> int:
+    from kubedtn_tpu.api.types import load_yaml
+    from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+
+    store = TopologyStore()
+    engine = SimEngine(store)
+    topos = load_yaml(args.file)
+    for t in topos:
+        t.validate()
+        store.create(t)
+    for t in topos:
+        engine.setup_pod(t.name, t.namespace)
+    rec = Reconciler(store, engine)
+    results = rec.drain()
+    print(json.dumps({
+        "topologies": len(topos),
+        "links_realized": engine.num_active,
+        "reconciles": len(results),
+    }))
+    return 0
+
+
+def cmd_ping(args) -> int:
+    from kubedtn_tpu.api.types import load_yaml
+    from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+
+    store = TopologyStore()
+    engine = SimEngine(store)
+    topos = load_yaml(args.file)
+    for t in topos:
+        store.create(t)
+    for t in topos:
+        engine.setup_pod(t.name, t.namespace)
+    Reconciler(store, engine).drain()
+    uid = args.uid
+    if uid is None:
+        for t in topos:
+            if t.name != args.a:
+                continue
+            for l in t.spec.links:
+                if l.peer_pod == args.b:
+                    uid = l.uid
+    if uid is None:
+        print(f"no link between {args.a} and {args.b}", file=sys.stderr)
+        return 1
+    out = engine.ping(args.a, args.b, uid)
+    print(json.dumps(_json_safe(out)))
+    return 0 if out["reachable"] else 1
+
+
+def cmd_scenario(args) -> int:
+    from kubedtn_tpu.scenarios import LADDER
+
+    if args.name == "all":
+        for name, fn in LADDER.items():
+            print(json.dumps(_json_safe(fn())))
+        return 0
+    if args.name not in LADDER:
+        print(f"unknown scenario {args.name}; "
+              f"choices: {', '.join(LADDER)} or all", file=sys.stderr)
+        return 1
+    print(json.dumps(_json_safe(LADDER[args.name]())))
+    return 0
+
+
+def cmd_daemon(args) -> int:
+    from kubedtn_tpu.metrics.metrics import MetricsServer, make_registry
+    from kubedtn_tpu.topology import SimEngine, TopologyStore
+    from kubedtn_tpu.wire.server import Daemon, make_server
+
+    store = TopologyStore()
+    engine = SimEngine(store, node_ip=args.node_ip)
+    registry, hist = make_registry(engine)
+    engine.stats.observer = hist
+    daemon = Daemon(engine, hist)
+    server, port = make_server(daemon, port=args.port)
+    metrics = MetricsServer(registry, port=args.metrics_port)
+    metrics.start()
+    server.start()
+    print(f"kubedtn-tpu daemon: gRPC on :{port}, "
+          f"metrics on :{metrics.port}/metrics", flush=True)
+    try:
+        server.wait_for_termination()
+    except KeyboardInterrupt:
+        server.stop(0)
+        metrics.stop()
+    return 0
+
+
+def cmd_physical_join(args) -> int:
+    """Join a physical host to the twin (reference cmd/main.go:26-101):
+    read {link, remote_ip} YAML and ask the daemon to realize the
+    host-side end via Remote.Update."""
+    import yaml
+
+    from kubedtn_tpu.api.types import Link
+    from kubedtn_tpu.topology.engine import vni_from_uid
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+
+    with open(args.file) as f:
+        q = yaml.safe_load(f)
+    link = Link.from_dict(q["link"])
+    if not link.is_physical():
+        print("peer_pod must be physical/<ip>", file=sys.stderr)
+        return 1
+    client = DaemonClient(args.daemon)
+    resp = client.Update(pb.RemotePod(
+        net_ns="",
+        intf_name=link.local_intf,
+        intf_ip=link.local_ip,
+        peer_vtep=q["remote_ip"],
+        vni=vni_from_uid(link.uid),
+        kube_ns="default",
+        name=f"physical/{link.physical_peer_ip()}",
+        properties=pb.props_to_proto(link.properties),
+    ))
+    print(json.dumps({"joined": bool(resp.response)}))
+    client.close()
+    return 0 if resp.response else 1
+
+
+def cmd_bench(args) -> int:
+    import bench
+
+    bench.main()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpudtn")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ap = sub.add_parser("apply", help="load topology YAML and reconcile")
+    ap.add_argument("file")
+    ap.set_defaults(fn=cmd_apply)
+
+    pp = sub.add_parser("ping", help="ping-equivalent probe between pods")
+    pp.add_argument("a")
+    pp.add_argument("b")
+    pp.add_argument("--uid", type=int, default=None)
+    pp.add_argument("--file", required=True)
+    pp.set_defaults(fn=cmd_ping)
+
+    sp = sub.add_parser("scenario", help="run a BASELINE ladder scenario")
+    sp.add_argument("name")
+    sp.set_defaults(fn=cmd_scenario)
+
+    dp = sub.add_parser("daemon", help="serve the gRPC control plane")
+    dp.add_argument("--port", type=int, default=51111)
+    dp.add_argument("--metrics-port", type=int, default=51112)
+    dp.add_argument("--node-ip", default="10.0.0.1")
+    dp.set_defaults(fn=cmd_daemon)
+
+    jp = sub.add_parser("physical-join",
+                        help="join a physical host via a daemon")
+    jp.add_argument("file")
+    jp.add_argument("--daemon", default="127.0.0.1:51111")
+    jp.set_defaults(fn=cmd_physical_join)
+
+    bp = sub.add_parser("bench", help="run the headline benchmark")
+    bp.set_defaults(fn=cmd_bench)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

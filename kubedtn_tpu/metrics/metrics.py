@@ -1,0 +1,149 @@
+"""Prometheus metrics — name/label/bucket parity with the reference daemon.
+
+Exports the same series the reference's daemon serves on :51112/metrics:
+
+- `kubedtnd_request_duration_milliseconds{method}` histogram with buckets
+  0,1,5,10,20,50,100,200,500,1000,2000,5000 and methods
+  add|del|update|remoteUpdate|setup (reference
+  daemon/metrics/latency_histograms.go:10-23, observed at
+  daemon/kubedtn/handler.go:195,456,489,665).
+- `interface_{rx,tx}_{packets,bytes}` and `interface_{rx,tx}_{errors,
+  dropped}` gauges labeled (interface, pod, namespace) (reference
+  daemon/metrics/interface_statistics.go:17-65). Where the reference walks
+  every pod netns with netlink per scrape (:79-133), this collector reads
+  the cumulative device counters in one transfer.
+
+Mapping from simulation taxa to interface counters:
+- tx_dropped  ← netem loss + TBF queue + delay-ring drops (egress side)
+- rx_errors   ← corrupt-flagged deliveries
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+from prometheus_client import CollectorRegistry, Histogram, generate_latest
+from prometheus_client.core import GaugeMetricFamily
+
+# Reference bucket edges (latency_histograms.go:15).
+BUCKETS = (0, 1, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000)
+
+HTTP_ADDR_DEFAULT = 51112  # reference common/constants.go:10 (":51112")
+
+
+class LatencyHistograms:
+    """kubedtnd_request_duration_milliseconds{method} (parity series)."""
+
+    def __init__(self, registry: CollectorRegistry) -> None:
+        self._h = Histogram(
+            "kubedtnd_request_duration_milliseconds",
+            "Latency of requests in milliseconds",
+            ["method"],
+            buckets=BUCKETS,
+            registry=registry,
+        )
+
+    def observe(self, method: str, latency_ms: float) -> None:
+        self._h.labels(method=method).observe(latency_ms)
+
+
+class InterfaceStatsCollector:
+    """interface_* gauges from the engine's realized links + sim counters."""
+
+    def __init__(self, engine, sim_counters_fn=None) -> None:
+        self._engine = engine
+        self._sim_counters_fn = sim_counters_fn
+
+    def collect(self):
+        labels = ["interface", "pod", "namespace"]
+        fams = {
+            name: GaugeMetricFamily(f"interface_{name}", doc, labels=labels)
+            for name, doc in [
+                ("rx_packets", "Number of good packets received by the interface"),
+                ("rx_bytes", "Number of good received bytes, corresponding to rx_packets"),
+                ("tx_packets", "Number of packets successfully transmitted"),
+                ("tx_bytes", "Number of good transmitted bytes, corresponding to tx_packets"),
+                ("rx_errors", "Total number of bad packets received on this network device"),
+                ("tx_errors", "Total number of transmit problems"),
+                ("rx_dropped", "Number of packets received but not processed, e.g. due to lack of resources or unsupported protocol"),
+                ("tx_dropped", "Number of packets dropped on their way to transmission, e.g. due to lack of resources"),
+            ]
+        }
+        counters = self._sim_counters_fn() if self._sim_counters_fn else None
+        if counters is not None:
+            c = {k: np.asarray(getattr(counters, k)) for k in (
+                "tx_packets", "tx_bytes", "rx_packets", "rx_bytes",
+                "dropped_loss", "dropped_queue", "dropped_ring",
+                "rx_corrupted")}
+        # Reverse row map: row -> (pod_key, uid); interface name from the
+        # spec is not tracked per row, so expose uid-derived names the way
+        # the CRD samples do (eth<n> ordering is a spec-level concern).
+        for (pod_key, uid), row in sorted(self._engine._rows.items()):
+            ns, _, pod = pod_key.partition("/")
+            iface = f"uid{uid}"
+            lab = [iface, pod, ns]
+            if counters is None:
+                continue
+            # tx = this row's egress; rx = reverse row's deliveries into us
+            fams["tx_packets"].add_metric(lab, float(c["tx_packets"][row]))
+            fams["tx_bytes"].add_metric(lab, float(c["tx_bytes"][row]))
+            fams["tx_dropped"].add_metric(
+                lab, float(c["dropped_loss"][row] + c["dropped_queue"][row]
+                           + c["dropped_ring"][row]))
+            fams["tx_errors"].add_metric(lab, 0.0)
+            rev = self._engine.reverse_row(pod_key, uid)
+            if rev is not None:
+                fams["rx_packets"].add_metric(
+                    lab, float(c["rx_packets"][rev]))
+                fams["rx_bytes"].add_metric(lab, float(c["rx_bytes"][rev]))
+                fams["rx_errors"].add_metric(
+                    lab, float(c["rx_corrupted"][rev]))
+                fams["rx_dropped"].add_metric(lab, 0.0)
+        return list(fams.values())
+
+
+class MetricsServer:
+    """Serves the registry on an HTTP port — the daemon's :51112/metrics
+    endpoint (reference daemon/main.go:57-66)."""
+
+    def __init__(self, registry: CollectorRegistry,
+                 port: int = HTTP_ADDR_DEFAULT) -> None:
+        self.registry = registry
+        reg = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = generate_latest(reg)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence
+                pass
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._srv.server_port
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        t.start()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+
+
+def make_registry(engine=None, sim_counters_fn=None):
+    """Registry with the parity collectors installed."""
+    registry = CollectorRegistry()
+    hist = LatencyHistograms(registry)
+    if engine is not None:
+        registry.register(InterfaceStatsCollector(engine, sim_counters_fn))
+    return registry, hist

@@ -1,0 +1,129 @@
+"""Traffic models — per-edge packet sources for the data plane.
+
+The reference measures its data plane with external traffic generators
+(ping in hack/test-3node.sh, iperf pods in config/samples/tc/bandwidth.yaml);
+here the generators are part of the framework, vectorized per edge:
+
+- CBR: constant bit rate, byte-credit accumulator.
+- Poisson: Poisson packet arrivals at a mean rate.
+- ON/OFF: two-state bursty source (exponential sojourn times) gating a CBR.
+
+Each step every edge emits up to K packet slots (sizes, validity, arrival
+offsets inside the step) — fully static shapes, advanced by one fused
+kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+MODE_OFF = 0
+MODE_CBR = 1
+MODE_POISSON = 2
+MODE_ONOFF = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficSpec:
+    """Static per-edge traffic configuration."""
+
+    mode: jax.Array       # i32[E]
+    rate_bps: jax.Array   # f32[E] offered load (mean for poisson/onoff)
+    pkt_bytes: jax.Array  # f32[E]
+    on_us: jax.Array      # f32[E] mean ON sojourn (onoff)
+    off_us: jax.Array     # f32[E] mean OFF sojourn (onoff)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficState:
+    """Mutable per-edge source state."""
+
+    credit: jax.Array     # f32[E] accumulated bytes not yet emitted
+    on: jax.Array         # bool[E] ON/OFF gate
+
+
+for _cls in (TrafficSpec, TrafficState):
+    jax.tree_util.register_dataclass(
+        _cls,
+        data_fields=[f.name for f in dataclasses.fields(_cls)],
+        meta_fields=[],
+    )
+
+
+def cbr_everywhere(capacity: int, n_edges: int, rate_bps: float,
+                   pkt_bytes: float = 1500.0) -> TrafficSpec:
+    """Convenience: CBR on the first n_edges rows, off elsewhere."""
+    idx = jnp.arange(capacity)
+    on = idx < n_edges
+    return TrafficSpec(
+        mode=jnp.where(on, MODE_CBR, MODE_OFF).astype(jnp.int32),
+        rate_bps=jnp.where(on, rate_bps, 0.0).astype(jnp.float32),
+        pkt_bytes=jnp.full((capacity,), pkt_bytes, jnp.float32),
+        on_us=jnp.zeros((capacity,), jnp.float32),
+        off_us=jnp.zeros((capacity,), jnp.float32),
+    )
+
+
+def init_traffic_state(capacity: int) -> TrafficState:
+    return TrafficState(
+        credit=jnp.zeros((capacity,), jnp.float32),
+        on=jnp.ones((capacity,), dtype=bool),
+    )
+
+
+def generate(spec: TrafficSpec, ts: TrafficState, dt_us: jax.Array,
+             k: int, key: jax.Array):
+    """Emit up to k packets per edge for one step of length dt_us.
+
+    Returns (ts', sizes f32[E,K], valid bool[E,K], t_arrival f32[E,K]).
+    Arrivals are offsets in [0, dt_us), sorted along K.
+    """
+    E = spec.mode.shape[0]
+    k_onoff, k_poisson, k_arr = jax.random.split(key, 3)
+
+    rate_b_us = spec.rate_bps / 8e6  # bytes per µs
+
+    # ON/OFF gate: per-step toggle probabilities from exponential sojourns.
+    p_off2on = jnp.where(spec.off_us > 0, 1 - jnp.exp(-dt_us / jnp.maximum(
+        spec.off_us, 1.0)), 1.0)
+    p_on2off = jnp.where(spec.on_us > 0, 1 - jnp.exp(-dt_us / jnp.maximum(
+        spec.on_us, 1.0)), 0.0)
+    u = jax.random.uniform(k_onoff, (E,))
+    toggled_on = jnp.where(ts.on, u >= p_on2off, u < p_off2on)
+    gate = jnp.where(spec.mode == MODE_ONOFF, toggled_on, True)
+
+    # CBR / ON-gated CBR: credit accumulator.
+    is_cbr = (spec.mode == MODE_CBR) | ((spec.mode == MODE_ONOFF) & gate)
+    credit = ts.credit + jnp.where(is_cbr, rate_b_us * dt_us, 0.0)
+    n_cbr = jnp.floor(credit / jnp.maximum(spec.pkt_bytes, 1.0))
+
+    # Poisson: mean packets per step = rate / pkt_size.
+    lam = rate_b_us * dt_us / jnp.maximum(spec.pkt_bytes, 1.0)
+    n_poi = jax.random.poisson(
+        k_poisson, jnp.where(spec.mode == MODE_POISSON, lam, 0.0),
+        (E,)).astype(jnp.float32)
+
+    n = jnp.where(spec.mode == MODE_POISSON, n_poi, n_cbr)
+    n = jnp.where((spec.mode == MODE_OFF), 0.0, n)
+    n = jnp.minimum(n, float(k))
+    credit = jnp.where(is_cbr, credit - n * spec.pkt_bytes, credit)
+
+    lane = jnp.arange(k, dtype=jnp.float32)[None, :]      # [1, K]
+    valid = lane < n[:, None]
+    sizes = jnp.where(valid, spec.pkt_bytes[:, None], 0.0)
+
+    # arrivals: CBR evenly spaced; poisson uniform-sorted.
+    even = (lane + 0.5) / jnp.maximum(n[:, None], 1.0) * dt_us
+    rand = jnp.sort(
+        jax.random.uniform(k_arr, (E, k), maxval=dt_us), axis=1)
+    t_arr = jnp.where((spec.mode == MODE_POISSON)[:, None], rand, even)
+    t_arr = jnp.where(valid, t_arr, 0.0)
+
+    return (
+        TrafficState(credit=credit, on=jnp.where(
+            spec.mode == MODE_ONOFF, toggled_on, ts.on)),
+        sizes, valid, t_arr,
+    )

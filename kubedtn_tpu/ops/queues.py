@@ -1,0 +1,182 @@
+"""Packet-event data plane: multi-packet shaping + in-flight delay lines.
+
+The reference's steady-state data plane is per-packet kernel machinery — a
+pcap loop shipping frames over unary gRPC (reference
+daemon/grpcwire/grpcwire.go:386-462), VXLAN encap, or the eBPF sockmap
+bypass (reference bpf/redir.c:10-63). Here the per-packet hot path is
+device-resident: each simulation step advances every edge by up to K packet
+slots through the netem+TBF chain (a `lax.scan` over slots of a fully
+vmapped per-edge kernel), and packets whose departure lies beyond the step
+land in a per-edge in-flight ring (the delay line) to be delivered by a
+later step.
+
+Delivery is time-ordered, like netem's tfifo queue: each step releases every
+in-flight slot whose departure time falls inside the step, regardless of
+insertion order (reordered packets overtake). The in-flight ring has
+`Q` slots per edge; inserting into a full ring drops the packet
+(netem's finite qdisc limit — the kernel default is 1000 packets; Q is the
+static-shape analogue).
+
+Every packet carries a `final_dst` node so the routing layer can forward
+delivered packets across multiple hops (see kubedtn_tpu.ops.routing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kubedtn_tpu.ops import netem
+from kubedtn_tpu.ops.edge_state import EdgeState
+
+INF = jnp.float32(jnp.inf)
+
+
+@dataclasses.dataclass(frozen=True)
+class InFlight:
+    """Per-edge delay line: packets shaped but not yet delivered.
+
+    Times are step-relative µs (rolled each step with the EdgeState epoch).
+    Empty slots have t == +inf.
+    """
+
+    t: jax.Array          # f32[E, Q] delivery time
+    size: jax.Array       # f32[E, Q] bytes
+    final_dst: jax.Array  # i32[E, Q] destination node for multi-hop
+    corrupted: jax.Array  # bool[E, Q]
+
+    @property
+    def q(self) -> int:
+        return self.t.shape[1]
+
+
+jax.tree_util.register_dataclass(
+    InFlight,
+    data_fields=[f.name for f in dataclasses.fields(InFlight)],
+    meta_fields=[],
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EdgeCounters:
+    """Cumulative per-edge counters — the per-interface statistics schema of
+    the reference's Prometheus collector (reference
+    daemon/metrics/interface_statistics.go:19-65): tx/rx packets/bytes plus
+    drop/error taxa."""
+
+    tx_packets: jax.Array      # f32[E] entered the edge (post-source)
+    tx_bytes: jax.Array
+    rx_packets: jax.Array      # delivered out the far end
+    rx_bytes: jax.Array
+    dropped_loss: jax.Array    # netem loss
+    dropped_queue: jax.Array   # TBF 50ms-queue overflow
+    dropped_ring: jax.Array    # delay-line overflow (qdisc limit)
+    rx_corrupted: jax.Array    # delivered but corrupt-flagged
+    duplicated: jax.Array
+    reordered: jax.Array
+
+
+jax.tree_util.register_dataclass(
+    EdgeCounters,
+    data_fields=[f.name for f in dataclasses.fields(EdgeCounters)],
+    meta_fields=[],
+)
+
+
+def init_inflight(capacity: int, q: int = 32) -> InFlight:
+    return InFlight(
+        t=jnp.full((capacity, q), jnp.inf, jnp.float32),
+        size=jnp.zeros((capacity, q), jnp.float32),
+        final_dst=jnp.full((capacity, q), -1, jnp.int32),
+        corrupted=jnp.zeros((capacity, q), dtype=bool),
+    )
+
+
+def init_counters(capacity: int) -> EdgeCounters:
+    z = jnp.zeros((capacity,), jnp.float32)
+    return EdgeCounters(*([z] * 10))
+
+
+def shape_packets(state: EdgeState, sizes: jax.Array, valid: jax.Array,
+                  t_arrival: jax.Array, key: jax.Array):
+    """Shape up to K packets per edge, sequentially per edge.
+
+    Args:
+      sizes: f32[E, K]; valid: bool[E, K]; t_arrival: f32[E, K] —
+        per-edge packet slots, arrival-ordered along K.
+      key: step PRNG key.
+
+    Returns (state', ShapeResult with [E, K] leaves).
+    """
+    K = sizes.shape[1]
+    keys = jax.random.split(key, K)
+
+    def body(st, inp):
+        sz, ok, ta, k = inp
+        st, res = netem.shape_step.__wrapped__(st, sz, ok, ta, k)
+        return st, res
+
+    state, res = jax.lax.scan(
+        body, state,
+        (sizes.T, valid.T, t_arrival.T, keys),
+    )
+    # scan stacks along K-major; transpose leaves back to [E, K]
+    res = jax.tree.map(lambda x: x.T, res)
+    return state, res
+
+
+def insert_inflight(fl: InFlight, depart: jax.Array, sizes: jax.Array,
+                    final_dst: jax.Array, corrupted: jax.Array,
+                    deliver: jax.Array):
+    """Insert up to K shaped packets per edge into the delay line.
+
+    deliver: bool[E, K] — which slots hold a real packet to deliver.
+    Returns (fl', dropped_ring[E] count of packets lost to a full ring).
+    """
+    K = depart.shape[1]
+
+    def body(carry, inp):
+        t, size, fdst, corr = carry
+        dep_k, sz_k, fd_k, co_k, ok_k = inp  # [E]
+        free = t == jnp.inf                  # [E, Q]
+        # leftmost free slot per edge
+        slot = jnp.argmax(free, axis=1)      # [E]
+        has_free = jnp.any(free, axis=1)
+        do = ok_k & has_free
+        e_idx = jnp.arange(t.shape[0])
+        t = t.at[e_idx, slot].set(jnp.where(do, dep_k, t[e_idx, slot]))
+        size = size.at[e_idx, slot].set(
+            jnp.where(do, sz_k, size[e_idx, slot]))
+        fdst = fdst.at[e_idx, slot].set(
+            jnp.where(do, fd_k, fdst[e_idx, slot]))
+        corr = corr.at[e_idx, slot].set(
+            jnp.where(do, co_k, corr[e_idx, slot]))
+        dropped = (ok_k & ~has_free).astype(jnp.float32)
+        return (t, size, fdst, corr), dropped
+
+    (t, size, fdst, corr), dropped = jax.lax.scan(
+        body,
+        (fl.t, fl.size, fl.final_dst, fl.corrupted),
+        (depart.T, sizes.T, final_dst.T, corrupted.T, deliver.T),
+    )
+    return InFlight(t=t, size=size, final_dst=fdst,
+                    corrupted=corr), dropped.sum(axis=0)
+
+
+def pop_due(fl: InFlight, dt_us: jax.Array):
+    """Release every in-flight packet due within this step (t <= dt_us).
+
+    Returns (fl', due mask bool[E, Q]) — the caller reads sizes/final_dst
+    under the mask before they are cleared, then rolls the epoch.
+    """
+    due = fl.t <= dt_us
+    fl2 = InFlight(
+        t=jnp.where(due, INF, fl.t - dt_us),
+        size=jnp.where(due, 0.0, fl.size),
+        final_dst=jnp.where(due, -1, fl.final_dst),
+        corrupted=jnp.where(due, False, fl.corrupted),
+    )
+    return fl2, due

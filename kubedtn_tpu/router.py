@@ -1,0 +1,223 @@
+"""RouterSim — multi-hop packet forwarding over the simulated fabric.
+
+Composes the data plane (kubedtn_tpu.sim) with the routing kernels
+(kubedtn_tpu.ops.routing): packets carry a final destination node; when a
+packet is delivered out of an edge whose far end is not its destination, it
+re-enters the fabric on that node's next-hop edge in the following step.
+This is the piece the reference delegates to real routing daemons running
+inside pods over its emulated links — here the whole forwarding plane is
+device arrays.
+
+Forwarding is static-shape: every step, due packets are grouped by their
+next-hop edge with a sort + segmented-rank, then scattered into at most
+`k_fwd` re-injection lanes per edge (excess packets drop and are counted,
+like a router's input-queue overflow).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kubedtn_tpu.models.traffic import TrafficSpec, generate
+from kubedtn_tpu.ops import netem
+from kubedtn_tpu.ops.queues import init_inflight, insert_inflight, pop_due
+from kubedtn_tpu.ops.queues import shape_packets
+from kubedtn_tpu.sim import SimState, _add, init_sim
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterState:
+    """Forwarding-plane state carried between steps."""
+
+    sim: SimState
+    next_edge: jax.Array       # i32[n, n] routing table (edge rows)
+    pend_size: jax.Array       # f32[E, Kf] packets awaiting re-injection
+    pend_dst: jax.Array        # i32[E, Kf] their final destinations
+    pend_corr: jax.Array       # bool[E, Kf]
+    node_rx_packets: jax.Array  # f32[n] packets that reached their dest
+    node_rx_bytes: jax.Array    # f32[n]
+    fwd_dropped: jax.Array      # f32[] packets lost to forwarding overflow
+    no_route_dropped: jax.Array  # f32[] packets with no route to dest
+
+
+jax.tree_util.register_dataclass(
+    RouterState,
+    data_fields=[f.name for f in dataclasses.fields(RouterState)],
+    meta_fields=[],
+)
+
+
+def init_router(edges, next_edge: jax.Array, n_nodes: int, q: int = 32,
+                k_fwd: int = 8) -> RouterState:
+    sim = init_sim(edges, q=q)
+    E = edges.capacity
+    return RouterState(
+        sim=sim,
+        next_edge=next_edge,
+        pend_size=jnp.zeros((E, k_fwd), jnp.float32),
+        pend_dst=jnp.full((E, k_fwd), -1, jnp.int32),
+        pend_corr=jnp.zeros((E, k_fwd), dtype=bool),
+        node_rx_packets=jnp.zeros((n_nodes,), jnp.float32),
+        node_rx_bytes=jnp.zeros((n_nodes,), jnp.float32),
+        fwd_dropped=jnp.zeros((), jnp.float32),
+        no_route_dropped=jnp.zeros((), jnp.float32),
+    )
+
+
+def _group_into_lanes(target: jax.Array, size: jax.Array, fdst: jax.Array,
+                      corr: jax.Array, live: jax.Array, E: int, k_fwd: int):
+    """Scatter flat packets into per-edge lanes [E, k_fwd].
+
+    target: i32[M] destination edge row per packet (E = drop).
+    Returns (size[E,Kf], dst[E,Kf], corr[E,Kf], valid[E,Kf], dropped count).
+    """
+    M = target.shape[0]
+    tgt = jnp.where(live, target, E)
+    order = jnp.argsort(tgt)
+    tgt_s = tgt[order]
+    # segmented rank: position within each equal-target run
+    idx = jnp.arange(M)
+    starts = jnp.concatenate([jnp.array([True]), tgt_s[1:] != tgt_s[:-1]])
+    start_idx = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(starts, idx, 0))
+    rank = idx - start_idx
+
+    ok = (tgt_s < E) & (rank < k_fwd)
+    row = jnp.where(ok, tgt_s, E)
+    lane = jnp.where(ok, rank, 0)
+
+    out_sz = jnp.zeros((E + 1, k_fwd), jnp.float32)
+    out_dst = jnp.full((E + 1, k_fwd), -1, jnp.int32)
+    out_co = jnp.zeros((E + 1, k_fwd), dtype=bool)
+    out_ok = jnp.zeros((E + 1, k_fwd), dtype=bool)
+
+    out_sz = out_sz.at[row, lane].set(size[order], mode="drop")[:E]
+    out_dst = out_dst.at[row, lane].set(fdst[order], mode="drop")[:E]
+    out_co = out_co.at[row, lane].set(corr[order], mode="drop")[:E]
+    out_ok = out_ok.at[row, lane].set(ok, mode="drop")[:E]
+
+    dropped = ((tgt_s < E) & (rank >= k_fwd)).sum().astype(jnp.float32)
+    return out_sz, out_dst, out_co, out_ok, dropped
+
+
+@partial(jax.jit, static_argnums=(4, 5), donate_argnums=0)
+def router_step(rs: RouterState, spec: TrafficSpec, flow_dst: jax.Array,
+                key: jax.Array, k_slots: int, k_fwd: int, dt_us: jax.Array):
+    """One routed data-plane step.
+
+    `flow_dst` (i32[E]) gives the final-destination node of the host flow
+    sourced on each edge; entries < 0 default to the edge's own far end
+    (single-hop). Pending lanes are forwarded packets re-entering mid-path.
+    """
+    sim = rs.sim
+    E = sim.edges.capacity
+    kg, ks = jax.random.split(key)
+
+    # 1. traffic + pending-forward arrivals
+    tstate, sizes_t, valid_t, t_arr_t = generate(spec, sim.traffic, dt_us,
+                                                 k_slots, kg)
+    valid_t = valid_t & sim.edges.active[:, None]
+    sizes_t = jnp.where(valid_t, sizes_t, 0.0)  # keep byte counters honest
+    fd = jnp.where(flow_dst >= 0, flow_dst, sim.edges.dst)
+    fdst_t = jnp.broadcast_to(fd[:, None], sizes_t.shape)
+
+    valid_p = rs.pend_dst >= 0
+    sizes = jnp.concatenate([sizes_t, rs.pend_size], axis=1)
+    valid = jnp.concatenate([valid_t, valid_p], axis=1)
+    t_arr = jnp.concatenate(
+        [t_arr_t, jnp.zeros_like(rs.pend_size)], axis=1)
+    fdst_in = jnp.concatenate([fdst_t, rs.pend_dst], axis=1)
+
+    # 2. shape through the qdisc chain
+    edges, res = shape_packets(sim.edges, sizes, valid, t_arr, ks)
+
+    # 3. into the delay lines (duplicates share the original's departure)
+    dep_all = jnp.concatenate([res.depart_us, res.depart_us], axis=1)
+    sz_all = jnp.concatenate([sizes, sizes], axis=1)
+    co_all = jnp.concatenate([res.corrupted, res.corrupted], axis=1)
+    fd_all = jnp.concatenate([fdst_in, fdst_in], axis=1)
+    deliver_all = jnp.concatenate(
+        [res.delivered, res.delivered & res.duplicated], axis=1)
+    fl, dropped_ring = insert_inflight(
+        sim.inflight, dep_all, sz_all, fd_all, co_all, deliver_all)
+
+    # 4. deliveries due this step
+    fl_after, due = pop_due(fl, dt_us)
+    here = jnp.broadcast_to(sim.edges.dst[:, None], due.shape)
+    at_dest = due & (fl.final_dst == here)
+    in_transit = due & ~at_dest
+
+    # 4a. final deliveries -> per-node counters
+    node_rx_p = rs.node_rx_packets.at[
+        jnp.where(at_dest, here, rs.node_rx_packets.shape[0])
+    ].add(1.0, mode="drop")
+    node_rx_b = rs.node_rx_bytes.at[
+        jnp.where(at_dest, here, rs.node_rx_bytes.shape[0])
+    ].add(jnp.where(at_dest, fl.size, 0.0), mode="drop")
+
+    # 4b. transit packets -> next-hop edge, re-inject next step
+    flat_here = here.reshape(-1)
+    flat_fd = fl.final_dst.reshape(-1)
+    flat_live = in_transit.reshape(-1)
+    safe_here = jnp.where(flat_live, flat_here, 0)
+    safe_fd = jnp.where(flat_live, jnp.maximum(flat_fd, 0), 0)
+    nxt = rs.next_edge[safe_here, safe_fd]
+    no_route = flat_live & (nxt < 0)
+    target = jnp.where(flat_live & (nxt >= 0), nxt, E)
+    p_sz, p_dst, p_co, p_ok, fwd_drop = _group_into_lanes(
+        target, fl.size.reshape(-1), flat_fd, fl.corrupted.reshape(-1),
+        flat_live & (nxt >= 0), E, k_fwd)
+
+    counters = _add(
+        sim.counters,
+        tx_packets=valid.sum(axis=1).astype(jnp.float32),
+        tx_bytes=sizes.sum(axis=1),
+        rx_packets=due.sum(axis=1).astype(jnp.float32),
+        rx_bytes=jnp.where(due, fl.size, 0.0).sum(axis=1),
+        rx_corrupted=jnp.where(due, fl.corrupted, False).sum(
+            axis=1).astype(jnp.float32),
+        dropped_loss=res.dropped_loss.sum(axis=1).astype(jnp.float32),
+        dropped_queue=res.dropped_queue.sum(axis=1).astype(jnp.float32),
+        dropped_ring=dropped_ring,
+        duplicated=res.duplicated.sum(axis=1).astype(jnp.float32),
+        reordered=res.reordered.sum(axis=1).astype(jnp.float32),
+    )
+
+    edges = netem.roll_epoch.__wrapped__(edges, dt_us)
+    sim2 = SimState(edges=edges, inflight=fl_after, counters=counters,
+                    traffic=tstate, clock_us=sim.clock_us + dt_us)
+    rs2 = RouterState(
+        sim=sim2,
+        next_edge=rs.next_edge,
+        pend_size=jnp.where(p_ok, p_sz, 0.0),
+        pend_dst=jnp.where(p_ok, p_dst, -1),
+        pend_corr=p_co & p_ok,
+        node_rx_packets=node_rx_p,
+        node_rx_bytes=node_rx_b,
+        fwd_dropped=rs.fwd_dropped + fwd_drop,
+        no_route_dropped=rs.no_route_dropped +
+        no_route.sum().astype(jnp.float32),
+    )
+    return rs2
+
+
+def run_routed(rs: RouterState, spec: TrafficSpec, flow_dst, steps: int,
+               dt_us: float, k_slots: int = 4, k_fwd: int = 8, seed: int = 0
+               ) -> RouterState:
+    keys = jax.random.split(jax.random.key(seed), steps)
+    dt = jnp.float32(dt_us)
+
+    @partial(jax.jit, static_argnums=(2, 3))
+    def _run(rs, keys, k_slots, k_fwd):
+        def body(s, k):
+            return router_step.__wrapped__(s, spec, flow_dst, k, k_slots,
+                                           k_fwd, dt), None
+
+        s, _ = jax.lax.scan(body, rs, keys)
+        return s
+
+    return _run(rs, keys, k_slots, k_fwd)

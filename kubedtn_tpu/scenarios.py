@@ -1,0 +1,194 @@
+"""The BASELINE scenario ladder as runnable functions.
+
+Each rung of /root/repo/BASELINE.json's `configs` list is one function
+returning a result dict. The CLI (`python -m kubedtn_tpu.cli scenario ...`)
+and the test suite call these; bench.py's headline metric is rung 5's
+update path measured standalone.
+
+1. three_node      — the reference's 3-node sample, CNI + reconcile + ping
+2. fat_tree_64     — 64-node-scale fat-tree (k=8) with static shaping
+3. churn_1k        — 1k-node random mesh, 10%/sec UpdateLinks churn
+4. routes_10k      — shortest-path recompute on link up/down events
+5. clos_100k       — 100k-link Clos with loss+jitter and packet queues
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubedtn_tpu.api.types import LinkProperties, load_yaml
+from kubedtn_tpu.models import topologies as T
+from kubedtn_tpu.models.traffic import cbr_everywhere
+from kubedtn_tpu.ops import edge_state as es
+from kubedtn_tpu.ops import routing as R
+from kubedtn_tpu import sim as S
+from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+
+
+def three_node(yaml_path: str = "/root/reference/config/samples/3node.yml"):
+    """Rung 1: the reference's own sample through the full control plane."""
+    t0 = time.perf_counter()
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=64)
+    topos = load_yaml(yaml_path)
+    for t in topos:
+        store.create(t)
+    for t in topos:
+        engine.setup_pod(t.name, t.namespace)
+    rec = Reconciler(store, engine)
+    rec.drain()
+    pings = {}
+    uids = sorted({l.uid for t in topos for l in t.spec.links})
+    pairs = {}
+    for t in topos:
+        for l in t.spec.links:
+            pairs.setdefault(l.uid, (t.name, l.peer_pod))
+    for uid in uids:
+        a, b = pairs[uid]
+        pings[f"{a}<->{b}"] = engine.ping(a, b, uid)
+    return {
+        "scenario": "3node",
+        "links": engine.num_active // 2,
+        "reachable": all(p["reachable"] for p in pings.values()),
+        "pings": {k: v["rtt_us"] for k, v in pings.items()},
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def fat_tree_64(k: int = 8, steps: int = 200, dt_us: float = 1000.0):
+    """Rung 2: k=8 fat-tree (80 switches), static latency+bw shaping,
+    CBR traffic on every link."""
+    t0 = time.perf_counter()
+    el = T.fat_tree(k, LinkProperties(latency="50us", rate="10Gbit"))
+    state, rows = T.load_edge_list_into_state(el)
+    sim = S.init_sim(state)
+    spec = cbr_everywhere(state.capacity, len(rows), rate_bps=1e9)
+    sim = S.run(sim, spec, steps=steps, dt_us=dt_us, k_slots=8)
+    c = sim.counters
+    return {
+        "scenario": "fat_tree_64",
+        "nodes": el.n_nodes,
+        "links": el.n_links,
+        "sim_time_s": steps * dt_us / 1e6,
+        "tx_packets": float(np.asarray(c.tx_packets).sum()),
+        "rx_packets": float(np.asarray(c.rx_packets).sum()),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def churn_1k(n_nodes: int = 1000, n_links: int = 3000,
+             churn_frac_per_s: float = 0.10, seconds: float = 5.0):
+    """Rung 3: 1k-node random mesh with 10%-of-links-per-second property
+    churn through the batched UpdateLinks path."""
+    t0 = time.perf_counter()
+    el = T.random_mesh(n_nodes, n_links, seed=7,
+                       props=LinkProperties(latency="1ms"))
+    state, rows = T.load_edge_list_into_state(el)
+    rng = np.random.default_rng(0)
+    batch = int(n_links * churn_frac_per_s)
+    n_batches = int(seconds)
+    lat_choices = np.array([1_000, 5_000, 10_000, 50_000], np.float32)
+    # warmup: compile the update shape once before timing
+    wp = np.zeros((batch, es.NPROP), np.float32)
+    state = es.update_links(state, jnp.arange(batch, dtype=jnp.int32),
+                            jnp.asarray(wp), jnp.zeros(batch, dtype=bool))
+    jax.block_until_ready(state)
+    # pipelined dispatch: enqueue every churn batch, sync once — per-call
+    # blocking would pay the full host↔device round trip each batch
+    tb = time.perf_counter()
+    for i in range(n_batches):
+        pick = rng.choice(n_links, batch, replace=False).astype(np.int32)
+        props = np.zeros((batch, es.NPROP), np.float32)
+        props[:, es.P_LATENCY_US] = rng.choice(lat_choices, batch)
+        state = es.update_links(state, jnp.asarray(pick),
+                                jnp.asarray(props),
+                                jnp.ones(batch, dtype=bool))
+    jax.block_until_ready(state)
+    upd_time = time.perf_counter() - tb
+    return {
+        "scenario": "churn_1k",
+        "nodes": n_nodes,
+        "links": n_links,
+        "churn_links_total": batch * n_batches,
+        "updates_per_sec": round(batch * n_batches / upd_time, 1),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def routes_10k(n_nodes: int = 10_000, n_links: int = 30_000,
+               events: int = 3, dst_chunk: int = 1000):
+    """Rung 4: 10k-node shortest-path recompute on link up/down events —
+    the BGP-convergence analogue as batched device min-plus relaxation."""
+    t0 = time.perf_counter()
+    el = T.random_mesh(n_nodes, n_links, seed=11,
+                       props=LinkProperties(latency="1ms"))
+    state, rows = T.load_edge_list_into_state(el)
+    recompute_times = []
+    rng = np.random.default_rng(1)
+    for i in range(events):
+        # link event: take a random link down (both directions)
+        pick = int(rng.integers(0, el.n_links))
+        state = es.delete_links(
+            state, jnp.array([pick, pick + el.n_links], jnp.int32),
+            jnp.ones(2, dtype=bool))
+        tb = time.perf_counter()
+        dist, nh = R.recompute_routes(state, n_nodes, max_hops=12,
+                                      dst_chunk=dst_chunk)
+        jax.block_until_ready((dist, nh))
+        recompute_times.append(time.perf_counter() - tb)
+    finite = float(np.isfinite(np.asarray(dist)).mean())
+    return {
+        "scenario": "routes_10k",
+        "nodes": n_nodes,
+        "links": n_links,
+        "recompute_s_first": round(recompute_times[0], 3),
+        "recompute_s_steady": round(float(np.mean(recompute_times[1:])), 3)
+        if len(recompute_times) > 1 else None,
+        "reachable_frac": round(finite, 4),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def clos_100k(steps: int = 50, dt_us: float = 1000.0):
+    """Rung 5: 100k-link Clos with per-link loss+jitter and packet queues
+    — the full data plane at BASELINE scale."""
+    t0 = time.perf_counter()
+    el = T.clos(100, 500, 0,
+                props=LinkProperties(latency="10us", jitter="5us",
+                                     loss="0.01", rate="100Gbit"),
+                links_per_pair=2)
+    state, rows = T.load_edge_list_into_state(el)
+    sim = S.init_sim(state, q=8)
+    spec = cbr_everywhere(state.capacity, len(rows), rate_bps=1e9)
+    tb = time.perf_counter()
+    sim = S.run(sim, spec, steps=steps, dt_us=dt_us, k_slots=2)
+    jax.block_until_ready(sim.counters.rx_packets)
+    step_time = time.perf_counter() - tb
+    c = sim.counters
+    tx = float(np.asarray(c.tx_packets).sum())
+    rx = float(np.asarray(c.rx_packets).sum())
+    lost = float(np.asarray(c.dropped_loss).sum())
+    return {
+        "scenario": "clos_100k",
+        "links": el.n_links,
+        "directed_edges": 2 * el.n_links,
+        "sim_time_s": steps * dt_us / 1e6,
+        "tx_packets": tx,
+        "rx_packets": rx,
+        "loss_rate": round(lost / max(tx, 1), 6),
+        "packet_events_per_sec": round(tx / step_time, 1),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+LADDER = {
+    "3node": three_node,
+    "fat_tree_64": fat_tree_64,
+    "churn_1k": churn_1k,
+    "routes_10k": routes_10k,
+    "clos_100k": clos_100k,
+}

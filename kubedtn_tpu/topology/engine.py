@@ -29,6 +29,8 @@ scatters compile O(log n) distinct shapes, never per batch.
 
 from __future__ import annotations
 
+import functools
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -57,6 +59,17 @@ def uid_from_vni(vni: int) -> int:
     return vni - VXLAN_BASE
 
 
+def _locked(fn):
+    """Serialize a public engine method on the engine lock."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
+
+
 def _next_pow2(n: int, floor: int = 8) -> int:
     p = floor
     while p < n:
@@ -66,14 +79,21 @@ def _next_pow2(n: int, floor: int = 8) -> int:
 
 @dataclass
 class EngineStats:
+    """Per-op latency records — feeds the parity histogram
+    kubedtnd_request_duration_milliseconds (reference
+    daemon/metrics/latency_histograms.go:5-30)."""
+
     adds: int = 0
     dels: int = 0
     updates: int = 0
     device_calls: int = 0
     op_ms: dict[str, list[float]] = field(default_factory=dict)
+    observer: object = None  # optional LatencyHistograms
 
     def observe(self, method: str, ms: float) -> None:
         self.op_ms.setdefault(method, []).append(ms)
+        if self.observer is not None:
+            self.observer.observe(method, ms)
 
 
 class SimEngine:
@@ -81,6 +101,12 @@ class SimEngine:
 
     def __init__(self, store: TopologyStore, capacity: int = 1024,
                  node_ip: str = "10.0.0.1") -> None:
+        # One engine serves a 16-thread gRPC pool; all state mutation is
+        # serialized here (the reference daemon locks per link uid —
+        # common/utils.go:21-26 — but its state lives in the kernel; ours
+        # is a single device-array pytree, so a coarse lock is the correct
+        # unit).
+        self._lock = threading.RLock()
         self.store = store
         self.node_ip = node_ip  # the daemon's HOST_IP equivalent
         self.state = es.init_state(capacity)
@@ -88,11 +114,13 @@ class SimEngine:
         # host-side registries (the daemon's managers):
         self._pod_ids: dict[str, int] = {}   # endpoint name -> node index
         self._rows: dict[tuple[str, int], int] = {}  # (pod_key, uid) -> row
+        self._peer: dict[tuple[str, int], tuple[str, int]] = {}
         self._free: list[int] = list(range(capacity - 1, -1, -1))
         self._topology_manager: set[str] = set()  # alive pods (metrics/TopologyManager)
 
     # -- registries ----------------------------------------------------
 
+    @_locked
     def pod_id(self, endpoint: str) -> int:
         """Stable integer id for any endpoint name (pod key, "localhost",
         "physical/<ip>")."""
@@ -102,6 +130,13 @@ class SimEngine:
 
     def row_of(self, pod_key: str, uid: int) -> int | None:
         return self._rows.get((pod_key, uid))
+
+    def reverse_row(self, pod_key: str, uid: int) -> int | None:
+        """Row of the opposite direction of this p2p link, if realized."""
+        peer = self._peer.get((pod_key, uid))
+        if peer is None:
+            return None
+        return self._rows.get(peer)
 
     @property
     def num_active(self) -> int:
@@ -173,6 +208,7 @@ class SimEngine:
         """Local.Get equivalent (handler.go:50-60)."""
         return self.store.get(ns or "default", name)
 
+    @_locked
     def set_alive(self, name: str, ns: str, src_ip: str, net_ns: str) -> bool:
         """Local.SetAlive equivalent (handler.go:90-147): write placement
         into status, manage the finalizer, register with the topology
@@ -195,7 +231,11 @@ class SimEngine:
                 if GROUP_VERSION not in topo.finalizers:
                     topo.finalizers.append(GROUP_VERSION)
             else:
-                topo.finalizers = []
+                # remove only our own finalizer — foreign holders keep the
+                # object alive (the reference removes just its entry,
+                # handler.go:125-140)
+                topo.finalizers = [f for f in topo.finalizers
+                                   if f != GROUP_VERSION]
             self.store.update(topo)
 
         retry_on_conflict(txn_meta)
@@ -207,6 +247,7 @@ class SimEngine:
             self._topology_manager.discard(key)
         return True
 
+    @_locked
     def setup_pod(self, name: str, ns: str = "default",
                   net_ns: str = "") -> bool:
         """Local.SetupPod equivalent (handler.go:495-535)."""
@@ -222,6 +263,7 @@ class SimEngine:
         self.stats.observe("setup", (time.perf_counter() - t0) * 1e3)
         return True
 
+    @_locked
     def destroy_pod(self, name: str, ns: str = "default") -> bool:
         """Local.DestroyPod equivalent (handler.go:538-590)."""
         key = f"{ns or 'default'}/{name}"
@@ -247,6 +289,7 @@ class SimEngine:
             return False
         return topo.is_alive()
 
+    @_locked
     def add_links(self, topo: Topology, links: list[Link]) -> bool:
         """Local.AddLinks equivalent: the reference's per-link dispatch
         (handler.go:316-459) collapsed into one batched device op."""
@@ -298,11 +341,14 @@ class SimEngine:
             prow = self._alloc(peer_key, link.uid)
             entries.append((prow, link.uid, self.pod_id(peer_key),
                             self.pod_id(local_key), props))
+            self._peer[(local_key, link.uid)] = (peer_key, link.uid)
+            self._peer[(peer_key, link.uid)] = (local_key, link.uid)
         self._apply_rows(entries)
         self.stats.adds += len(entries)
         self.stats.observe("add", (time.perf_counter() - t0) * 1e3)
         return True
 
+    @_locked
     def del_links(self, topo: Topology, links: list[Link]) -> bool:
         """Local.DelLinks equivalent (handler.go:461-492, 613-632).
 
@@ -314,12 +360,14 @@ class SimEngine:
         rows: list[int] = []
         for link in links:
             row = self._rows.pop((local_key, link.uid), None)
+            self._peer.pop((local_key, link.uid), None)
             if row is not None:
                 rows.append(row)
                 self._free.append(row)
             if not (link.is_macvlan() or link.is_physical()):
                 peer_key = f"{topo.namespace}/{link.peer_pod}"
                 prow = self._rows.pop((peer_key, link.uid), None)
+                self._peer.pop((peer_key, link.uid), None)
                 if prow is not None:
                     rows.append(prow)
                     self._free.append(prow)
@@ -328,6 +376,7 @@ class SimEngine:
         self.stats.observe("del", (time.perf_counter() - t0) * 1e3)
         return True
 
+    @_locked
     def update_links(self, topo: Topology, links: list[Link]) -> bool:
         """Local.UpdateLinks equivalent (handler.go:634-671): rebuild only
         the LOCAL end's shaping, leaving the peer direction untouched."""
@@ -343,6 +392,24 @@ class SimEngine:
         self._update_rows(entries)
         self.stats.updates += len(entries)
         self.stats.observe("update", (time.perf_counter() - t0) * 1e3)
+        return True
+
+    @_locked
+    def remote_update(self, name: str, ns: str, uid: int, intf_name: str,
+                      intf_ip: str, peer_vtep: str, props) -> bool:
+        """Remote.Update equivalent (reference handler.go:149-198): a peer
+        daemon asks us to realize our end of a cross-node link, identified
+        by VNI→uid. The far end is the peer's VTEP, not a local pod."""
+        del intf_name, intf_ip  # interface identity lives in the CR spec
+        t0 = time.perf_counter()
+        pod_key = f"{ns or 'default'}/{name}"
+        self._ensure_capacity(1)
+        row = self._alloc(pod_key, uid)
+        entry = (row, uid, self.pod_id(pod_key),
+                 self.pod_id(f"vtep/{peer_vtep}"),
+                 np.asarray(es.props_row(props.to_numeric())))
+        self._apply_rows([entry])
+        self.stats.observe("remoteUpdate", (time.perf_counter() - t0) * 1e3)
         return True
 
     def _alloc(self, pod_key: str, uid: int) -> int:
@@ -368,6 +435,7 @@ class SimEngine:
             **{name: float(props[i]) for i, name in enumerate(es.PROP_NAMES)},
         }
 
+    @_locked
     def ping(self, a: str, b: str, uid: int, size_bytes: float = 84.0,
              ns: str = "default", seed: int = 0) -> dict:
         """Ping-equivalent probe: push one ICMP-sized packet each way

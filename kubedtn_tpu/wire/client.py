@@ -1,0 +1,37 @@
+"""gRPC client for the daemon — the role the reference controller and CNI
+plugin play against port 51111 (reference
+controllers/topology_controller.go:320-329, plugin/kube_dtn.go:80-87)."""
+
+from __future__ import annotations
+
+import grpc
+
+from kubedtn_tpu.wire import proto as pb
+
+
+class DaemonClient:
+    def __init__(self, address: str) -> None:
+        self._channel = grpc.insecure_channel(address)
+        self._calls = {}
+        for service, methods in [("Local", pb.LOCAL_METHODS),
+                                 ("Remote", pb.REMOTE_METHODS),
+                                 ("WireProtocol", pb.WIRE_METHODS)]:
+            for m, (req, resp, streaming) in methods.items():
+                path = f"/{pb.PACKAGE}.{service}/{m}"
+                if streaming:
+                    self._calls[m] = self._channel.stream_unary(
+                        path, request_serializer=req.SerializeToString,
+                        response_deserializer=resp.FromString)
+                else:
+                    self._calls[m] = self._channel.unary_unary(
+                        path, request_serializer=req.SerializeToString,
+                        response_deserializer=resp.FromString)
+
+    def __getattr__(self, name):
+        try:
+            return self._calls[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def close(self) -> None:
+        self._channel.close()

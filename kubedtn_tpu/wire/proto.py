@@ -1,0 +1,219 @@
+"""Wire-protocol schema, built programmatically (no codegen toolchain).
+
+Message and service shapes are wire-compatible with the reference's IDL
+(reference proto/v1/kube_dtn.proto): same proto package (`proto.v1`), same
+message names, field names and field numbers, and the same three services —
+`Local` (pod/link lifecycle), `Remote` (peer-daemon updates), and
+`WireProtocol` (per-frame tunnel). A client built against the reference's
+generated stubs can talk to this server unmodified.
+
+Instead of shipping a .proto file through protoc, the FileDescriptorProto is
+constructed in Python and message classes come from
+google.protobuf.message_factory — one less build step, same bytes on the
+wire.
+"""
+
+from __future__ import annotations
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+_T = descriptor_pb2.FieldDescriptorProto
+
+PACKAGE = "proto.v1"
+
+
+def _field(name: str, number: int, ftype, label=None, type_name: str = ""):
+    f = _T(name=name, number=number, type=ftype,
+           label=label or _T.LABEL_OPTIONAL)
+    if type_name:
+        f.type_name = f".{PACKAGE}.{type_name}"
+        f.type = _T.TYPE_MESSAGE
+    return f
+
+
+def _msg(name: str, *fields) -> descriptor_pb2.DescriptorProto:
+    m = descriptor_pb2.DescriptorProto(name=name)
+    m.field.extend(fields)
+    return m
+
+
+def _build_file() -> descriptor_pb2.FileDescriptorProto:
+    f = descriptor_pb2.FileDescriptorProto(
+        name="kubedtn_tpu/wire/kube_dtn_dynamic.proto",
+        package=PACKAGE,
+        syntax="proto3",
+    )
+
+    S, I64, I32, U32, B, BY = (_T.TYPE_STRING, _T.TYPE_INT64, _T.TYPE_INT32,
+                               _T.TYPE_UINT32, _T.TYPE_BOOL, _T.TYPE_BYTES)
+    REP = _T.LABEL_REPEATED
+
+    f.message_type.append(_msg(
+        "LinkProperties",
+        _field("latency", 1, S), _field("latency_corr", 2, S),
+        _field("jitter", 3, S), _field("loss", 4, S),
+        _field("loss_corr", 5, S), _field("rate", 6, S),
+        _field("gap", 7, U32), _field("duplicate", 8, S),
+        _field("duplicate_corr", 9, S), _field("reorder_prob", 10, S),
+        _field("reorder_corr", 11, S), _field("corrupt_prob", 12, S),
+        _field("corrupt_corr", 13, S),
+    ))
+    f.message_type.append(_msg(
+        "Link",
+        _field("peer_pod", 1, S), _field("local_intf", 2, S),
+        _field("peer_intf", 3, S), _field("local_ip", 4, S),
+        _field("peer_ip", 5, S), _field("uid", 6, I64),
+        _field("properties", 7, None, type_name="LinkProperties"),
+        _field("local_mac", 8, S), _field("peer_mac", 9, S),
+    ))
+    f.message_type.append(_msg(
+        "Pod",
+        _field("name", 1, S), _field("src_ip", 2, S),
+        _field("net_ns", 3, S), _field("kube_ns", 4, S),
+        _field("links", 5, None, REP, type_name="Link"),
+    ))
+    f.message_type.append(_msg(
+        "PodQuery", _field("name", 1, S), _field("kube_ns", 2, S)))
+    f.message_type.append(_msg(
+        "LinksBatchQuery",
+        _field("local_pod", 1, None, type_name="Pod"),
+        _field("links", 2, None, REP, type_name="Link"),
+    ))
+    f.message_type.append(_msg(
+        "SetupPodQuery",
+        _field("name", 1, S), _field("kube_ns", 2, S),
+        _field("net_ns", 3, S),
+    ))
+    f.message_type.append(_msg("BoolResponse", _field("response", 1, B)))
+    f.message_type.append(_msg(
+        "RemotePod",
+        _field("net_ns", 1, S), _field("intf_name", 2, S),
+        _field("intf_ip", 3, S), _field("peer_vtep", 4, S),
+        _field("kube_ns", 5, S), _field("vni", 6, I32),
+        _field("properties", 7, None, type_name="LinkProperties"),
+        _field("name", 8, S),
+    ))
+    f.message_type.append(_msg(
+        "WireDef",
+        _field("peer_intf_id", 1, I64), _field("peer_ip", 2, S),
+        _field("intf_name_in_pod", 3, S),
+        _field("local_pod_net_ns", 4, S),
+        _field("link_uid", 5, I64), _field("local_pod_name", 6, S),
+        _field("veth_name_local_host", 7, S), _field("kube_ns", 8, S),
+        _field("local_pod_ip", 9, S),
+    ))
+    f.message_type.append(_msg(
+        "WireCreateResponse",
+        _field("response", 1, B), _field("peer_intf_id", 2, I64)))
+    f.message_type.append(_msg(
+        "Packet",
+        _field("remot_intf_id", 1, I64), _field("frame", 2, BY)))
+    f.message_type.append(_msg(
+        "GenerateNodeInterfaceNameRequest",
+        _field("pod_intf_name", 1, S), _field("pod_name", 2, S)))
+    f.message_type.append(_msg(
+        "GenerateNodeInterfaceNameResponse",
+        _field("ok", 1, B), _field("node_intf_name", 2, S)))
+    return f
+
+
+_pool = descriptor_pool.DescriptorPool()
+_file_desc = _pool.Add(_build_file())
+
+_MESSAGES = {}
+for _name in ("LinkProperties", "Link", "Pod", "PodQuery",
+              "LinksBatchQuery", "SetupPodQuery", "BoolResponse",
+              "RemotePod", "WireDef", "WireCreateResponse", "Packet",
+              "GenerateNodeInterfaceNameRequest",
+              "GenerateNodeInterfaceNameResponse"):
+    _MESSAGES[_name] = message_factory.GetMessageClass(
+        _pool.FindMessageTypeByName(f"{PACKAGE}.{_name}"))
+
+LinkProperties = _MESSAGES["LinkProperties"]
+Link = _MESSAGES["Link"]
+Pod = _MESSAGES["Pod"]
+PodQuery = _MESSAGES["PodQuery"]
+LinksBatchQuery = _MESSAGES["LinksBatchQuery"]
+SetupPodQuery = _MESSAGES["SetupPodQuery"]
+BoolResponse = _MESSAGES["BoolResponse"]
+RemotePod = _MESSAGES["RemotePod"]
+WireDef = _MESSAGES["WireDef"]
+WireCreateResponse = _MESSAGES["WireCreateResponse"]
+Packet = _MESSAGES["Packet"]
+GenerateNodeInterfaceNameRequest = _MESSAGES[
+    "GenerateNodeInterfaceNameRequest"]
+GenerateNodeInterfaceNameResponse = _MESSAGES[
+    "GenerateNodeInterfaceNameResponse"]
+
+# Service method tables: name -> (request class, response class, streaming)
+LOCAL_METHODS = {
+    "Get": (PodQuery, Pod, False),
+    "SetAlive": (Pod, BoolResponse, False),
+    "AddLinks": (LinksBatchQuery, BoolResponse, False),
+    "DelLinks": (LinksBatchQuery, BoolResponse, False),
+    "UpdateLinks": (LinksBatchQuery, BoolResponse, False),
+    "SetupPod": (SetupPodQuery, BoolResponse, False),
+    "DestroyPod": (PodQuery, BoolResponse, False),
+    "GRPCWireExists": (WireDef, WireCreateResponse, False),
+    "AddGRPCWireLocal": (WireDef, BoolResponse, False),
+    "RemGRPCWire": (WireDef, BoolResponse, False),
+    "GenerateNodeInterfaceName": (GenerateNodeInterfaceNameRequest,
+                                  GenerateNodeInterfaceNameResponse, False),
+}
+REMOTE_METHODS = {
+    "Update": (RemotePod, BoolResponse, False),
+    "AddGRPCWireRemote": (WireDef, WireCreateResponse, False),
+}
+WIRE_METHODS = {
+    "SendToOnce": (Packet, BoolResponse, False),
+    "SendToStream": (Packet, BoolResponse, True),  # client-streaming
+}
+
+
+# -- conversions to/from the framework's native types ------------------
+
+def link_from_proto(msg) -> "object":
+    from kubedtn_tpu.api import types as api
+
+    return api.Link(
+        local_intf=msg.local_intf,
+        peer_intf=msg.peer_intf,
+        peer_pod=msg.peer_pod,
+        uid=int(msg.uid),
+        local_ip=msg.local_ip,
+        peer_ip=msg.peer_ip,
+        local_mac=msg.local_mac,
+        peer_mac=msg.peer_mac,
+        properties=props_from_proto(msg.properties),
+    )
+
+
+def props_from_proto(p) -> "object":
+    from kubedtn_tpu.api import types as api
+
+    return api.LinkProperties(
+        latency=p.latency, latency_corr=p.latency_corr, jitter=p.jitter,
+        loss=p.loss, loss_corr=p.loss_corr, rate=p.rate, gap=int(p.gap),
+        duplicate=p.duplicate, duplicate_corr=p.duplicate_corr,
+        reorder_prob=p.reorder_prob, reorder_corr=p.reorder_corr,
+        corrupt_prob=p.corrupt_prob, corrupt_corr=p.corrupt_corr,
+    )
+
+
+def link_to_proto(link) -> "Link":
+    return Link(
+        peer_pod=link.peer_pod, local_intf=link.local_intf,
+        peer_intf=link.peer_intf, local_ip=link.local_ip,
+        peer_ip=link.peer_ip, uid=link.uid, local_mac=link.local_mac,
+        peer_mac=link.peer_mac, properties=props_to_proto(link.properties),
+    )
+
+
+def props_to_proto(p) -> "LinkProperties":
+    return LinkProperties(
+        latency=p.latency, latency_corr=p.latency_corr, jitter=p.jitter,
+        loss=p.loss, loss_corr=p.loss_corr, rate=p.rate, gap=p.gap,
+        duplicate=p.duplicate, duplicate_corr=p.duplicate_corr,
+        reorder_prob=p.reorder_prob, reorder_corr=p.reorder_corr,
+        corrupt_prob=p.corrupt_prob, corrupt_corr=p.corrupt_corr,
+    )

@@ -1,0 +1,292 @@
+"""The daemon: gRPC services in front of the SimEngine.
+
+Plays the role of the reference's per-node daemon process (reference
+daemon/main.go, daemon/kubedtn/) for clients speaking its exact wire
+protocol on the same default port 51111: `Local` (CNI plugin + controller
+surface), `Remote` (peer daemons), `WireProtocol` (per-frame tunnel).
+Requests become engine calls; the "kernel plumbing" they used to trigger is
+device-array state.
+
+The grpc-wire capability — attach an external packet source/sink to a
+simulated link (reference daemon/grpcwire/grpcwire.go) — is the sim's
+ingress/egress: frames sent via SendToOnce/SendToStream queue onto their
+wire's edge row for the next sim step; frames the sim delivers to a wire
+queue for pickup. SendToStream is fully implemented here (the reference
+declares it but never implements it — kube_dtn.proto:171).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from concurrent import futures
+from dataclasses import dataclass, field
+
+import grpc
+
+from kubedtn_tpu.topology.engine import SimEngine, uid_from_vni
+from kubedtn_tpu.topology.store import NotFoundError
+from kubedtn_tpu.wire import proto as pb
+
+DEFAULT_PORT = 51111  # reference common/constants.go:9
+
+
+@dataclass
+class Wire:
+    """One attachment of an external endpoint to a simulated link end."""
+
+    wire_id: int
+    uid: int
+    pod_key: str
+    node_iface_name: str
+    peer_intf_id: int = 0
+    peer_ip: str = ""
+    ingress: deque = field(default_factory=deque)  # frames awaiting the sim
+    egress: deque = field(default_factory=deque)   # frames the sim delivered
+
+
+class WireManager:
+    """Registry of wires, indexed like the reference's wireMap
+    (grpcwire.go:100-158): by (netns, uid) for lookups and by interface id
+    for O(1) per-packet dispatch."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next_index = 0
+        self._next_wire_id = 1000
+        self._by_id: dict[int, Wire] = {}
+        self._by_key: dict[tuple[str, int], Wire] = {}
+
+    def next_wire_id(self) -> int:
+        with self._lock:
+            self._next_wire_id += 1
+            return self._next_wire_id
+
+    def gen_node_iface_name(self, pod_name: str, pod_intf: str) -> str:
+        """Unique per-node interface name, reference format
+        "%.5s%.5s-%04d" (grpcwire.go:270-288)."""
+        with self._lock:
+            self._next_index += 1
+            return f"{pod_name[:5]}{pod_intf[:5]}-{self._next_index:04d}"
+
+    def add(self, wire: Wire) -> None:
+        with self._lock:
+            self._by_id[wire.wire_id] = wire
+            self._by_key[(wire.pod_key, wire.uid)] = wire
+
+    def get_by_id(self, wire_id: int) -> Wire | None:
+        return self._by_id.get(wire_id)
+
+    def get_by_key(self, pod_key: str, uid: int) -> Wire | None:
+        return self._by_key.get((pod_key, uid))
+
+    def delete_by_pod(self, pod_key: str) -> int:
+        with self._lock:
+            doomed = [w for w in self._by_id.values()
+                      if w.pod_key == pod_key]
+            for w in doomed:
+                self._by_id.pop(w.wire_id, None)
+                self._by_key.pop((w.pod_key, w.uid), None)
+            return len(doomed)
+
+    def all(self) -> list[Wire]:
+        return list(self._by_id.values())
+
+
+class Daemon:
+    """Service implementations bound to one engine."""
+
+    def __init__(self, engine: SimEngine, latency_histograms=None) -> None:
+        self.engine = engine
+        self.wires = WireManager()
+        self.hist = latency_histograms
+
+    # -- Local ---------------------------------------------------------
+
+    def Get(self, request, context):
+        try:
+            topo = self.engine.get_pod(request.name, request.kube_ns)
+        except NotFoundError:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"pod {request.name} not found")
+        return pb.Pod(
+            name=topo.name,
+            src_ip=topo.status.src_ip,
+            net_ns=topo.status.net_ns,
+            kube_ns=topo.namespace,
+            links=[pb.link_to_proto(l) for l in topo.spec.links],
+        )
+
+    def SetAlive(self, request, context):
+        ok = self.engine.set_alive(request.name, request.kube_ns or "default",
+                                   request.src_ip, request.net_ns)
+        return pb.BoolResponse(response=ok)
+
+    def _batch(self, request, fn):
+        try:
+            topo = self.engine.get_pod(request.local_pod.name,
+                                       request.local_pod.kube_ns)
+        except NotFoundError:
+            return pb.BoolResponse(response=False)
+        links = [pb.link_from_proto(l) for l in request.links]
+        return pb.BoolResponse(response=fn(topo, links))
+
+    def AddLinks(self, request, context):
+        return self._batch(request, self.engine.add_links)
+
+    def DelLinks(self, request, context):
+        return self._batch(request, self.engine.del_links)
+
+    def UpdateLinks(self, request, context):
+        return self._batch(request, self.engine.update_links)
+
+    def SetupPod(self, request, context):
+        ok = self.engine.setup_pod(request.name, request.kube_ns or "default",
+                                   request.net_ns)
+        return pb.BoolResponse(response=ok)
+
+    def DestroyPod(self, request, context):
+        pod_key = f"{request.kube_ns or 'default'}/{request.name}"
+        self.wires.delete_by_pod(pod_key)
+        ok = self.engine.destroy_pod(request.name,
+                                     request.kube_ns or "default")
+        return pb.BoolResponse(response=ok)
+
+    def GRPCWireExists(self, request, context):
+        pod_key = f"{request.kube_ns or 'default'}/{request.local_pod_name}"
+        wire = self.wires.get_by_key(pod_key, int(request.link_uid))
+        if wire is None:
+            return pb.WireCreateResponse(response=False,
+                                         peer_intf_id=request.peer_intf_id)
+        return pb.WireCreateResponse(response=True,
+                                     peer_intf_id=wire.peer_intf_id)
+
+    def AddGRPCWireLocal(self, request, context):
+        self._add_wire(request)
+        return pb.BoolResponse(response=True)
+
+    def RemGRPCWire(self, request, context):
+        pod_key = f"{request.kube_ns or 'default'}/{request.local_pod_name}"
+        self.wires.delete_by_pod(pod_key)
+        return pb.BoolResponse(response=True)
+
+    def GenerateNodeInterfaceName(self, request, context):
+        name = self.wires.gen_node_iface_name(request.pod_name,
+                                              request.pod_intf_name)
+        return pb.GenerateNodeInterfaceNameResponse(ok=True,
+                                                    node_intf_name=name)
+
+    # -- Remote --------------------------------------------------------
+
+    def Update(self, request, context):
+        """Peer-daemon link completion (reference handler.go:149-198):
+        realize this end of a cross-node link from its VNI."""
+        uid = uid_from_vni(request.vni)
+        ok = self.engine.remote_update(
+            name=request.name, ns=request.kube_ns or "default", uid=uid,
+            intf_name=request.intf_name, intf_ip=request.intf_ip,
+            peer_vtep=request.peer_vtep,
+            props=pb.props_from_proto(request.properties),
+        )
+        return pb.BoolResponse(response=ok)
+
+    def AddGRPCWireRemote(self, request, context):
+        wire = self._add_wire(request)
+        return pb.WireCreateResponse(response=True,
+                                     peer_intf_id=wire.wire_id)
+
+    def _add_wire(self, wd) -> Wire:
+        pod_key = f"{wd.kube_ns or 'default'}/{wd.local_pod_name}"
+        name = wd.veth_name_local_host or self.wires.gen_node_iface_name(
+            wd.local_pod_name, wd.intf_name_in_pod)
+        wire = Wire(
+            wire_id=self.wires.next_wire_id(),
+            uid=int(wd.link_uid),
+            pod_key=pod_key,
+            node_iface_name=name,
+            peer_intf_id=int(wd.peer_intf_id),
+            peer_ip=wd.peer_ip,
+        )
+        self.wires.add(wire)
+        return wire
+
+    # -- WireProtocol --------------------------------------------------
+
+    def SendToOnce(self, request, context):
+        wire = self.wires.get_by_id(int(request.remot_intf_id))
+        if wire is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no wire {request.remot_intf_id}")
+        wire.ingress.append(bytes(request.frame))
+        return pb.BoolResponse(response=True)
+
+    def SendToStream(self, request_iterator, context):
+        """Client-streaming frame ingestion — implemented (the reference
+        never implements this RPC; kube_dtn.proto:171)."""
+        n = 0
+        for pkt in request_iterator:
+            wire = self.wires.get_by_id(int(pkt.remot_intf_id))
+            if wire is not None:
+                wire.ingress.append(bytes(pkt.frame))
+                n += 1
+        return pb.BoolResponse(response=n > 0)
+
+    # -- sim ingress/egress bridge ------------------------------------
+
+    def drain_ingress(self, max_per_wire: int = 64):
+        """Collect queued external frames as (row, sizes) batches for the
+        next sim step."""
+        out = []
+        for wire in self.wires.all():
+            row = self.engine.row_of(wire.pod_key, wire.uid)
+            if row is None:
+                continue
+            frames = []
+            while wire.ingress and len(frames) < max_per_wire:
+                frames.append(wire.ingress.popleft())
+            if frames:
+                out.append((row, [len(f) for f in frames], frames))
+        return out
+
+    def deliver_egress(self, pod_key: str, uid: int, frame: bytes) -> bool:
+        wire = self.wires.get_by_key(pod_key, uid)
+        if wire is None:
+            return False
+        wire.egress.append(frame)
+        return True
+
+
+def _handler(fn, req_cls, resp_cls, streaming: bool):
+    if streaming:
+        return grpc.stream_unary_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+    return grpc.unary_unary_rpc_method_handler(
+        fn,
+        request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString,
+    )
+
+
+def make_server(daemon: Daemon, port: int = DEFAULT_PORT,
+                max_workers: int = 16) -> tuple[grpc.Server, int]:
+    """Build the gRPC server with the three reference services."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    tables = [
+        ("Local", pb.LOCAL_METHODS),
+        ("Remote", pb.REMOTE_METHODS),
+        ("WireProtocol", pb.WIRE_METHODS),
+    ]
+    for service, methods in tables:
+        handlers = {
+            m: _handler(getattr(daemon, m), req, resp, streaming)
+            for m, (req, resp, streaming) in methods.items()
+        }
+        server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(
+                f"{pb.PACKAGE}.{service}", handlers),
+        ))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    return server, bound

@@ -56,7 +56,6 @@ def test_to_topologies_roundtrip_validates():
     assert n["rate_bps"] == 1_000_000_000
     # every uid appears exactly twice (once per endpoint view)
     uids = [l.uid for t in topos for l in t.spec.links]
-    assert sorted(set(uids)) == sorted(uids)[::2][: len(set(uids))] or True
     from collections import Counter
     assert all(c == 2 for c in Counter(uids).values())
 
