@@ -1,0 +1,189 @@
+"""Checkpoint / resume + elastic recovery.
+
+The reference has no snapshot files: its durable state is the Topology CR
+(Status.Links = last applied, Status.SrcIP/NetNs = placement) plus the
+kernel devices themselves, and crash recovery is *reconstruction* — a
+restarted daemon re-lists topologies and rescans pod netnses to rebuild its
+managers (reference daemon/kubedtn/kubedtn.go:107-121,
+daemon/vxlan/manager.go:25-55; SURVEY.md §5.3-5.4). This module provides
+both halves for the TPU build:
+
+- `rebuild_engine` — the reconstruction path: given only the store (the CR
+  source of truth), re-derive the whole device-array realization, exactly
+  like a daemon restart. Device arrays are rebuildable projections.
+- `save` / `load` — a real checkpoint: store contents + engine registries
+  as JSON, device arrays as npz. Restoring short-circuits reconstruction
+  (no re-plumbing) and preserves mutable shaping state (token buckets,
+  correlation memory, counters) that reconstruction would reset — the same
+  distinction as kernel qdiscs surviving a daemon restart vs being
+  reinstalled.
+
+Layout of a checkpoint directory:
+  manifest.json   — versioned metadata + engine registries + store records
+  edge_state.npz  — EdgeState arrays
+  sim_state.npz   — optional SimState arrays (inflight/counters/traffic)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubedtn_tpu.api.types import Topology
+from kubedtn_tpu.ops import edge_state as es
+from kubedtn_tpu.topology.engine import SimEngine
+from kubedtn_tpu.topology.store import TopologyStore
+
+FORMAT_VERSION = 1
+
+
+# -- store serialization ----------------------------------------------
+
+def store_records(store: TopologyStore) -> list[dict]:
+    """Full topology records incl. the metadata to_manifest omits."""
+    out = []
+    for t in store.list():
+        out.append({
+            "manifest": t.to_manifest(),
+            "finalizers": list(t.finalizers),
+            "resource_version": t.resource_version,
+            "deletion_requested": t.deletion_requested,
+        })
+    return out
+
+
+def restore_store(records: list[dict]) -> TopologyStore:
+    store = TopologyStore()
+    # Bypass create(): it resets resourceVersion/deletionRequested, but a
+    # restore must preserve the optimistic-concurrency clocks so in-flight
+    # clients conflict correctly against pre-checkpoint versions.
+    with store._lock:
+        for r in records:
+            t = Topology.from_manifest(r["manifest"])
+            t.finalizers = list(r.get("finalizers", []))
+            t.deletion_requested = bool(r.get("deletion_requested", False))
+            t.resource_version = int(r.get("resource_version", 1))
+            store._objects[t.key] = t
+            store._rv = max(store._rv, t.resource_version)
+    return store
+
+
+# -- elastic recovery (reconstruction) --------------------------------
+
+def rebuild_engine(store: TopologyStore, capacity: int = 1024,
+                   node_ip: str = "10.0.0.1") -> SimEngine:
+    """Daemon-restart reconstruction: rebuild the full device-array
+    realization from the store alone.
+
+    Mirrors the reference's startup resync (kubedtn.go:107-121): list all
+    topologies, seed the managers, and re-plumb every alive pod's links.
+    add_links is idempotent per (pod, uid) like SetupVeth
+    (common/veth.go:65-93), so plumbing both endpoint topologies converges
+    to one realization. Mutable shaping state comes back fresh, exactly as
+    reinstalled qdiscs would.
+    """
+    engine = SimEngine(store, capacity=capacity, node_ip=node_ip)
+    for topo in store.list():
+        if topo.is_alive():
+            engine.set_alive(topo.name, topo.namespace, topo.status.src_ip,
+                             topo.status.net_ns)
+    # second pass so peer-aliveness checks see every pod's restored status
+    for topo in store.list():
+        if topo.is_alive():
+            engine.add_links(topo, topo.spec.links)
+    return engine
+
+
+# -- checkpoint save/load ---------------------------------------------
+
+def _arrays_to_npz(path: str, obj) -> None:
+    fields = {f.name: np.asarray(getattr(obj, f.name))
+              for f in dataclasses.fields(obj)}
+    np.savez_compressed(path, **fields)
+
+
+def save(path: str, store: TopologyStore, engine: SimEngine,
+         sim=None) -> None:
+    """Write a checkpoint directory (created if needed)."""
+    os.makedirs(path, exist_ok=True)
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "node_ip": engine.node_ip,
+        "capacity": engine.state.capacity,
+        "store": store_records(store),
+        "engine": {
+            "pod_ids": engine._pod_ids,
+            "rows": [[k[0], k[1], v] for k, v in engine._rows.items()],
+            "peer": [[k[0], k[1], v[0], v[1]]
+                     for k, v in engine._peer.items()],
+            "free": engine._free,
+            "alive": sorted(engine._topology_manager),
+        },
+        "has_sim": sim is not None,
+    }
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    _arrays_to_npz(os.path.join(path, "edge_state.npz"), engine.state)
+    if sim is not None:
+        flat = {}
+        for name in ("inflight", "counters", "traffic"):
+            sub = getattr(sim, name)
+            for fld in dataclasses.fields(sub):
+                flat[f"{name}.{fld.name}"] = np.asarray(getattr(sub, fld.name))
+        flat["clock_us"] = np.asarray(sim.clock_us)
+        np.savez_compressed(os.path.join(path, "sim_state.npz"), **flat)
+
+
+def load(path: str) -> tuple[TopologyStore, SimEngine]:
+    """Restore (store, engine) from a checkpoint directory."""
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest["format_version"] != FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported checkpoint version {manifest['format_version']}")
+
+    store = restore_store(manifest["store"])
+    engine = SimEngine(store, capacity=manifest["capacity"],
+                       node_ip=manifest["node_ip"])
+
+    with np.load(os.path.join(path, "edge_state.npz")) as z:
+        engine.state = es.EdgeState(
+            **{name: jnp.asarray(z[name]) for name in z.files})
+
+    eng = manifest["engine"]
+    engine._pod_ids = dict(eng["pod_ids"])
+    engine._rows = {(p, int(u)): int(r) for p, u, r in eng["rows"]}
+    engine._peer = {(p, int(u)): (pp, int(pu))
+                    for p, u, pp, pu in eng["peer"]}
+    engine._free = [int(x) for x in eng["free"]]
+    engine._topology_manager = set(eng["alive"])
+    return store, engine
+
+
+def load_sim(path: str, engine: SimEngine):
+    """Restore the optional SimState against a restored engine."""
+    from kubedtn_tpu.models.traffic import TrafficState
+    from kubedtn_tpu.ops.queues import EdgeCounters, InFlight
+    from kubedtn_tpu.sim import SimState
+
+    p = os.path.join(path, "sim_state.npz")
+    if not os.path.exists(p):
+        return None
+    with np.load(p) as z:
+        def sub(cls, prefix):
+            return cls(**{
+                f.name: jnp.asarray(z[f"{prefix}.{f.name}"])
+                for f in dataclasses.fields(cls)
+            })
+
+        return SimState(
+            edges=engine.state,
+            inflight=sub(InFlight, "inflight"),
+            counters=sub(EdgeCounters, "counters"),
+            traffic=sub(TrafficState, "traffic"),
+            clock_us=jnp.asarray(z["clock_us"]),
+        )

@@ -103,21 +103,28 @@ def cmd_daemon(args) -> int:
     from kubedtn_tpu.topology import SimEngine, TopologyStore
     from kubedtn_tpu.wire.server import Daemon, make_server
 
+    from kubedtn_tpu.runtime import WireDataPlane
+
     store = TopologyStore()
     engine = SimEngine(store, node_ip=args.node_ip)
-    registry, hist = make_registry(engine)
+    daemon = Daemon(engine)
+    dataplane = WireDataPlane(daemon)
+    registry, hist = make_registry(engine,
+                                   sim_counters_fn=dataplane.counters_fn)
     engine.stats.observer = hist
-    daemon = Daemon(engine, hist)
+    daemon.hist = hist
     server, port = make_server(daemon, port=args.port)
     metrics = MetricsServer(registry, port=args.metrics_port)
     metrics.start()
     server.start()
+    dataplane.start()
     print(f"kubedtn-tpu daemon: gRPC on :{port}, "
           f"metrics on :{metrics.port}/metrics", flush=True)
     try:
         server.wait_for_termination()
     except KeyboardInterrupt:
         server.stop(0)
+        dataplane.stop()
         metrics.stop()
     return 0
 
@@ -156,8 +163,16 @@ def cmd_physical_join(args) -> int:
 
 
 def cmd_bench(args) -> int:
-    import bench
+    # bench.py lives at the repo root, not in the package: anchor the
+    # import so `python -m kubedtn_tpu.cli bench` works from any cwd
+    import importlib.util
+    import os
 
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    spec = importlib.util.spec_from_file_location("bench", path)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
     bench.main()
     return 0
 

@@ -77,10 +77,11 @@ class InterfaceStatsCollector:
                 "tx_packets", "tx_bytes", "rx_packets", "rx_bytes",
                 "dropped_loss", "dropped_queue", "dropped_ring",
                 "rx_corrupted")}
-        # Reverse row map: row -> (pod_key, uid); interface name from the
-        # spec is not tracked per row, so expose uid-derived names the way
-        # the CRD samples do (eth<n> ordering is a spec-level concern).
-        for (pod_key, uid), row in sorted(self._engine._rows.items()):
+        # Locked snapshot: gRPC workers mutate the registries concurrently.
+        # Interface name from the spec is not tracked per row, so expose
+        # uid-derived names the way the CRD samples do (eth<n> ordering is
+        # a spec-level concern).
+        for pod_key, uid, row, rev in self._engine.realized_snapshot():
             ns, _, pod = pod_key.partition("/")
             iface = f"uid{uid}"
             lab = [iface, pod, ns]
@@ -93,7 +94,6 @@ class InterfaceStatsCollector:
                 lab, float(c["dropped_loss"][row] + c["dropped_queue"][row]
                            + c["dropped_ring"][row]))
             fams["tx_errors"].add_metric(lab, 0.0)
-            rev = self._engine.reverse_row(pod_key, uid)
             if rev is not None:
                 fams["rx_packets"].add_metric(
                     lab, float(c["rx_packets"][rev]))
@@ -109,7 +109,8 @@ class MetricsServer:
     endpoint (reference daemon/main.go:57-66)."""
 
     def __init__(self, registry: CollectorRegistry,
-                 port: int = HTTP_ADDR_DEFAULT) -> None:
+                 port: int = HTTP_ADDR_DEFAULT,
+                 host: str = "0.0.0.0") -> None:
         self.registry = registry
         reg = registry
 
@@ -129,7 +130,9 @@ class MetricsServer:
             def log_message(self, *a):  # silence
                 pass
 
-        self._srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        # all interfaces by default: off-host Prometheus must reach the
+        # scrape endpoint, like the reference's :51112 (daemon/main.go:62-66)
+        self._srv = ThreadingHTTPServer((host, port), Handler)
         self.port = self._srv.server_port
 
     def start(self) -> None:

@@ -1,0 +1,260 @@
+"""ctypes bindings for the native runtime library (native/kubedtn_native.cc).
+
+Three capabilities, each a TPU-native stand-in for a native component of the
+reference:
+
+- `decode_frame` / `classify_frame`: the grpc-wire packet decoders
+  (reference daemon/grpcwire/grpcwire.go:465-613), for wire-ingress logging
+  and per-protocol counters.
+- `FlowTable`: the eBPF TCP/IP-bypass state machine (reference
+  bpf/lib/sockops.c, redir.c, redir_disable.c) in userspace — same-node
+  flows short-circuit the shaping data plane unless they traverse a shaped
+  device.
+- `FrameRing`: SPSC frame queue (the reference's per-wire pcap buffer,
+  grpcwire.go:398-409).
+
+The shared library is built on demand with `make -C native` (g++ is in the
+image); every class/function raises NativeUnavailable with a clear message
+if the library cannot be built, and `have_native()` lets callers gate.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libkubedtn_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_error: str | None = None
+
+FRAME_TYPES = {
+    0: "UNKNOWN", 1: "IPv4", 2: "IPv6", 3: "ARP", 4: "VLAN", 5: "LLC",
+    6: "ISIS", 7: "ICMP", 8: "TCP", 9: "BGP", 10: "UDP", 11: "ICMPv6",
+}
+
+PROXY_INIT = 0
+PROXY_ENABLED = 1
+PROXY_DISABLED = 2
+
+
+class NativeUnavailable(RuntimeError):
+    """The native library could not be built or loaded."""
+
+
+def _declare(lib) -> None:
+    c = ctypes
+    u8p, u64p = c.POINTER(c.c_uint8), c.POINTER(c.c_uint64)
+    sigs = {
+        "kdt_decode_frame": (c.c_int64, [u8p, c.c_uint64, c.c_char_p,
+                                         c.c_uint64]),
+        "kdt_classify_frame": (c.c_int32, [u8p, c.c_uint64]),
+        "kdt_classify_batch": (None, [u8p, u64p, u64p, c.c_int64,
+                                      c.POINTER(c.c_int32)]),
+        "kdt_ft_new": (c.c_void_p, [c.c_uint64]),
+        "kdt_ft_free": (None, [c.c_void_p]),
+        "kdt_ft_active_established": (None, [c.c_void_p, c.c_uint32,
+                                             c.c_uint16, c.c_uint32,
+                                             c.c_uint16]),
+        "kdt_ft_passive_established": (c.c_int32, [c.c_void_p, c.c_uint32,
+                                                   c.c_uint16, c.c_uint32,
+                                                   c.c_uint16]),
+        "kdt_ft_msg_redirect": (c.c_int32, [c.c_void_p, c.c_uint32,
+                                            c.c_uint16, c.c_uint32,
+                                            c.c_uint16]),
+        "kdt_ft_shaped_egress": (None, [c.c_void_p, c.c_uint32, c.c_uint16,
+                                        c.c_uint32, c.c_uint16]),
+        "kdt_ft_close": (None, [c.c_void_p, c.c_uint32, c.c_uint16,
+                                c.c_uint32, c.c_uint16]),
+        "kdt_ft_flag": (c.c_int32, [c.c_void_p, c.c_uint32, c.c_uint16,
+                                    c.c_uint32, c.c_uint16]),
+        "kdt_ft_size": (c.c_uint64, [c.c_void_p]),
+        "kdt_ft_bypassed": (c.c_uint64, [c.c_void_p]),
+        "kdt_ft_passed": (c.c_uint64, [c.c_void_p]),
+        "kdt_rb_new": (c.c_void_p, [c.c_uint64]),
+        "kdt_rb_free": (None, [c.c_void_p]),
+        "kdt_rb_push": (c.c_int32, [c.c_void_p, u8p, c.c_uint32]),
+        "kdt_rb_pop": (c.c_int64, [c.c_void_p, u8p, c.c_uint64]),
+        "kdt_rb_count": (c.c_uint64, [c.c_void_p]),
+        "kdt_rb_dropped": (c.c_uint64, [c.c_void_p]),
+    }
+    for name, (restype, argtypes) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+
+
+def _load():
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            raise NativeUnavailable(_build_error)
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                               capture_output=True, text=True, timeout=120)
+            except (subprocess.CalledProcessError, OSError,
+                    subprocess.TimeoutExpired) as e:
+                detail = getattr(e, "stderr", "") or str(e)
+                _build_error = f"native build failed: {detail}"
+                raise NativeUnavailable(_build_error) from e
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            _declare(lib)
+        except OSError as e:
+            _build_error = f"native load failed: {e}"
+            raise NativeUnavailable(_build_error) from e
+        _lib = lib
+        return lib
+
+
+def have_native() -> bool:
+    try:
+        _load()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def _buf(data: bytes):
+    return (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+
+
+def decode_frame(frame: bytes) -> str:
+    """Human-readable frame classification, format-parity with the
+    reference's DecodeFrame (grpcwire.go:465-498)."""
+    lib = _load()
+    out = ctypes.create_string_buffer(4096)
+    lib.kdt_decode_frame(_buf(frame), len(frame), out, len(out))
+    return out.value.decode()
+
+
+def classify_frame(frame: bytes) -> str:
+    """Innermost protocol name of the frame (e.g. "BGP", "ARP", "ISIS")."""
+    lib = _load()
+    return FRAME_TYPES[lib.kdt_classify_frame(_buf(frame), len(frame))]
+
+
+def classify_batch(frames: list[bytes]) -> list[str]:
+    """One native call for a whole ingress drain."""
+    lib = _load()
+    n = len(frames)
+    if n == 0:
+        return []
+    blob = b"".join(frames)
+    offs, lens = [], []
+    pos = 0
+    for f in frames:
+        offs.append(pos)
+        lens.append(len(f))
+        pos += len(f)
+    out = (ctypes.c_int32 * n)()
+    lib.kdt_classify_batch(
+        _buf(blob), (ctypes.c_uint64 * n)(*offs), (ctypes.c_uint64 * n)(*lens),
+        n, out)
+    return [FRAME_TYPES[v] for v in out]
+
+
+def _ip(v) -> int:
+    """Accept dotted-quad strings or raw uint32."""
+    if isinstance(v, int):
+        return v
+    parts = [int(x) for x in v.split(".")]
+    return (parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8) | parts[3]
+
+
+class FlowTable:
+    """The eBPF bypass state machine (see module docstring)."""
+
+    def __init__(self, capacity: int = 65535) -> None:
+        self._lib = _load()
+        self._h = self._lib.kdt_ft_new(capacity)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kdt_ft_free(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def active_established(self, lip, lport, rip, rport) -> None:
+        self._lib.kdt_ft_active_established(self._h, _ip(lip), lport,
+                                            _ip(rip), rport)
+
+    def passive_established(self, lip, lport, rip, rport) -> bool:
+        return bool(self._lib.kdt_ft_passive_established(
+            self._h, _ip(lip), lport, _ip(rip), rport))
+
+    def msg_redirect(self, lip, lport, rip, rport) -> bool:
+        """True ⇒ this message bypasses the shaping data plane."""
+        return bool(self._lib.kdt_ft_msg_redirect(
+            self._h, _ip(lip), lport, _ip(rip), rport))
+
+    def shaped_egress(self, sip, sport, dip, dport) -> None:
+        self._lib.kdt_ft_shaped_egress(self._h, _ip(sip), sport, _ip(dip),
+                                       dport)
+
+    def on_close(self, lip, lport, rip, rport) -> None:
+        self._lib.kdt_ft_close(self._h, _ip(lip), lport, _ip(rip), rport)
+
+    def flag(self, lip, lport, rip, rport) -> int | None:
+        v = self._lib.kdt_ft_flag(self._h, _ip(lip), lport, _ip(rip), rport)
+        return None if v < 0 else v
+
+    def __len__(self) -> int:
+        return self._lib.kdt_ft_size(self._h)
+
+    @property
+    def bypassed(self) -> int:
+        return self._lib.kdt_ft_bypassed(self._h)
+
+    @property
+    def passed(self) -> int:
+        return self._lib.kdt_ft_passed(self._h)
+
+
+class FrameRing:
+    """SPSC length-prefixed frame queue."""
+
+    def __init__(self, capacity_bytes: int = 640 * 1024) -> None:
+        self._lib = _load()
+        self._h = self._lib.kdt_rb_new(capacity_bytes)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kdt_rb_free(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def push(self, frame: bytes) -> bool:
+        return bool(self._lib.kdt_rb_push(self._h, _buf(frame), len(frame)))
+
+    def pop(self, max_len: int = 65536) -> bytes | None:
+        out = (ctypes.c_uint8 * max_len)()
+        n = self._lib.kdt_rb_pop(self._h, out, max_len)
+        if n < 0:
+            return None
+        return bytes(out[:n])
+
+    def __len__(self) -> int:
+        return self._lib.kdt_rb_count(self._h)
+
+    @property
+    def dropped(self) -> int:
+        return self._lib.kdt_rb_dropped(self._h)

@@ -1,0 +1,291 @@
+"""Fused netem+TBF shaping as a Pallas TPU kernel.
+
+The shaping step is the data plane's hot op: every simulation step reads the
+whole per-edge state (props, token buckets, correlation memory, counters),
+pushes one packet per edge through the netem→TBF chain, and writes the state
+back. Under plain XLA this is a chain of elementwise HLOs that the fusion
+pass usually merges well; this kernel makes the fusion *guaranteed* and
+controls the layout explicitly: one VMEM-resident pass per 8×128-lane edge
+tile — every input read once from HBM, every output written once, zero
+intermediate HBM traffic.
+
+Numerical parity: given the same uniforms, the kernel computes bit-identical
+results to the reference vmapped path (kubedtn_tpu.ops.netem.shape_step),
+which itself mirrors the Linux sch_netem/sch_tbf semantics the reference
+installs per veth (reference common/qdisc.go:20-126, 201-290). The test
+suite checks parity on CPU via interpret mode.
+
+Layout: per-edge 1-D arrays [E] are viewed as [R, 128] row tiles; the
+property matrix [E, NPROP] and correlation memory [E, NCORR] are transposed
+to [NPROP, R, 128] / [NCORR, R, 128] so each property is a contiguous lane
+vector — column extraction becomes a sublane-indexed read instead of a
+strided gather.
+
+Flags are packed into one int32 bitmask per edge (bit k of FLAG_*) so the
+kernel has a single flag output instead of six bool arrays (bool tiles have
+a 32-sublane minimum; int32 tiles align with the f32 data at 8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kubedtn_tpu.api.parsers import TBF_LATENCY_US
+from kubedtn_tpu.ops import edge_state as es
+from kubedtn_tpu.ops import netem
+from kubedtn_tpu.ops.edge_state import EdgeState
+
+LANE = 128
+SUBLANES = 8          # f32 min tile sublane count
+MIN_TILE = LANE * SUBLANES
+
+FLAG_DELIVERED = 1
+FLAG_DROP_LOSS = 2
+FLAG_DROP_QUEUE = 4
+FLAG_CORRUPTED = 8
+FLAG_DUPLICATED = 16
+FLAG_REORDERED = 32
+
+
+def _crandom(u, last, rho):
+    """netem get_crandom, elementwise on tiles (see netem.crandom)."""
+    val = u * (1.0 - rho) + last * rho
+    new_last = jnp.where(rho > 0.0, val, last)
+    return val, new_last
+
+
+def _shape_kernel(props_ref, corr_ref, u_ref, tokens_ref, t_last_ref,
+                  backlog_ref, count_ref, sizes_ref, t_arr_ref, act_ref,
+                  # outputs
+                  depart_ref, flags_ref, tokens_out, t_last_out,
+                  backlog_out, corr_out, count_out):
+    """One edge tile ([BR, 128] lanes) through the full qdisc chain."""
+    pct = 1.0 / 100.0
+
+    latency = props_ref[es.P_LATENCY_US]
+    lat_rho = props_ref[es.P_LATENCY_CORR] * pct
+    jitter = props_ref[es.P_JITTER_US]
+    loss = props_ref[es.P_LOSS]
+    loss_rho = props_ref[es.P_LOSS_CORR] * pct
+    rate = props_ref[es.P_RATE_BPS]
+    gap = props_ref[es.P_GAP]
+    dup = props_ref[es.P_DUPLICATE]
+    dup_rho = props_ref[es.P_DUPLICATE_CORR] * pct
+    reorder = props_ref[es.P_REORDER_PROB]
+    reo_rho = props_ref[es.P_REORDER_CORR] * pct
+    corrupt = props_ref[es.P_CORRUPT_PROB]
+    cor_rho = props_ref[es.P_CORRUPT_CORR] * pct
+
+    c_delay = corr_ref[es.C_DELAY]
+    c_loss = corr_ref[es.C_LOSS]
+    c_dup = corr_ref[es.C_DUP]
+    c_reo = corr_ref[es.C_REORDER]
+    c_cor = corr_ref[es.C_CORRUPT]
+
+    cnt = count_ref[...]
+    cnt_f = cnt.astype(jnp.float32)
+
+    # -- netem stage (kernel enqueue order; see netem.netem_packet) ----
+    x_dup, dup_state = _crandom(u_ref[netem.U_DUP], c_dup, dup_rho)
+    dup_hit = (dup > 0.0) & (x_dup * 100.0 < dup)
+    dup_state = jnp.where(dup > 0.0, dup_state, c_dup)
+
+    x_loss, loss_state = _crandom(u_ref[netem.U_LOSS], c_loss, loss_rho)
+    loss_hit = (loss > 0.0) & (x_loss * 100.0 < loss)
+    loss_state = jnp.where(loss > 0.0, loss_state, c_loss)
+
+    dropped = loss_hit & ~dup_hit
+    duplicated = dup_hit & ~loss_hit
+    survives = ~dropped
+
+    x_cor, cor_state = _crandom(u_ref[netem.U_CORRUPT], c_cor, cor_rho)
+    corrupted = (corrupt > 0.0) & (x_cor * 100.0 < corrupt) & survives
+    cor_state = jnp.where((corrupt > 0.0) & survives, cor_state, c_cor)
+
+    x_del, del_state = _crandom(u_ref[netem.U_DELAY], c_delay, lat_rho)
+    delay = jnp.where(jitter > 0.0,
+                      latency + jitter * (2.0 * x_del - 1.0), latency)
+    delay = jnp.maximum(delay, 0.0)
+    del_state = jnp.where((jitter > 0.0) & survives, del_state, c_delay)
+
+    x_reo, reo_state = _crandom(u_ref[netem.U_REORDER], c_reo, reo_rho)
+    reorder_on = reorder > 0.0
+    candidate = (gap == 0.0) | (cnt_f >= gap - 1.0)
+    do_reorder = reorder_on & candidate & (x_reo * 100.0 <= reorder) & survives
+    reo_state = jnp.where(reorder_on & candidate & survives, reo_state, c_reo)
+
+    delay = jnp.where(do_reorder, 0.0, delay)
+    new_cnt = jnp.where(do_reorder, 0, jnp.where(survives, cnt + 1, cnt))
+
+    # -- TBF stage (see netem.tbf_packet) ------------------------------
+    tokens = tokens_ref[...]
+    t_last = t_last_ref[...]
+    next_free = backlog_ref[...]
+    size = sizes_ref[...]
+    t_ready = t_arr_ref[...] + delay
+
+    rate_on = rate > 0.0
+    rate_b_us = rate / 8e6
+    burst = jnp.maximum(rate / 250.0, 5000.0)
+    start = jnp.maximum(t_ready, next_free)
+    avail = jnp.minimum(burst, tokens + (start - t_last) *
+                        jnp.where(rate_on, rate_b_us, 0.0))
+    need = size - avail
+    wait = jnp.where(need > 0.0, need / jnp.maximum(rate_b_us, 1e-30), 0.0)
+    depart = start + wait
+    drop_q = rate_on & ((depart - t_ready) > TBF_LATENCY_US)
+    accept = rate_on & ~drop_q
+    new_tokens = jnp.where(accept, jnp.maximum(avail - size, 0.0), tokens)
+    new_t_last = jnp.where(accept, depart, t_last)
+    new_next_free = jnp.where(accept, depart, next_free)
+    t_depart = jnp.where(rate_on, depart, t_ready)
+
+    # netem-dropped packets never reach TBF
+    new_tokens = jnp.where(dropped, tokens, new_tokens)
+    new_t_last = jnp.where(dropped, t_last, new_t_last)
+    new_next_free = jnp.where(dropped, next_free, new_next_free)
+    drop_q = drop_q & ~dropped
+
+    delivered = ~dropped & ~drop_q
+
+    # -- masking + packed outputs --------------------------------------
+    act = act_ref[...] > 0
+    inf = jnp.float32(jnp.inf)
+    delivered &= act
+    dropped &= act
+    drop_q &= act
+    corrupted = corrupted & delivered
+    duplicated = duplicated & delivered
+    do_reorder = do_reorder & delivered
+
+    depart_ref[...] = jnp.where(delivered, t_depart, inf)
+    flags_ref[...] = (
+        delivered.astype(jnp.int32) * FLAG_DELIVERED
+        + dropped.astype(jnp.int32) * FLAG_DROP_LOSS
+        + drop_q.astype(jnp.int32) * FLAG_DROP_QUEUE
+        + corrupted.astype(jnp.int32) * FLAG_CORRUPTED
+        + duplicated.astype(jnp.int32) * FLAG_DUPLICATED
+        + do_reorder.astype(jnp.int32) * FLAG_REORDERED
+    )
+    tokens_out[...] = jnp.where(act, new_tokens, tokens)
+    t_last_out[...] = jnp.where(act, new_t_last, t_last)
+    backlog_out[...] = jnp.where(act, new_next_free, next_free)
+    count_out[...] = jnp.where(act, new_cnt, cnt)
+    corr_out[es.C_DELAY] = jnp.where(act, del_state, c_delay)
+    corr_out[es.C_LOSS] = jnp.where(act, loss_state, c_loss)
+    corr_out[es.C_DUP] = jnp.where(act, dup_state, c_dup)
+    corr_out[es.C_REORDER] = jnp.where(act, reo_state, c_reo)
+    corr_out[es.C_CORRUPT] = jnp.where(act, cor_state, c_cor)
+
+
+def _pad_rows(x: jax.Array, e_pad: int) -> jax.Array:
+    """Zero-pad the leading (edge) dim to e_pad."""
+    if x.shape[0] == e_pad:
+        return x
+    pad = [(0, e_pad - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad)
+
+
+def _tiles(x: jax.Array, e_pad: int):
+    """[E] -> [R, 128] or [E, C] -> [C, R, 128]."""
+    x = _pad_rows(x, e_pad)
+    if x.ndim == 1:
+        return x.reshape(e_pad // LANE, LANE)
+    return x.T.reshape(x.shape[1], e_pad // LANE, LANE)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block_rows"))
+def shape_step(state: EdgeState, sizes: jax.Array, have_pkt: jax.Array,
+               t_arrival: jax.Array, key: jax.Array, *,
+               interpret: bool | None = None, block_rows: int = 64):
+    """Drop-in replacement for kubedtn_tpu.ops.netem.shape_step backed by
+    the fused Pallas kernel. Same signature, same results for the same key.
+
+    `interpret=None` auto-selects interpret mode off-TPU so the kernel runs
+    (and is tested) everywhere; pass False/True to force.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    E = state.capacity
+    br = block_rows if E >= block_rows * LANE else SUBLANES
+    e_pad = -(-E // (br * LANE)) * (br * LANE)
+    R = e_pad // LANE
+
+    # Same uniforms as the vmapped path -> identical results per key.
+    u = jax.random.uniform(key, (E, netem.NU), dtype=jnp.float32)
+
+    act = (have_pkt & state.active).astype(jnp.int32)
+
+    props_t = _tiles(state.props, e_pad)        # [NPROP, R, 128]
+    corr_t = _tiles(state.corr, e_pad)          # [NCORR, R, 128]
+    u_t = _tiles(u, e_pad)                      # [NU, R, 128]
+    tokens_t = _tiles(state.tokens, e_pad)
+    t_last_t = _tiles(state.t_last, e_pad)
+    backlog_t = _tiles(state.backlog_until, e_pad)
+    count_t = _tiles(state.pkt_count, e_pad)
+    sizes_t = _tiles(sizes, e_pad)
+    t_arr_t = _tiles(t_arrival, e_pad)
+    act_t = _tiles(act, e_pad)
+
+    grid = (R // br,)
+
+    def vec(io=0):
+        return pl.BlockSpec((br, LANE), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+
+    def slab(c):
+        return pl.BlockSpec((c, br, LANE), lambda i: (0, i, 0),
+                            memory_space=pltpu.VMEM)
+
+    f32 = jnp.float32
+    out_shapes = (
+        jax.ShapeDtypeStruct((R, LANE), f32),          # depart
+        jax.ShapeDtypeStruct((R, LANE), jnp.int32),    # flags
+        jax.ShapeDtypeStruct((R, LANE), f32),          # tokens
+        jax.ShapeDtypeStruct((R, LANE), f32),          # t_last
+        jax.ShapeDtypeStruct((R, LANE), f32),          # backlog
+        jax.ShapeDtypeStruct((es.NCORR, R, LANE), f32),  # corr
+        jax.ShapeDtypeStruct((R, LANE), jnp.int32),    # pkt_count
+    )
+    out_specs = (vec(), vec(), vec(), vec(), vec(), slab(es.NCORR), vec())
+
+    (depart, flags, tokens, t_last, backlog, corr, count) = pl.pallas_call(
+        _shape_kernel,
+        grid=grid,
+        in_specs=[slab(es.NPROP), slab(es.NCORR), slab(netem.NU),
+                  vec(), vec(), vec(), vec(), vec(), vec(), vec()],
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(props_t, corr_t, u_t, tokens_t, t_last_t, backlog_t, count_t,
+      sizes_t, t_arr_t, act_t)
+
+    def untile(x):
+        return x.reshape(-1)[:E]
+
+    new_state = dataclasses.replace(
+        state,
+        tokens=untile(tokens),
+        t_last=untile(t_last),
+        backlog_until=untile(backlog),
+        corr=corr.reshape(es.NCORR, -1)[:, :E].T,
+        pkt_count=untile(count),
+    )
+    fl = untile(flags)
+    res = netem.ShapeResult(
+        depart_us=untile(depart),
+        delivered=(fl & FLAG_DELIVERED) > 0,
+        dropped_loss=(fl & FLAG_DROP_LOSS) > 0,
+        dropped_queue=(fl & FLAG_DROP_QUEUE) > 0,
+        corrupted=(fl & FLAG_CORRUPTED) > 0,
+        duplicated=(fl & FLAG_DUPLICATED) > 0,
+        reordered=(fl & FLAG_REORDERED) > 0,
+    )
+    return new_state, res

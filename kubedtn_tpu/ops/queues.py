@@ -96,8 +96,9 @@ def init_inflight(capacity: int, q: int = 32) -> InFlight:
 
 
 def init_counters(capacity: int) -> EdgeCounters:
-    z = jnp.zeros((capacity,), jnp.float32)
-    return EdgeCounters(*([z] * 10))
+    # distinct buffers per field: donation rejects the same buffer twice
+    return EdgeCounters(*[jnp.zeros((capacity,), jnp.float32)
+                          for _ in range(10)])
 
 
 def shape_packets(state: EdgeState, sizes: jax.Array, valid: jax.Array,

@@ -139,6 +139,7 @@ def next_hop_edges(state: EdgeState, dist: jax.Array, n_nodes: int,
     if dst_chunk is None:
         dst_chunk = n_nodes
     dst_chunk = min(dst_chunk, n_nodes)
+    assert n_nodes % dst_chunk == 0, "dst_chunk must divide n_nodes"
     n_chunks = max(n_nodes // dst_chunk, 1)
 
     def chunk_fn(d_chunk):

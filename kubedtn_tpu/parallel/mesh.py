@@ -40,6 +40,36 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> None:
+    """Join a multi-host run (jax.distributed) — the TPU-native analogue of
+    the reference's daemon joining the cluster and peering over gRPC
+    (reference daemon/main.go:20-107): afterwards jax.devices() spans every
+    host and the collectives in the sharded step ride ICI within a slice
+    and DCN across slices. No-op when already initialized."""
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError:
+        pass  # already initialized
+
+
+def make_multihost_mesh() -> Mesh:
+    """1-D edge mesh over EVERY process's devices, host-major.
+
+    Host-major order means a block-sharded edge array keeps consecutive
+    shards on the same host: the all_to_all segments between co-hosted
+    shards ride ICI, only inter-host segments touch DCN — the layout
+    recipe of the scaling-book's "pick a mesh, let XLA insert collectives".
+    """
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    return Mesh(np.array(devs), (EDGE_AXIS,))
+
+
 def shard_edge_state(state, mesh: Mesh):
     """Place every EdgeState array with its edge dimension sharded.
 

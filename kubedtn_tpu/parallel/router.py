@@ -1,0 +1,257 @@
+"""Sharded multi-hop forwarding: shard_map + all_to_all over the edge mesh.
+
+The reference completes multi-node paths with daemon-to-daemon RPC — every
+cross-node link crossing is one unary gRPC per packet (reference
+daemon/grpcwire/grpcwire.go:386-462) or a kernel VXLAN hop. Here the
+forwarding plane is sharded along the edge axis, and the per-step batch of
+"packets whose next hop lives on another shard" crosses in ONE
+`jax.lax.all_to_all` over ICI — the collective replaces the RPC mesh
+(SURVEY.md §5.7-5.8).
+
+Step anatomy (inside one shard_map over the 'edge' axis):
+  1. local data plane: traffic gen → netem+TBF shaping → delay lines →
+     due deliveries (all per-edge elementwise, zero communication);
+  2. route lookup on the replicated next-hop table;
+  3. bucket transit packets by owner shard of their next-hop edge into a
+     fixed [n_shards, budget] exchange buffer (overflow counted, like a
+     router input-queue drop);
+  4. all_to_all the buffer; re-inject received packets into local pending
+     lanes for the next step;
+  5. psum per-node delivery counters across shards.
+
+Everything is static-shape; the exchange budget bounds per-step cross-shard
+traffic the way the reference's gRPC channel capacity bounds its wires.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubedtn_tpu.models.traffic import TrafficSpec, generate
+from kubedtn_tpu.ops import netem
+from kubedtn_tpu.ops.edge_state import EdgeState
+from kubedtn_tpu.ops.queues import insert_inflight, pop_due, shape_packets
+from kubedtn_tpu.parallel.mesh import EDGE_AXIS
+from kubedtn_tpu.router import RouterState, _group_into_lanes
+from kubedtn_tpu.sim import SimState, _add, init_sim
+
+
+def _edge_specs(rs: RouterState, n_shards: int):
+    """Spec pytree: edge-dim arrays sharded, tables/counters replicated."""
+    del n_shards
+    sim_spec = jax.tree.map(lambda x: P(EDGE_AXIS), rs.sim)
+    sim_spec = dataclasses.replace(sim_spec, clock_us=P())
+    return RouterState(
+        sim=sim_spec,
+        next_edge=P(),
+        pend_size=P(EDGE_AXIS),
+        pend_dst=P(EDGE_AXIS),
+        pend_corr=P(EDGE_AXIS),
+        node_rx_packets=P(),
+        node_rx_bytes=P(),
+        fwd_dropped=P(),
+        no_route_dropped=P(),
+    )
+
+
+def _bucket_by_shard(shard_of: jax.Array, lrow: jax.Array, size: jax.Array,
+                     fdst: jax.Array, corr: jax.Array, live: jax.Array,
+                     n_shards: int, budget: int):
+    """Scatter flat packets into [n_shards, budget, 4] send lanes.
+
+    Same sort+segmented-rank trick as router._group_into_lanes, keyed by
+    destination shard. Fields packed f32: (local_row, size, final_dst,
+    corrupted); empty lanes have local_row == -1.
+    """
+    M = shard_of.shape[0]
+    tgt = jnp.where(live, shard_of, n_shards)
+    order = jnp.argsort(tgt)
+    tgt_s = tgt[order]
+    idx = jnp.arange(M)
+    starts = jnp.concatenate([jnp.array([True]), tgt_s[1:] != tgt_s[:-1]])
+    start_idx = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(starts, idx, 0))
+    rank = idx - start_idx
+
+    ok = (tgt_s < n_shards) & (rank < budget)
+    row = jnp.where(ok, tgt_s, n_shards)
+    lane = jnp.where(ok, rank, 0)
+
+    fields = jnp.stack([
+        jnp.where(live, lrow.astype(jnp.float32), -1.0)[order],
+        size[order],
+        fdst.astype(jnp.float32)[order],
+        corr.astype(jnp.float32)[order],
+    ], axis=-1)                                   # [M, 4]
+    buf = jnp.full((n_shards + 1, budget, 4), -1.0, jnp.float32)
+    buf = buf.at[row, lane].set(
+        jnp.where(ok[:, None], fields, -1.0), mode="drop")[:n_shards]
+    dropped = ((tgt_s < n_shards) & (rank >= budget)).sum().astype(jnp.float32)
+    return buf, dropped
+
+
+def make_sharded_router_step(mesh, n_nodes: int, k_slots: int = 4,
+                             k_fwd: int = 8, budget: int | None = None):
+    """Build the jitted sharded router step.
+
+    Returns step(rs, spec, flow_dst, key, dt_us) -> rs' with every edge-dim
+    leaf of `rs` (and `spec`/`flow_dst`) sharded over the mesh's edge axis.
+    """
+    n_shards = mesh.devices.size
+    if budget is None:
+        budget = max(k_fwd * 8, 16)
+
+    spec_edge = TrafficSpec(*([P(EDGE_AXIS)] * 5))
+
+    def body(rs: RouterState, spec: TrafficSpec, flow_dst, key, dt_us):
+        sim = rs.sim
+        E_loc = sim.edges.capacity            # local block
+        shard = jax.lax.axis_index(EDGE_AXIS)
+        row0 = shard * E_loc                  # global row offset
+        key = jax.random.fold_in(key, shard)
+        kg, ks = jax.random.split(key)
+
+        # 1. traffic + pending re-injections (local)
+        tstate, sizes_t, valid_t, t_arr_t = generate(
+            spec, sim.traffic, dt_us, k_slots, kg)
+        valid_t = valid_t & sim.edges.active[:, None]
+        sizes_t = jnp.where(valid_t, sizes_t, 0.0)
+        fd = jnp.where(flow_dst >= 0, flow_dst, sim.edges.dst)
+        fdst_t = jnp.broadcast_to(fd[:, None], sizes_t.shape)
+
+        valid_p = rs.pend_dst >= 0
+        sizes = jnp.concatenate([sizes_t, rs.pend_size], axis=1)
+        valid = jnp.concatenate([valid_t, valid_p], axis=1)
+        t_arr = jnp.concatenate([t_arr_t, jnp.zeros_like(rs.pend_size)],
+                                axis=1)
+        fdst_in = jnp.concatenate([fdst_t, rs.pend_dst], axis=1)
+
+        # 2. shaping (local, elementwise over edges)
+        edges, res = shape_packets(sim.edges, sizes, valid, t_arr, ks)
+
+        # 3. delay lines (duplicates share the original's departure).
+        #    Corruption persists across hops: carry the pending lanes' flag.
+        corr_in = jnp.concatenate(
+            [jnp.zeros_like(valid_t), rs.pend_corr & valid_p], axis=1)
+        corr_now = res.corrupted | (corr_in & res.delivered)
+        dep_all = jnp.concatenate([res.depart_us, res.depart_us], axis=1)
+        sz_all = jnp.concatenate([sizes, sizes], axis=1)
+        co_all = jnp.concatenate([corr_now, corr_now], axis=1)
+        fd_all = jnp.concatenate([fdst_in, fdst_in], axis=1)
+        deliver_all = jnp.concatenate(
+            [res.delivered, res.delivered & res.duplicated], axis=1)
+        fl, dropped_ring = insert_inflight(
+            sim.inflight, dep_all, sz_all, fd_all, co_all, deliver_all)
+
+        # 4. due deliveries
+        fl_after, due = pop_due(fl, dt_us)
+        here = jnp.broadcast_to(edges.dst[:, None], due.shape)
+        at_dest = due & (fl.final_dst == here)
+        in_transit = due & ~at_dest
+
+        # 4a. final deliveries -> per-node counters (psum'd below)
+        n = rs.node_rx_packets.shape[0]
+        local_rx_p = jnp.zeros((n,), jnp.float32).at[
+            jnp.where(at_dest, here, n)].add(1.0, mode="drop")
+        local_rx_b = jnp.zeros((n,), jnp.float32).at[
+            jnp.where(at_dest, here, n)].add(
+            jnp.where(at_dest, fl.size, 0.0), mode="drop")
+
+        # 4b. transit -> next-hop edge (global row), bucket by owner shard
+        flat_here = here.reshape(-1)
+        flat_fd = fl.final_dst.reshape(-1)
+        flat_live = in_transit.reshape(-1)
+        safe_here = jnp.where(flat_live, flat_here, 0)
+        safe_fd = jnp.where(flat_live, jnp.maximum(flat_fd, 0), 0)
+        nxt = rs.next_edge[safe_here, safe_fd]    # global edge row
+        no_route = flat_live & (nxt < 0)
+        live = flat_live & (nxt >= 0)
+        shard_of = jnp.where(live, nxt // E_loc, n_shards)
+        lrow = jnp.where(live, nxt - shard_of * E_loc, -1)
+
+        send, fwd_drop_tx = _bucket_by_shard(
+            shard_of, lrow, fl.size.reshape(-1), flat_fd,
+            fl.corrupted.reshape(-1), live, n_shards, budget)
+
+        # --- THE collective: one all_to_all replaces the per-packet RPC
+        recv = jax.lax.all_to_all(send, EDGE_AXIS, split_axis=0,
+                                  concat_axis=0, tiled=True)
+        r = recv.reshape(-1, 4)                   # [n_shards*budget, 4]
+        r_row = r[:, 0].astype(jnp.int32)
+        r_live = r_row >= 0
+        p_sz, p_dst, p_co, p_ok, fwd_drop_rx = _group_into_lanes(
+            jnp.where(r_live, r_row, E_loc), r[:, 1],
+            r[:, 2].astype(jnp.int32), r[:, 3] > 0.5, r_live, E_loc, k_fwd)
+
+        counters = _add(
+            sim.counters,
+            tx_packets=valid.sum(axis=1).astype(jnp.float32),
+            tx_bytes=sizes.sum(axis=1),
+            rx_packets=due.sum(axis=1).astype(jnp.float32),
+            rx_bytes=jnp.where(due, fl.size, 0.0).sum(axis=1),
+            rx_corrupted=jnp.where(due, fl.corrupted, False).sum(
+                axis=1).astype(jnp.float32),
+            dropped_loss=res.dropped_loss.sum(axis=1).astype(jnp.float32),
+            dropped_queue=res.dropped_queue.sum(axis=1).astype(jnp.float32),
+            dropped_ring=dropped_ring,
+            duplicated=res.duplicated.sum(axis=1).astype(jnp.float32),
+            reordered=res.reordered.sum(axis=1).astype(jnp.float32),
+        )
+
+        edges = netem.roll_epoch.__wrapped__(edges, dt_us)
+        sim2 = SimState(edges=edges, inflight=fl_after, counters=counters,
+                        traffic=tstate, clock_us=sim.clock_us + dt_us)
+        return RouterState(
+            sim=sim2,
+            next_edge=rs.next_edge,
+            pend_size=jnp.where(p_ok, p_sz, 0.0),
+            pend_dst=jnp.where(p_ok, p_dst, -1),
+            pend_corr=p_co & p_ok,
+            node_rx_packets=rs.node_rx_packets +
+            jax.lax.psum(local_rx_p, EDGE_AXIS),
+            node_rx_bytes=rs.node_rx_bytes +
+            jax.lax.psum(local_rx_b, EDGE_AXIS),
+            fwd_dropped=rs.fwd_dropped + jax.lax.psum(
+                fwd_drop_tx + fwd_drop_rx, EDGE_AXIS),
+            no_route_dropped=rs.no_route_dropped + jax.lax.psum(
+                no_route.sum().astype(jnp.float32), EDGE_AXIS),
+        )
+
+    def rs_specs(rs_like: RouterState) -> RouterState:
+        return _edge_specs(rs_like, n_shards)
+
+    def make(rs_template: RouterState):
+        specs = rs_specs(rs_template)
+        mapped = shard_map(
+            body, mesh=mesh,
+            in_specs=(specs, spec_edge, P(EDGE_AXIS), P(), P()),
+            out_specs=specs,
+        )
+        return jax.jit(mapped, donate_argnums=0)
+
+    _cache: dict = {}
+
+    def step(rs: RouterState, spec: TrafficSpec, flow_dst, key, dt_us):
+        if "fn" not in _cache:
+            _cache["fn"] = make(rs)
+        return _cache["fn"](rs, spec, flow_dst, key,
+                            jnp.float32(dt_us))
+
+    return step
+
+
+def shard_router_state(rs: RouterState, mesh) -> RouterState:
+    """Place a host-built RouterState onto the mesh with the step's
+    shardings (edge-dim leaves split, tables replicated)."""
+    specs = _edge_specs(rs, mesh.devices.size)
+
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, rs, specs)

@@ -135,10 +135,15 @@ def router_step(rs: RouterState, spec: TrafficSpec, flow_dst: jax.Array,
     # 2. shape through the qdisc chain
     edges, res = shape_packets(sim.edges, sizes, valid, t_arr, ks)
 
-    # 3. into the delay lines (duplicates share the original's departure)
+    # 3. into the delay lines (duplicates share the original's departure).
+    #    A packet corrupted on ANY hop stays corrupted: carry the pending
+    #    lanes' flag through this hop's result.
+    corr_in = jnp.concatenate(
+        [jnp.zeros_like(valid_t), rs.pend_corr & valid_p], axis=1)
+    corr_now = res.corrupted | (corr_in & res.delivered)
     dep_all = jnp.concatenate([res.depart_us, res.depart_us], axis=1)
     sz_all = jnp.concatenate([sizes, sizes], axis=1)
-    co_all = jnp.concatenate([res.corrupted, res.corrupted], axis=1)
+    co_all = jnp.concatenate([corr_now, corr_now], axis=1)
     fd_all = jnp.concatenate([fdst_in, fdst_in], axis=1)
     deliver_all = jnp.concatenate(
         [res.delivered, res.delivered & res.duplicated], axis=1)

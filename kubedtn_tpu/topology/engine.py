@@ -422,6 +422,18 @@ class SimEngine:
 
     # -- queries -------------------------------------------------------
 
+    @_locked
+    def realized_snapshot(self) -> list[tuple[str, int, int, int | None]]:
+        """(pod_key, uid, row, reverse_row) for every realized link end,
+        taken under the engine lock — the safe read for concurrent metrics
+        scrapes (a gRPC worker may be mutating the registries)."""
+        out = []
+        for (pod_key, uid), row in sorted(self._rows.items()):
+            peer = self._peer.get((pod_key, uid))
+            rev = self._rows.get(peer) if peer is not None else None
+            out.append((pod_key, uid, row, rev))
+        return out
+
     def link_row(self, pod_key: str, uid: int) -> dict | None:
         """Host-side readout of one directed link's realized state."""
         row = self._rows.get((pod_key, uid))

@@ -1,0 +1,146 @@
+"""Tracing/profiling — phase timing as a first-class subsystem.
+
+The reference's tracing is ad-hoc: fmt.Printf phase walltimes in the
+controller (reference controllers/topology_controller.go:99-153) and
+Prometheus latency histograms in the daemon (daemon/metrics/
+latency_histograms.go). This module upgrades that to a structured tracer:
+
+- nested spans with a thread-local stack (`with tracer.span("add-links"):`)
+- chrome://tracing ("catapult") JSON export, loadable in Perfetto
+- per-name aggregate stats (count/total/max ms), the histogram feed
+- optional XLA device profiling via jax.profiler for the TPU hot path
+
+A process-wide default tracer keeps call sites one-liners; everything is
+thread-safe.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    name: str
+    start_us: float
+    dur_us: float = 0.0
+    depth: int = 0
+    thread: int = 0
+    meta: dict = field(default_factory=dict)
+
+
+class Tracer:
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+        self._local = threading.local()
+        self._t0 = time.perf_counter()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _stack(self) -> list[Span]:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, **meta):
+        if not self.enabled:
+            yield None
+            return
+        s = Span(name=name, start_us=self._now_us(),
+                 depth=len(self._stack()),
+                 thread=threading.get_ident(), meta=meta)
+        self._stack().append(s)
+        try:
+            yield s
+        finally:
+            self._stack().pop()
+            s.dur_us = self._now_us() - s.start_us
+            with self._lock:
+                self._spans.append(s)
+
+    def traced(self, name: str | None = None):
+        """Decorator form of span()."""
+
+        def wrap(fn):
+            label = name or fn.__qualname__
+
+            def inner(*a, **kw):
+                with self.span(label):
+                    return fn(*a, **kw)
+
+            inner.__name__ = fn.__name__
+            inner.__qualname__ = fn.__qualname__
+            inner.__doc__ = fn.__doc__
+            return inner
+
+        return wrap
+
+    # -- readouts ------------------------------------------------------
+
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def stats(self) -> dict[str, dict[str, float]]:
+        """Aggregate per-name {count, total_ms, max_ms} — the shape the
+        daemon's latency histograms consume."""
+        agg: dict[str, dict[str, float]] = defaultdict(
+            lambda: {"count": 0, "total_ms": 0.0, "max_ms": 0.0})
+        for s in self.spans():
+            a = agg[s.name]
+            a["count"] += 1
+            ms = s.dur_us / 1e3
+            a["total_ms"] += ms
+            a["max_ms"] = max(a["max_ms"], ms)
+        return dict(agg)
+
+    def export_chrome(self, path: str) -> None:
+        """Write catapult trace-event JSON (open in Perfetto/chrome)."""
+        events = [{
+            "name": s.name, "ph": "X", "ts": s.start_us, "dur": s.dur_us,
+            "pid": 0, "tid": s.thread % 1_000_000, "args": s.meta,
+        } for s in self.spans()]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+# process-wide default
+_default = Tracer()
+
+
+def default_tracer() -> Tracer:
+    return _default
+
+
+def span(name: str, **meta):
+    return _default.span(name, **meta)
+
+
+def traced(name: str | None = None):
+    return _default.traced(name)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """XLA device profiling around a hot region (TensorBoard-loadable).
+    The TPU-side complement of the host spans."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -18,7 +18,7 @@ declares it but never implements it — kube_dtn.proto:171).
 from __future__ import annotations
 
 import threading
-from collections import deque
+from collections import Counter, deque
 from concurrent import futures
 from dataclasses import dataclass, field
 
@@ -100,6 +100,16 @@ class Daemon:
         self.engine = engine
         self.wires = WireManager()
         self.hist = latency_histograms
+        # Per-protocol ingress counters via the native frame classifier —
+        # the per-packet role of the reference's DecodeFrame debug logging
+        # (grpcwire.go:429-450), kept as cheap counters instead of strings.
+        self.frame_stats: Counter[str] = Counter()
+        try:
+            from kubedtn_tpu import native as _native
+            self._classify = (_native.classify_batch
+                              if _native.have_native() else None)
+        except Exception:
+            self._classify = None
 
     # -- Local ---------------------------------------------------------
 
@@ -245,6 +255,8 @@ class Daemon:
             while wire.ingress and len(frames) < max_per_wire:
                 frames.append(wire.ingress.popleft())
             if frames:
+                if self._classify is not None:
+                    self.frame_stats.update(self._classify(frames))
                 out.append((row, [len(f) for f in frames], frames))
         return out
 
@@ -271,7 +283,8 @@ def _handler(fn, req_cls, resp_cls, streaming: bool):
 
 
 def make_server(daemon: Daemon, port: int = DEFAULT_PORT,
-                max_workers: int = 16) -> tuple[grpc.Server, int]:
+                max_workers: int = 16,
+                host: str = "0.0.0.0") -> tuple[grpc.Server, int]:
     """Build the gRPC server with the three reference services."""
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     tables = [
@@ -288,5 +301,8 @@ def make_server(daemon: Daemon, port: int = DEFAULT_PORT,
             grpc.method_handlers_generic_handler(
                 f"{pb.PACKAGE}.{service}", handlers),
         ))
-    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    # all interfaces by default: peer daemons (Remote.Update) and the
+    # physical-join CLI dial in from other hosts, like the reference's
+    # :51111 listener (daemon/kubedtn/kubedtn.go:104)
+    bound = server.add_insecure_port(f"{host}:{port}")
     return server, bound

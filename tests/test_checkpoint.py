@@ -1,0 +1,149 @@
+"""Checkpoint/resume + elastic recovery tests.
+
+Covers both recovery modes of SURVEY.md §5.3-5.4: reconstruction from the
+store alone (the reference's daemon-restart resync) and full checkpoint
+restore (store + registries + device arrays, incl. mutable shaping state)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubedtn_tpu import checkpoint
+from kubedtn_tpu.api.types import Link, LinkProperties, load_yaml
+from kubedtn_tpu.ops import netem
+from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+
+THREE_NODE = "/root/reference/config/samples/3node.yml"
+
+
+def build_three_node():
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=64)
+    topos = load_yaml(THREE_NODE)
+    for t in topos:
+        store.create(t)
+    for t in topos:
+        engine.setup_pod(t.name, t.namespace)
+    return store, engine, topos
+
+
+def engine_fingerprint(engine: SimEngine):
+    return {
+        "rows": dict(engine._rows),
+        "peer": dict(engine._peer),
+        "pod_ids": dict(engine._pod_ids),
+        "alive": set(engine._topology_manager),
+        "num_active": engine.num_active,
+    }
+
+
+def test_rebuild_engine_reconstruction():
+    """Daemon restart: device arrays are rebuildable from the store."""
+    store, engine, _ = build_three_node()
+    before = engine_fingerprint(engine)
+
+    rebuilt = checkpoint.rebuild_engine(store, capacity=64)
+    after = engine_fingerprint(rebuilt)
+
+    assert after["alive"] == before["alive"]
+    assert after["num_active"] == before["num_active"]
+    assert set(after["rows"]) == set(before["rows"])
+    # realized properties survive reconstruction
+    for (pod, uid) in before["rows"]:
+        a = engine.link_row(pod, uid)
+        b = rebuilt.link_row(pod, uid)
+        for k in a:
+            if k != "row":  # row placement may differ; semantics may not
+                assert a[k] == b[k], (pod, uid, k)
+
+
+def test_rebuild_skips_dead_pods():
+    store, engine, topos = build_three_node()
+    engine.destroy_pod(topos[0].name, topos[0].namespace)
+    rebuilt = checkpoint.rebuild_engine(store, capacity=64)
+    dead_key = f"{topos[0].namespace or 'default'}/{topos[0].name}"
+    assert all(pod != dead_key for pod, _ in rebuilt._rows)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    store, engine, topos = build_three_node()
+    # advance mutable shaping state so restore has something to preserve
+    E = engine.state.capacity
+    sizes = jnp.full((E,), 1500.0, jnp.float32)
+    have = engine.state.active.copy()  # donated below; alias would dangle
+    engine.state, _ = netem.shape_step(engine.state, sizes, have,
+                                       jnp.zeros((E,), jnp.float32),
+                                       jax.random.key(0))
+    before = engine_fingerprint(engine)
+    state_before = jax.tree.map(np.asarray, engine.state)
+
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, store, engine)
+    store2, engine2 = checkpoint.load(path)
+
+    assert engine_fingerprint(engine2) == before
+    for f in dataclasses.fields(engine.state):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(engine2.state, f.name)),
+            getattr(state_before, f.name), err_msg=f.name)
+    # store round-trips spec+status+metadata
+    for t in store.list():
+        t2 = store2.get(t.namespace, t.name)
+        assert t2.spec.links == t.spec.links
+        assert t2.status.src_ip == t.status.src_ip
+        assert t2.finalizers == t.finalizers
+        assert t2.resource_version == t.resource_version
+
+
+def test_restored_engine_keeps_working(tmp_path):
+    """Resume then mutate: the restored engine accepts new reconciles."""
+    store, engine, topos = build_three_node()
+    # reach steady state (status.links populated) before checkpointing, so
+    # the post-restore reconcile is a real diff, not the first-seen rule
+    rec0 = Reconciler(store, engine)
+    for t in topos:
+        rec0.reconcile(t.namespace, t.name)
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, store, engine)
+    store2, engine2 = checkpoint.load(path)
+
+    # update link properties through the reconciler on the restored pair
+    rec = Reconciler(store2, engine2)
+    t = store2.get(topos[0].namespace, topos[0].name)
+    new_links = [dataclasses.replace(
+        l, properties=LinkProperties(latency="42ms")) for l in t.spec.links]
+    t.spec.links = new_links
+    store2.update(t)
+    rec.reconcile(t.namespace, t.name)
+
+    row = engine2.link_row(t.key, t.spec.links[0].uid)
+    assert row is not None and row["latency_us"] == 42000.0
+
+
+def test_checkpoint_with_sim_state(tmp_path):
+    from kubedtn_tpu.models.traffic import cbr_everywhere
+    from kubedtn_tpu import sim as S
+
+    store, engine, _ = build_three_node()
+    spec = cbr_everywhere(engine.state.capacity, engine.num_active,
+                          rate_bps=1e6, pkt_bytes=500.0)
+    sim = S.init_sim(engine.state)
+    sim = S.run(sim, spec, steps=5, dt_us=1000.0, k_slots=2)
+    engine.state = sim.edges  # run() donates; re-adopt the live arrays
+
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, store, engine, sim=sim)
+    _, engine2 = checkpoint.load(path)
+    sim2 = checkpoint.load_sim(path, engine2)
+
+    assert sim2 is not None
+    np.testing.assert_array_equal(np.asarray(sim2.counters.tx_packets),
+                                  np.asarray(sim.counters.tx_packets))
+    clock2 = float(sim2.clock_us)
+    assert clock2 == float(sim.clock_us)
+    # and it still steps (sim_step donates sim2)
+    sim3, _ = S.sim_step(sim2, spec, jax.random.key(1), 2,
+                         jnp.float32(1000.0))
+    assert float(sim3.clock_us) > clock2

@@ -1,0 +1,170 @@
+"""CNI shim protocol tests (against a live daemon on a loopback port) and
+tracing subsystem tests."""
+
+import json
+
+import pytest
+
+from kubedtn_tpu import cni
+from kubedtn_tpu.api.types import load_yaml
+from kubedtn_tpu.topology import SimEngine, TopologyStore
+from kubedtn_tpu.utils import tracing
+from kubedtn_tpu.wire.server import Daemon, make_server
+
+THREE_NODE = "/root/reference/config/samples/3node.yml"
+
+
+@pytest.fixture()
+def daemon_port():
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=64)
+    for t in load_yaml(THREE_NODE):
+        store.create(t)
+    daemon = Daemon(engine)
+    server, port = make_server(daemon, port=0)
+    server.start()
+    yield port, engine
+    server.stop(0)
+
+
+def conf(port: int, prev=None) -> str:
+    d = {"cniVersion": "1.0.0", "name": "k8s-pod-network",
+         "type": "kubedtn", "daemonPort": port}
+    if prev is not None:
+        d["prevResult"] = prev
+    return json.dumps(d)
+
+
+def env_for(cmd: str, pod: str, ns: str = "default") -> dict:
+    return {
+        "CNI_COMMAND": cmd,
+        "CNI_ARGS": f"IgnoreUnknown=1;K8S_POD_NAMESPACE={ns};"
+                    f"K8S_POD_NAME={pod}",
+        "CNI_NETNS": f"/var/run/netns/{pod}",
+        "CNI_CONTAINERID": "abc123",
+    }
+
+
+def test_cmd_add_realizes_pod(daemon_port, capsys):
+    port, engine = daemon_port
+    prev = {"cniVersion": "1.0.0", "ips": [{"address": "10.244.0.7/24"}]}
+    rc = cni.main(stdin_text=conf(port, prev), env=env_for("ADD", "r1"))
+    assert rc == 0
+    # chained prevResult is passed through on stdout
+    out = json.loads(capsys.readouterr().out)
+    assert out == prev
+    assert engine.is_alive("default/r1")
+
+
+def test_add_then_peer_plumbs_links(daemon_port, capsys):
+    port, engine = daemon_port
+    cni.main(stdin_text=conf(port), env=env_for("ADD", "r1"))
+    cni.main(stdin_text=conf(port), env=env_for("ADD", "r2"))
+    capsys.readouterr()
+    # r1<->r2 link realized by whichever pod came up last
+    assert engine.num_active >= 2
+
+
+def test_non_topology_pod_errors_but_del_is_silent(daemon_port, capsys):
+    port, engine = daemon_port
+    # SetupPod returns True for unknown pods (delegate), so ADD succeeds
+    rc = cni.main(stdin_text=conf(port), env=env_for("ADD", "not-a-twin"))
+    assert rc == 0
+    capsys.readouterr()
+    # DEL of an unknown pod must never fail pod teardown
+    rc = cni.main(stdin_text=conf(port), env=env_for("DEL", "not-a-twin"))
+    assert rc == 0
+
+
+def test_cmd_del(daemon_port, capsys):
+    port, engine = daemon_port
+    cni.main(stdin_text=conf(port), env=env_for("ADD", "r1"))
+    cni.main(stdin_text=conf(port), env=env_for("ADD", "r2"))
+    rc = cni.main(stdin_text=conf(port), env=env_for("DEL", "r1"))
+    capsys.readouterr()
+    assert rc == 0
+    assert not engine.is_alive("default/r1")
+
+
+def test_version(capsys):
+    rc = cni.main(stdin_text="", env={"CNI_COMMAND": "VERSION"})
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "1.0.0" in out["supportedVersions"]
+
+
+def test_check_noop(daemon_port):
+    port, _ = daemon_port
+    assert cni.main(stdin_text=conf(port), env=env_for("CHECK", "r1")) == 0
+
+
+def test_conflist_install_merge_and_remove(tmp_path):
+    primary = {"cniVersion": "0.3.1", "name": "cbr0",
+               "plugins": [{"type": "flannel"}]}
+    (tmp_path / "10-flannel.conflist").write_text(json.dumps(primary))
+
+    out = cni.install_conflist(str(tmp_path), inter_node_link_type="GRPC",
+                               daemon_port=5151)
+    merged = json.loads(open(out).read())
+    types = [p["type"] for p in merged["plugins"]]
+    assert types == ["flannel", "kubedtn"]   # chained after the primary
+    assert merged["plugins"][1]["daemonPort"] == 5151
+    assert cni.inter_node_link_type(str(tmp_path)) == "GRPC"
+
+    # idempotent: re-install doesn't duplicate the plugin
+    cni.install_conflist(str(tmp_path))
+    merged = json.loads(open(out).read())
+    assert [p["type"] for p in merged["plugins"]].count("kubedtn") == 1
+
+    cni.remove_conflist(str(tmp_path))
+    assert not (tmp_path / cni.CONFLIST_NAME).exists()
+    assert cni.inter_node_link_type(str(tmp_path)) == "VXLAN"  # default
+
+
+def test_wrap_bare_conf(tmp_path):
+    (tmp_path / "05-bridge.conf").write_text(json.dumps(
+        {"cniVersion": "0.4.0", "name": "bridge", "type": "bridge"}))
+    out = cni.install_conflist(str(tmp_path))
+    merged = json.loads(open(out).read())
+    assert [p["type"] for p in merged["plugins"]] == ["bridge", "kubedtn"]
+
+
+# ---- tracing --------------------------------------------------------
+
+def test_spans_nest_and_aggregate():
+    tr = tracing.Tracer()
+    with tr.span("reconcile"):
+        with tr.span("add-links", n=3):
+            pass
+        with tr.span("status-copy"):
+            pass
+    spans = tr.spans()
+    by_name = {s.name: s for s in spans}
+    assert by_name["add-links"].depth == 1
+    assert by_name["reconcile"].depth == 0
+    assert by_name["add-links"].meta == {"n": 3}
+    stats = tr.stats()
+    assert stats["reconcile"]["count"] == 1
+    assert stats["reconcile"]["total_ms"] >= stats["add-links"]["total_ms"]
+
+
+def test_traced_decorator_and_export(tmp_path):
+    tr = tracing.Tracer()
+
+    @tr.traced("work")
+    def work(x):
+        return x * 2
+
+    assert work(21) == 42
+    path = str(tmp_path / "trace.json")
+    tr.export_chrome(path)
+    data = json.load(open(path))
+    assert data["traceEvents"][0]["name"] == "work"
+    assert data["traceEvents"][0]["ph"] == "X"
+
+
+def test_disabled_tracer_is_free():
+    tr = tracing.Tracer(enabled=False)
+    with tr.span("x"):
+        pass
+    assert tr.spans() == []

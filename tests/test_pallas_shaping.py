@@ -1,0 +1,146 @@
+"""Parity tests: the fused Pallas shaping kernel vs the vmapped reference
+path (kubedtn_tpu.ops.netem.shape_step), interpret mode on CPU.
+
+Given the same PRNG key both paths draw identical uniforms, so every output
+— departure times, all six outcome flags, and the full mutable shaping
+state — must agree elementwise."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubedtn_tpu.api.types import LinkProperties
+from kubedtn_tpu.models.topologies import fat_tree, load_edge_list_into_state
+from kubedtn_tpu.ops import edge_state as es
+from kubedtn_tpu.ops import netem
+from kubedtn_tpu.ops.pallas import shaping
+
+
+def random_state(capacity: int, seed: int, active_frac: float = 0.9):
+    """EdgeState with randomized-but-plausible properties and live state."""
+    rng = np.random.default_rng(seed)
+    E = capacity
+    props = np.zeros((E, es.NPROP), np.float32)
+    props[:, es.P_LATENCY_US] = rng.integers(0, 100_000, E)
+    props[:, es.P_LATENCY_CORR] = rng.choice([0, 25, 75], E)
+    props[:, es.P_JITTER_US] = rng.choice([0, 0, 1000, 5000], E)
+    props[:, es.P_LOSS] = rng.choice([0, 0, 1, 25, 100], E)
+    props[:, es.P_LOSS_CORR] = rng.choice([0, 50], E)
+    props[:, es.P_RATE_BPS] = rng.choice([0, 20e6, 1e9, 10e9], E)
+    props[:, es.P_GAP] = rng.choice([0, 0, 2, 5], E)
+    props[:, es.P_DUPLICATE] = rng.choice([0, 0, 10, 50], E)
+    props[:, es.P_DUPLICATE_CORR] = rng.choice([0, 30], E)
+    props[:, es.P_REORDER_PROB] = rng.choice([0, 0, 25], E)
+    props[:, es.P_REORDER_CORR] = rng.choice([0, 40], E)
+    props[:, es.P_CORRUPT_PROB] = rng.choice([0, 0, 5], E)
+    props[:, es.P_CORRUPT_CORR] = rng.choice([0, 20], E)
+
+    state = es.init_state(capacity)
+    state = dataclasses.replace(
+        state,
+        uid=jnp.arange(E, dtype=jnp.int32),
+        src=jnp.asarray(rng.integers(0, 64, E), jnp.int32),
+        dst=jnp.asarray(rng.integers(0, 64, E), jnp.int32),
+        active=jnp.asarray(rng.random(E) < active_frac),
+        props=jnp.asarray(props),
+        tokens=jnp.asarray(rng.uniform(0, 1e6, E).astype(np.float32)),
+        t_last=jnp.asarray(rng.uniform(-1e4, 0, E).astype(np.float32)),
+        corr=jnp.asarray(rng.random((E, es.NCORR)).astype(np.float32)),
+        pkt_count=jnp.asarray(rng.integers(0, 6, E), jnp.int32),
+        backlog_until=jnp.asarray(rng.uniform(0, 1e4, E).astype(np.float32)),
+    )
+    return state
+
+
+def assert_state_close(a: es.EdgeState, b: es.EdgeState):
+    for f in dataclasses.fields(es.EdgeState):
+        x = np.asarray(getattr(a, f.name))
+        y = np.asarray(getattr(b, f.name))
+        np.testing.assert_allclose(x, y, rtol=1e-6, atol=1e-3,
+                                   err_msg=f.name)
+
+
+def assert_result_equal(a: netem.ShapeResult, b: netem.ShapeResult):
+    for f in dataclasses.fields(netem.ShapeResult):
+        x = np.asarray(getattr(a, f.name))
+        y = np.asarray(getattr(b, f.name))
+        if x.dtype == bool:
+            np.testing.assert_array_equal(x, y, err_msg=f.name)
+        else:
+            np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-2,
+                                       err_msg=f.name)
+
+
+@pytest.mark.parametrize("capacity,seed", [(1024, 0), (2048, 1), (8192, 2)])
+def test_parity_random_states(capacity, seed):
+    state = random_state(capacity, seed)
+    rng = np.random.default_rng(seed + 100)
+    sizes = jnp.asarray(rng.choice([64, 512, 1500], capacity)
+                        .astype(np.float32))
+    have = jnp.asarray(rng.random(capacity) < 0.8)
+    t_arr = jnp.asarray(rng.uniform(0, 1000, capacity).astype(np.float32))
+    key = jax.random.key(seed)
+
+    ref_state, ref_res = netem.shape_step.__wrapped__(
+        state, sizes, have, t_arr, key)
+    pl_state, pl_res = shaping.shape_step(state, sizes, have, t_arr, key,
+                                          interpret=True)
+    assert_result_equal(ref_res, pl_res)
+    assert_state_close(ref_state, pl_state)
+
+
+def test_parity_capacity_not_tile_multiple():
+    """Capacities below / not divisible by the 64x128 tile get padded."""
+    for cap in (64, 192, 1536):
+        state = random_state(cap, seed=cap)
+        sizes = jnp.full((cap,), 1500.0, jnp.float32)
+        have = jnp.ones((cap,), bool)
+        t_arr = jnp.zeros((cap,), jnp.float32)
+        key = jax.random.key(7)
+        ref_state, ref_res = netem.shape_step.__wrapped__(
+            state, sizes, have, t_arr, key)
+        pl_state, pl_res = shaping.shape_step(state, sizes, have, t_arr, key,
+                                              interpret=True)
+        assert_result_equal(ref_res, pl_res)
+        assert_state_close(ref_state, pl_state)
+
+
+def test_parity_on_real_topology():
+    """The flagship fat-tree state through both paths."""
+    props = LinkProperties(latency="10ms", jitter="1ms", loss="0.5",
+                           rate="1Gbit")
+    el = fat_tree(8, props)
+    state, rows = load_edge_list_into_state(el, capacity=1024)
+    E = state.capacity
+    sizes = jnp.full((E,), 1500.0, jnp.float32)
+    have = jnp.asarray(np.arange(E) < len(rows))
+    t_arr = jnp.zeros((E,), jnp.float32)
+    key = jax.random.key(3)
+
+    ref_state, ref_res = netem.shape_step.__wrapped__(
+        state, sizes, have, t_arr, key)
+    pl_state, pl_res = shaping.shape_step(state, sizes, have, t_arr, key,
+                                          interpret=True)
+    assert_result_equal(ref_res, pl_res)
+    assert_state_close(ref_state, pl_state)
+    assert int(np.asarray(pl_res.delivered).sum()) > 0
+
+
+def test_inactive_and_no_packet_lanes_untouched():
+    state = random_state(1024, seed=9, active_frac=0.5)
+    sizes = jnp.full((1024,), 100.0, jnp.float32)
+    have = jnp.asarray(np.arange(1024) % 2 == 0)
+    t_arr = jnp.zeros((1024,), jnp.float32)
+    key = jax.random.key(11)
+    new_state, res = shaping.shape_step(state, sizes, have, t_arr, key,
+                                        interpret=True)
+    idle = ~np.asarray(have & state.active)
+    assert not np.asarray(res.delivered)[idle].any()
+    assert np.isinf(np.asarray(res.depart_us)[idle]).all()
+    np.testing.assert_array_equal(np.asarray(new_state.tokens)[idle],
+                                  np.asarray(state.tokens)[idle])
+    np.testing.assert_array_equal(np.asarray(new_state.pkt_count)[idle],
+                                  np.asarray(state.pkt_count)[idle])
