@@ -1,0 +1,26 @@
+# kubedtn-tpu daemon image (deployment-parity with the reference's
+# docker/Dockerfile.cni multi-stage build: native artifacts compiled in a
+# builder stage, slim runtime stage).
+#
+# Stage 1: build the C++ runtime library.
+FROM debian:bookworm-slim AS native-build
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY native/ native/
+RUN make -C native
+
+# Stage 2: runtime. Pin a JAX version matching the target TPU runtime;
+# on TPU node pools install the libtpu wheel instead of the CPU extra.
+FROM python:3.11-slim
+RUN pip install --no-cache-dir "jax[cpu]" pyyaml grpcio protobuf \
+        prometheus-client
+WORKDIR /app
+COPY kubedtn_tpu/ kubedtn_tpu/
+COPY bench.py ./
+COPY config/ config/
+COPY --from=native-build /src/native/libkubedtn_native.so native/
+ENV GRPC_PORT=51111 HTTP_ADDR=51112
+EXPOSE 51111 51112
+ENTRYPOINT ["python", "-m", "kubedtn_tpu.cli"]
+CMD ["daemon"]

@@ -1,0 +1,39 @@
+# kubedtn-tpu top-level targets (build/test/bench parity with the
+# reference's Makefile + .mk/ tree, minus the Go/buf/kustomize toolchain
+# the TPU architecture doesn't need).
+
+PY ?= python
+
+.PHONY: all test test-fast bench native crd daemon scenario-% docker clean
+
+all: native test
+
+test: native               ## full suite (CPU, virtual 8-device mesh)
+	$(PY) -m pytest tests/ -q
+
+test-fast:                 ## skip the slow sharded/e2e tests
+	$(PY) -m pytest tests/ -q -m "not slow" 2>/dev/null || \
+	$(PY) -m pytest tests/ -q -x
+
+bench:                     ## headline metric (one JSON line)
+	$(PY) bench.py
+
+native:                    ## C++ runtime library
+	$(MAKE) -C native
+
+crd:                       ## regenerate the checked-in CRD manifest
+	$(PY) -m kubedtn_tpu.cli crd > config/crd/.topologies.yaml.tmp
+	mv config/crd/.topologies.yaml.tmp config/crd/topologies.yaml
+
+daemon:                    ## run the gRPC control plane + metrics
+	$(PY) -m kubedtn_tpu.cli daemon
+
+scenario-%:                ## run a BASELINE ladder rung, e.g. make scenario-clos_100k
+	$(PY) -m kubedtn_tpu.cli scenario $*
+
+docker:                    ## container image for the daemon DaemonSet
+	docker build -t kubedtn-tpu:latest .
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

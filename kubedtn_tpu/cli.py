@@ -19,6 +19,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 
 
@@ -98,12 +99,29 @@ def cmd_scenario(args) -> int:
     return 0
 
 
+def _env_port(var: str, default: int) -> int:
+    """Port from an env var that may be a bare port, ':port', or
+    'host:port' (the reference's HTTP_ADDR forms, daemon/main.go:27-40)."""
+    raw = os.environ.get(var, "")
+    if not raw:
+        return default
+    try:
+        return int(raw.rsplit(":", 1)[-1])
+    except ValueError:
+        raise SystemExit(f"{var}={raw!r}: not a port")
+
+
 def cmd_daemon(args) -> int:
     from kubedtn_tpu.metrics.metrics import MetricsServer, make_registry
     from kubedtn_tpu.topology import SimEngine, TopologyStore
     from kubedtn_tpu.wire.server import Daemon, make_server
 
     from kubedtn_tpu.runtime import WireDataPlane
+
+    if args.port is None:
+        args.port = _env_port("GRPC_PORT", 51111)
+    if args.metrics_port is None:
+        args.metrics_port = _env_port("HTTP_ADDR", 51112)
 
     store = TopologyStore()
     engine = SimEngine(store, node_ip=args.node_ip)
@@ -162,6 +180,17 @@ def cmd_physical_join(args) -> int:
     return 0 if resp.response else 1
 
 
+def cmd_crd(args) -> int:
+    """Print the Topology CRD manifest rendered from the API types
+    (reference config/crd/bases/, rendered copy at cni.yaml:14-280)."""
+    import yaml
+
+    from kubedtn_tpu.api.crd import render_crd
+
+    print(yaml.safe_dump(render_crd(), sort_keys=False))
+    return 0
+
+
 def cmd_bench(args) -> int:
     # bench.py lives at the repo root, not in the package: anchor the
     # import so `python -m kubedtn_tpu.cli bench` works from any cwd
@@ -196,11 +225,19 @@ def main(argv=None) -> int:
     sp.add_argument("name")
     sp.set_defaults(fn=cmd_scenario)
 
+    # Env-var defaults keep the reference daemon's config surface
+    # (reference daemon/main.go:27-40: GRPC_PORT, HTTP_ADDR, HOST_IP).
+    # None defaults — the env is resolved inside cmd_daemon so a malformed
+    # variable yields a daemon-scoped error, not a crash of every command.
     dp = sub.add_parser("daemon", help="serve the gRPC control plane")
-    dp.add_argument("--port", type=int, default=51111)
-    dp.add_argument("--metrics-port", type=int, default=51112)
-    dp.add_argument("--node-ip", default="10.0.0.1")
+    dp.add_argument("--port", type=int, default=None)
+    dp.add_argument("--metrics-port", type=int, default=None)
+    dp.add_argument("--node-ip",
+                    default=os.environ.get("HOST_IP", "10.0.0.1"))
     dp.set_defaults(fn=cmd_daemon)
+
+    cp = sub.add_parser("crd", help="render the Topology CRD manifest")
+    cp.set_defaults(fn=cmd_crd)
 
     jp = sub.add_parser("physical-join",
                         help="join a physical host via a daemon")
@@ -212,7 +249,12 @@ def main(argv=None) -> int:
     bp.set_defaults(fn=cmd_bench)
 
     args = p.parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # downstream pipe (e.g. `| head`) closed early — normal for a CLI
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
 
 
 if __name__ == "__main__":
