@@ -82,6 +82,13 @@ def _declare(lib) -> None:
         "kdt_rb_pop": (c.c_int64, [c.c_void_p, u8p, c.c_uint64]),
         "kdt_rb_count": (c.c_uint64, [c.c_void_p]),
         "kdt_rb_dropped": (c.c_uint64, [c.c_void_p]),
+        "kdt_tw_new": (c.c_void_p, [c.c_uint64, c.c_uint32, c.c_uint32]),
+        "kdt_tw_free": (None, [c.c_void_p]),
+        "kdt_tw_schedule": (None, [c.c_void_p, c.c_uint64, c.c_uint64]),
+        "kdt_tw_advance": (c.c_int64, [c.c_void_p, c.c_uint64, u64p,
+                                       c.c_int64]),
+        "kdt_tw_size": (c.c_uint64, [c.c_void_p]),
+        "kdt_tw_next_due_us": (c.c_uint64, [c.c_void_p]),
     }
     for name, (restype, argtypes) in sigs.items():
         fn = getattr(lib, name)
@@ -96,12 +103,14 @@ def _load():
             return _lib
         if _build_error is not None:
             raise NativeUnavailable(_build_error)
-        if not os.path.exists(_LIB_PATH):
-            try:
-                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                               capture_output=True, text=True, timeout=120)
-            except (subprocess.CalledProcessError, OSError,
-                    subprocess.TimeoutExpired) as e:
+        # Run make even when the .so exists: it is a no-op when current and
+        # rebuilds a stale artifact (one missing newer kdt_* symbols).
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True, text=True, timeout=120)
+        except (subprocess.CalledProcessError, OSError,
+                subprocess.TimeoutExpired) as e:
+            if not os.path.exists(_LIB_PATH):
                 detail = getattr(e, "stderr", "") or str(e)
                 _build_error = f"native build failed: {detail}"
                 raise NativeUnavailable(_build_error) from e
@@ -110,6 +119,10 @@ def _load():
             _declare(lib)
         except OSError as e:
             _build_error = f"native load failed: {e}"
+            raise NativeUnavailable(_build_error) from e
+        except AttributeError as e:
+            # stale library without a newly added symbol
+            _build_error = f"native library out of date: {e}"
             raise NativeUnavailable(_build_error) from e
         _lib = lib
         return lib
@@ -258,3 +271,47 @@ class FrameRing:
     @property
     def dropped(self) -> int:
         return self._lib.kdt_rb_dropped(self._h)
+
+
+class TimingWheel:
+    """Hashed hierarchical timing wheel (native): O(1) schedule/advance
+    delay-line release for the real-time data plane. Tokens are opaque
+    uint64s; `advance(now_us)` returns every token whose deadline passed,
+    time-ordered. `next_due_us()` is a lower bound on the next release —
+    safe to sleep until."""
+
+    def __init__(self, tick_us: int = 1000, bits: int = 8,
+                 levels: int = 4) -> None:
+        self._lib = _load()
+        self._h = self._lib.kdt_tw_new(tick_us, bits, levels)
+        self._out = (ctypes.c_uint64 * 4096)()
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kdt_tw_free(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def schedule(self, when_us: int, token: int) -> None:
+        self._lib.kdt_tw_schedule(self._h, max(0, int(when_us)), token)
+
+    def advance(self, now_us: int) -> list[int]:
+        out: list[int] = []
+        while True:
+            n = self._lib.kdt_tw_advance(self._h, int(now_us), self._out,
+                                         len(self._out))
+            out.extend(self._out[:n])
+            if n < len(self._out):
+                return out
+
+    def next_due_us(self) -> int | None:
+        v = self._lib.kdt_tw_next_due_us(self._h)
+        return None if v == (1 << 64) - 1 else v
+
+    def __len__(self) -> int:
+        return self._lib.kdt_tw_size(self._h)

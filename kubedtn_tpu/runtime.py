@@ -13,6 +13,11 @@ clock (the "real-time binding" of SURVEY.md §7 hard-part (e)).
 Cumulative per-edge counters feed the Prometheus interface collector, so a
 daemon's metrics are live whenever wires carry traffic (the reference's
 per-netns statistics scrape, daemon/metrics/interface_statistics.go:79-133).
+
+Delayed releases are held in the native hierarchical timing wheel
+(native/kubedtn_native.cc, via kubedtn_tpu.native.TimingWheel) — the role
+the kernel's qdisc watchdog plays for netem's tfifo in the reference — with
+a pure-Python heap fallback when the native library is unavailable.
 """
 
 from __future__ import annotations
@@ -25,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubedtn_tpu import native
 from kubedtn_tpu.ops import netem
 from kubedtn_tpu.ops.queues import EdgeCounters, init_counters
 
@@ -41,6 +47,16 @@ class WireDataPlane:
         self._key = jax.random.key(seed)
         self._heap: list = []          # (release_s, seq, pod_key, uid, frame)
         self._seq = 0
+        # wheel time is µs since the first tick's clock (which may be the
+        # wall clock or a synthetic test clock); token → payload map held
+        # Python-side, the wheel orders and releases
+        self._origin_s: float | None = None
+        self._pending: dict[int, tuple[str, int, bytes]] = {}
+        try:
+            self._wheel: native.TimingWheel | None = native.TimingWheel(
+                tick_us=1000)
+        except native.NativeUnavailable:
+            self._wheel = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.counters: EdgeCounters = init_counters(
@@ -56,6 +72,8 @@ class WireDataPlane:
         Returns the number of frames shaped this tick."""
         if now_s is None:
             now_s = time.monotonic()
+        if self._origin_s is None:
+            self._origin_s = now_s
         batches = self.daemon.drain_ingress(max_per_wire=self.max_slots)
         shaped = 0
         if batches:
@@ -101,9 +119,16 @@ class WireDataPlane:
                         target = rowinfo.get(row)
                         if target is not None:
                             self._seq += 1
-                            heapq.heappush(
-                                self._heap,
-                                (now_s + delay_s, self._seq, *target, frame))
+                            if self._wheel is not None:
+                                self._pending[self._seq] = (*target, frame)
+                                self._wheel.schedule(
+                                    (now_s + delay_s - self._origin_s) * 1e6,
+                                    self._seq)
+                            else:
+                                heapq.heappush(
+                                    self._heap,
+                                    (now_s + delay_s, self._seq, *target,
+                                     frame))
                         shaped += 1
                     else:
                         self.dropped += 1
@@ -136,6 +161,11 @@ class WireDataPlane:
         )
 
     def _release(self, now_s: float) -> None:
+        if self._wheel is not None:
+            for token in self._wheel.advance((now_s - self._origin_s) * 1e6):
+                pod_key, uid, frame = self._pending.pop(token)
+                self.daemon.deliver_egress(pod_key, uid, frame)
+            return
         while self._heap and self._heap[0][0] <= now_s:
             _, _, pod_key, uid, frame = heapq.heappop(self._heap)
             self.daemon.deliver_egress(pod_key, uid, frame)

@@ -248,3 +248,114 @@ def test_ring_spsc_threads():
     t.join(timeout=30)
     assert len(got) == n
     assert [struct.unpack(">I", f)[0] for f in got] == list(range(n))
+
+
+# ---- timing wheel ---------------------------------------------------
+
+def test_wheel_basic_order_and_due_time():
+    tw = native.TimingWheel(tick_us=100)
+    tw.schedule(5_000, 1)
+    tw.schedule(1_000, 2)
+    tw.schedule(9_000, 3)
+    assert len(tw) == 3
+    assert tw.advance(500) == []
+    assert tw.advance(1_100) == [2]
+    assert tw.advance(10_000) == [1, 3]   # time-ordered
+    assert len(tw) == 0
+    assert tw.next_due_us() is None
+
+
+def test_wheel_immediate_and_past_deadlines():
+    tw = native.TimingWheel(tick_us=1000)
+    tw.advance(50_000)
+    tw.schedule(10_000, 7)      # already past
+    tw.schedule(50_000, 8)      # exactly now
+    assert tw.advance(50_000) == [7, 8]
+
+
+def test_wheel_levels_cascade():
+    """Deadlines spanning all wheel levels release exactly once, never
+    early (beyond tick granularity), across random advance steps."""
+    import random
+
+    rng = random.Random(7)
+    tick = 1000
+    tw = native.TimingWheel(tick_us=tick, bits=4, levels=3)  # tiny wheels
+    events = {tok: rng.randint(0, 3_000_000) for tok in range(2000)}
+    for tok, when in events.items():
+        tw.schedule(when, tok)
+    released = {}
+    now = 0
+    while now < 3_100_000:
+        now += rng.randint(1, 50_000)
+        for tok in tw.advance(now):
+            assert tok not in released
+            assert events[tok] <= now + tick - 1, (events[tok], now)
+            released[tok] = now
+    assert len(released) == 2000
+    assert len(tw) == 0
+
+
+def test_wheel_next_due_is_lower_bound():
+    tw = native.TimingWheel(tick_us=100, bits=4, levels=3)
+    tw.schedule(250, 1)
+    nd = tw.next_due_us()
+    assert nd is not None and nd <= 300   # slot granularity upper slack
+    assert tw.advance(nd - 1) == [] or nd == 0
+    # far-future deadline: bound must still make progress (cascade point)
+    tw2 = native.TimingWheel(tick_us=100, bits=4, levels=3)
+    tw2.schedule(10_000_000, 9)
+    nd2 = tw2.next_due_us()
+    assert nd2 is not None and 0 < nd2 <= 10_000_000
+    assert tw2.advance(nd2) == []         # not due yet, just a checkpoint
+
+
+def test_wheel_interleaved_schedule_advance():
+    tw = native.TimingWheel(tick_us=1000)
+    out = []
+    for step in range(1, 101):
+        now = step * 10_000
+        tw.schedule(now + 25_000, step)
+        out.extend(tw.advance(now))
+    out.extend(tw.advance(10_000_000))
+    assert sorted(out) == list(range(1, 101))
+
+
+def test_wheel_past_deadlines_release_in_deadline_order():
+    """Past-due tokens come out in deadline order even when time does not
+    move forward between schedule and advance."""
+    tw = native.TimingWheel(tick_us=1000)
+    tw.advance(50_000)
+    tw.schedule(50_000, 8)
+    tw.schedule(10_000, 7)
+    assert tw.advance(50_000) == [7, 8]
+
+
+def test_wheel_never_releases_before_deadline_within_tick():
+    """A deadline inside the current tick quantum is held until reached —
+    the wheel must not undershoot emulated latency."""
+    tw = native.TimingWheel(tick_us=1000)
+    tw.advance(50_500)
+    tw.schedule(50_900, 1)       # current tick, 400us in the future
+    assert tw.advance(50_500) == []
+    assert tw.advance(50_899) == []
+    assert tw.advance(50_900) == [1]
+
+
+def test_wheel_strict_no_early_release_randomized():
+    import random
+
+    rng = random.Random(11)
+    tw = native.TimingWheel(tick_us=1000, bits=4, levels=3)
+    events = {tok: rng.randint(0, 500_000) for tok in range(500)}
+    for tok, when in events.items():
+        tw.schedule(when, tok)
+    released = set()
+    now = 0
+    while now < 600_000:
+        now += rng.randint(1, 7_000)
+        for tok in tw.advance(now):
+            assert events[tok] <= now, (events[tok], now)
+            assert tok not in released
+            released.add(tok)
+    assert len(released) == 500

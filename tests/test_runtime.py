@@ -160,3 +160,25 @@ def test_corruption_persists_across_hops():
     assert hop2_rx > 0
     assert hop2_corrupt == hop2_rx
     assert float(np.asarray(rs.node_rx_packets)[n_nodes - 1]) > 0
+
+
+def test_dataplane_uses_native_wheel_when_available():
+    """The delay line rides the native timing wheel (Python heap only as
+    fallback); pending frames drain through it and nothing leaks."""
+    from kubedtn_tpu import native
+
+    if not native.have_native():
+        import pytest
+        pytest.skip("native toolchain unavailable")
+    daemon, engine = make_daemon(LATENCY)
+    w1 = add_wire(daemon, "r1", 1)
+    w2 = add_wire(daemon, "r2", 1)
+    dp = WireDataPlane(daemon)
+    assert dp._wheel is not None
+    for i in range(5):
+        w1.ingress.append(b"\x02" * 64)
+        dp.tick(now_s=10.0 + i * 0.001)
+    assert len(dp._wheel) + len(w2.egress) == 5
+    dp.tick(now_s=10.5)  # all 10ms deadlines long past
+    assert len(w2.egress) == 5
+    assert len(dp._wheel) == 0 and not dp._pending
