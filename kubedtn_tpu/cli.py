@@ -180,6 +180,56 @@ def cmd_physical_join(args) -> int:
     return 0 if resp.response else 1
 
 
+def cmd_gen(args) -> int:
+    """Generate a topology-model family as Topology CR YAML (stdout or
+    file) — the generated-scenario counterpart of the reference's
+    hand-written sample files (reference config/samples/)."""
+    import yaml
+
+    from kubedtn_tpu.models.topologies import FAMILIES
+
+    fam = FAMILIES.get(args.family)
+    if fam is None:
+        print(f"unknown family {args.family!r}; choices: "
+              f"{', '.join(sorted(FAMILIES))}", file=sys.stderr)
+        return 1
+    kwargs = {}
+    for kv in args.param or []:
+        k, _, v = kv.partition("=")
+        try:
+            kwargs[k] = int(v)
+        except ValueError:
+            try:
+                kwargs[k] = float(v)
+            except ValueError:
+                kwargs[k] = v
+    # string-typed generator params must stay strings even when numeric
+    for key in ("rate",):
+        if key in kwargs:
+            kwargs[key] = str(kwargs[key])
+    try:
+        if "dims" in kwargs:  # torus dims as 4x4x2
+            kwargs["dims"] = tuple(
+                int(x) for x in str(kwargs["dims"]).split("x"))
+        el = fam(**kwargs)
+    except (TypeError, ValueError, AssertionError) as e:
+        import inspect
+
+        print(f"gen {args.family}: {e}\nsignature: "
+              f"{args.family}{inspect.signature(fam)}", file=sys.stderr)
+        return 1
+    docs = [t.to_manifest() for t in el.to_topologies()]
+    text = yaml.safe_dump_all(docs, sort_keys=False)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(json.dumps({"family": args.family, "nodes": el.n_nodes,
+                          "links": el.n_links, "file": args.out}))
+    else:
+        print(text)
+    return 0
+
+
 def cmd_crd(args) -> int:
     """Print the Topology CRD manifest rendered from the API types
     (reference config/crd/bases/, rendered copy at cni.yaml:14-280)."""
@@ -238,6 +288,13 @@ def main(argv=None) -> int:
 
     cp = sub.add_parser("crd", help="render the Topology CRD manifest")
     cp.set_defaults(fn=cmd_crd)
+
+    gp = sub.add_parser("gen", help="generate a topology family as YAML")
+    gp.add_argument("family")
+    gp.add_argument("-p", "--param", action="append", metavar="k=v",
+                    help="generator kwargs, e.g. -p k=8, -p dims=4x4")
+    gp.add_argument("-o", "--out", default=None)
+    gp.set_defaults(fn=cmd_gen)
 
     jp = sub.add_parser("physical-join",
                         help="join a physical host via a daemon")

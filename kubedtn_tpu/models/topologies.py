@@ -211,6 +211,172 @@ def clos(n_spine: int, n_leaf: int, hosts_per_leaf: int = 0,
     return _mk(names, pairs, props)
 
 
+def torus(dims: tuple[int, ...] | list[int],
+          props: LinkProperties | None = None) -> EdgeList:
+    """k-ary n-dimensional torus (wraparound grid) — the ICI topology of a
+    TPU pod itself, and a standard HPC interconnect. torus((4, 4)) = 16
+    nodes, 32 links; torus((4, 4, 4)) = 64 nodes, 192 links."""
+    dims = tuple(int(d) for d in dims)
+    assert all(d >= 2 for d in dims), "each torus dimension needs >= 2 nodes"
+    shape = np.array(dims)
+    n = int(shape.prod())
+    coords = np.stack(np.unravel_index(np.arange(n), dims), axis=1)
+    names = ["t" + "-".join(str(c) for c in row) for row in coords]
+    pairs = []
+    for axis, d in enumerate(dims):
+        nxt = coords.copy()
+        nxt[:, axis] = (nxt[:, axis] + 1) % d
+        nbr = np.ravel_multi_index(tuple(nxt.T), dims)
+        for i in range(n):
+            j = int(nbr[i])
+            # a dimension of size 2 has a single link per pair, not two
+            if d == 2 and j < i:
+                continue
+            pairs.append((i, j))
+    return _mk(names, pairs, props)
+
+
+def hypercube(d: int, props: LinkProperties | None = None) -> EdgeList:
+    """d-dimensional binary hypercube: 2^d nodes, d·2^(d-1) links."""
+    n = 1 << d
+    names = [f"h{i:0{max(d, 1)}b}" for i in range(n)]
+    pairs = [(i, i ^ (1 << bit)) for i in range(n) for bit in range(d)
+             if i < (i ^ (1 << bit))]
+    return _mk(names, pairs, props)
+
+
+def dragonfly(groups: int, routers_per_group: int,
+              global_links_per_router: int = 1,
+              props: LinkProperties | None = None) -> EdgeList:
+    """Dragonfly: fully-meshed groups joined by global links spread
+    round-robin over the routers of each group (the Cray/Slingshot-style
+    hierarchical low-diameter fabric)."""
+    g, a, h = groups, routers_per_group, global_links_per_router
+    assert g >= 2 and a >= 1 and h >= 1
+    names = [f"g{gi}-r{ri}" for gi in range(g) for ri in range(a)]
+    pairs = []
+    for gi in range(g):
+        base = gi * a
+        pairs.extend((base + i, base + j)
+                     for i in range(a) for j in range(i + 1, a))
+    # global channels: g·(g-1)/2 group pairs, each realized h times,
+    # endpoints rotated through the group's routers
+    counter = [0] * g
+    for gi in range(g):
+        for gj in range(gi + 1, g):
+            for _ in range(h):
+                ri = counter[gi] % a
+                rj = counter[gj] % a
+                counter[gi] += 1
+                counter[gj] += 1
+                pairs.append((gi * a + ri, gj * a + rj))
+    return _mk(names, pairs, props)
+
+
+def barabasi_albert(n: int, m: int = 2, seed: int = 0,
+                    props: LinkProperties | None = None) -> EdgeList:
+    """Scale-free preferential-attachment graph (Barabási–Albert): each
+    new node attaches to m existing nodes with probability proportional
+    to degree — heavy-tailed AS-/internet-like topologies."""
+    assert 1 <= m < n
+    rng = np.random.default_rng(seed)
+    names = [f"as{i}" for i in range(n)]
+    pairs: list[tuple[int, int]] = []
+    # attachment pool: every edge endpoint once (degree-proportional draw)
+    pool: list[int] = []
+    for new in range(m, n):
+        if not pool:
+            targets = list(range(new))[:m]
+        else:
+            targets = []
+            seen: set[int] = set()
+            while len(targets) < m:
+                t = int(pool[rng.integers(0, len(pool))])
+                if t not in seen and t != new:
+                    seen.add(t)
+                    targets.append(t)
+        for t in targets:
+            pairs.append((new, t))
+            pool.extend((new, t))
+    return _mk(names, pairs, props)
+
+
+def watts_strogatz(n: int, k: int = 4, beta: float = 0.1, seed: int = 0,
+                   props: LinkProperties | None = None) -> EdgeList:
+    """Small-world ring lattice with rewiring (Watts–Strogatz): each node
+    starts linked to its k nearest ring neighbors; each link's far end is
+    rewired with probability beta."""
+    assert k % 2 == 0 and k < n
+    rng = np.random.default_rng(seed)
+    names = [f"n{i}" for i in range(n)]
+    existing: set[tuple[int, int]] = set()
+    pairs = []
+    for i in range(n):
+        for off in range(1, k // 2 + 1):
+            j = (i + off) % n
+            key = (min(i, j), max(i, j))
+            if key in existing:
+                continue
+            if rng.random() < beta:
+                for _ in range(8):  # bounded retries
+                    cand = int(rng.integers(0, n))
+                    ck = (min(i, cand), max(i, cand))
+                    if cand != i and ck not in existing:
+                        key = ck
+                        break
+            existing.add(key)
+            pairs.append(key)
+    return _mk(names, pairs, props)
+
+
+def geo_wan(n: int, degree: int = 3, seed: int = 0,
+            rate: str = "10Gbit") -> EdgeList:
+    """Geographic WAN: n sites at random plane coordinates (km), each
+    linked to its `degree` nearest neighbors, with per-link latency from
+    fiber distance (~5 µs/km — the c/1.5 rule of thumb). Unlike the other
+    families every link gets its own property row, exercising the
+    heterogeneous-props path end to end."""
+    assert n >= 2 and 1 <= degree < n, "need n >= 2 and 1 <= degree < n"
+    rng = np.random.default_rng(seed)
+    xy = rng.uniform(0, 5000.0, (n, 2))  # continental scale, km
+    names = [f"site{i}" for i in range(n)]
+    d2 = ((xy[:, None, :] - xy[None, :, :]) ** 2).sum(-1)
+    np.fill_diagonal(d2, np.inf)
+    pairs = []
+    seen: set[tuple[int, int]] = set()
+    # spanning backbone first (like random_mesh): each site links to its
+    # geographically nearest already-placed site, so the WAN is connected
+    # regardless of how the k-NN extras fall
+    for i in range(1, n):
+        j = int(np.argmin(d2[i, :i]))
+        seen.add((j, i))
+        pairs.append((j, i))
+    order = np.argsort(d2, axis=1)
+    for i in range(n):
+        for j in order[i, :degree]:
+            key = (min(i, int(j)), max(i, int(j)))
+            if key not in seen:
+                seen.add(key)
+                pairs.append(key)
+    pairs_arr = np.asarray(pairs, np.int32)
+    km = np.sqrt(d2[pairs_arr[:, 0], pairs_arr[:, 1]])
+    base = es.props_row(LinkProperties(rate=rate).to_numeric())
+    prop_rows = np.broadcast_to(np.asarray(base, np.float32),
+                                (len(pairs), es.NPROP)).copy()
+    lat_col = es.PROP_NAMES.index("latency_us")
+    prop_rows[:, lat_col] = np.maximum(1.0, np.round(km * 5.0))
+    return _mk(names, pairs, prop_rows=prop_rows)
+
+
+FAMILIES = {
+    "line": line, "ring": ring, "star": star, "full_mesh": full_mesh,
+    "random_mesh": random_mesh, "fat_tree": fat_tree, "clos": clos,
+    "torus": torus, "hypercube": hypercube, "dragonfly": dragonfly,
+    "barabasi_albert": barabasi_albert, "watts_strogatz": watts_strogatz,
+    "geo_wan": geo_wan,
+}
+
+
 def load_edge_list_into_state(el: EdgeList, capacity: int | None = None):
     """Fast path: place a generated topology directly into a fresh
     EdgeState, bypassing the per-link control plane. Returns

@@ -65,3 +65,163 @@ def test_load_edge_list_into_state():
     state, rows = T.load_edge_list_into_state(el)
     assert int(state.num_active) == 2 * el.n_links
     assert state.capacity >= 2 * el.n_links
+
+
+# ---- new families ---------------------------------------------------
+
+def _connected(el):
+    """Union-find connectivity over the edge list."""
+    parent = list(range(el.n_nodes))
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a, b in zip(el.a.tolist(), el.b.tolist()):
+        parent[find(a)] = find(b)
+    return len({find(i) for i in range(el.n_nodes)}) == 1
+
+
+def _degrees(el):
+    deg = np.zeros(el.n_nodes, np.int64)
+    np.add.at(deg, el.a, 1)
+    np.add.at(deg, el.b, 1)
+    return deg
+
+
+def test_torus_2d_counts_and_regularity():
+    el = T.torus((4, 4))
+    assert el.n_nodes == 16 and el.n_links == 32
+    assert (_degrees(el) == 4).all()
+    assert _connected(el)
+
+
+def test_torus_3d_and_dim2_no_double_link():
+    el = T.torus((4, 4, 4))
+    assert el.n_nodes == 64 and el.n_links == 192
+    assert (_degrees(el) == 6).all()
+    # a size-2 dimension contributes ONE link per wrap pair, not two
+    el2 = T.torus((2, 3))
+    assert el2.n_links == 3 + 6  # 3 cross-links + two 3-rings
+    assert _connected(el2)
+
+
+def test_hypercube():
+    el = T.hypercube(4)
+    assert el.n_nodes == 16 and el.n_links == 32
+    assert (_degrees(el) == 4).all()
+    assert _connected(el)
+
+
+def test_dragonfly():
+    g, a, h = 4, 3, 2
+    el = T.dragonfly(g, a, h)
+    assert el.n_nodes == g * a
+    intra = g * a * (a - 1) // 2
+    glob = g * (g - 1) // 2 * h
+    assert el.n_links == intra + glob
+    assert _connected(el)
+
+
+def test_barabasi_albert_scale_free():
+    el = T.barabasi_albert(200, m=2, seed=3)
+    assert el.n_nodes == 200
+    assert el.n_links == (200 - 2) * 2
+    assert _connected(el)
+    deg = _degrees(el)
+    # heavy tail: max degree far above the mean
+    assert deg.max() >= 4 * deg.mean()
+
+
+def test_watts_strogatz():
+    el = T.watts_strogatz(100, k=4, beta=0.2, seed=5)
+    assert el.n_nodes == 100
+    assert el.n_links <= 200
+    assert _connected(el)
+    # no duplicate undirected pairs
+    keys = set(zip(np.minimum(el.a, el.b).tolist(),
+                   np.maximum(el.a, el.b).tolist()))
+    assert len(keys) == el.n_links
+
+
+def test_geo_wan_distance_latencies():
+    el = T.geo_wan(50, degree=3, seed=9)
+    assert el.n_nodes == 50
+    lat = el.props[:, es.PROP_NAMES.index("latency_us")]
+    assert (lat >= 1).all()
+    # 5000 km plane diagonal => at most ~ 7071 km * 5 us/km
+    assert lat.max() <= 7071 * 5 + 1
+    # heterogeneous: not all links share one latency
+    assert len(np.unique(lat)) > 5
+    # per-link props survive the CR round trip
+    topos = el.to_topologies()
+    for t in topos:
+        t.validate()
+
+
+def test_new_families_reachable_on_device():
+    """Load a torus into edge state and check full device-side
+    reachability via the routing kernel."""
+    from kubedtn_tpu.ops import routing as R
+
+    el = T.torus((3, 3))
+    state, rows = T.load_edge_list_into_state(el)
+    reach = R.reachability(state, n_nodes=el.n_nodes)
+    assert bool(np.asarray(reach).all())
+
+
+def test_gen_cli_families():
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    import yaml
+
+    from kubedtn_tpu.api.types import load_yaml
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "t.yaml")
+        r = subprocess.run(
+            [sys.executable, "-m", "kubedtn_tpu.cli", "gen", "torus",
+             "-p", "dims=3x3", "-o", out],
+            capture_output=True, text=True, cwd=repo, check=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        meta = yaml.safe_load(r.stdout)
+        assert meta["nodes"] == 9 and meta["links"] == 18
+        topos = load_yaml(out)
+        assert len(topos) == 9
+        for t in topos:
+            t.validate()
+
+
+def test_geo_wan_always_connected_and_guarded():
+    for seed in range(20):
+        assert _connected(T.geo_wan(50, degree=3, seed=seed)), seed
+    with pytest.raises(AssertionError):
+        T.geo_wan(4, degree=4)
+
+
+def test_gen_cli_bad_params_fail_cleanly():
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    for argv in (["gen", "ring"],                      # missing required n
+                 ["gen", "torus", "-p", "dims=4xa"],   # malformed dims
+                 ["gen", "geo_wan", "-p", "n=4", "-p", "degree=4"]):
+        r = subprocess.run([sys.executable, "-m", "kubedtn_tpu.cli"] + argv,
+                           capture_output=True, text=True, cwd=repo, env=env)
+        assert r.returncode == 1, argv
+        assert "Traceback" not in r.stderr, argv
+        assert "signature" in r.stderr, argv
+    # numeric-looking rate param stays a string
+    r = subprocess.run([sys.executable, "-m", "kubedtn_tpu.cli", "gen",
+                        "geo_wan", "-p", "n=5", "-p", "rate=100Mbit"],
+                       capture_output=True, text=True, cwd=repo, env=env)
+    assert r.returncode == 0, r.stderr
