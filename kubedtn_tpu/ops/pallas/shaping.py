@@ -200,10 +200,11 @@ def _tiles(x: jax.Array, e_pad: int):
     return x.T.reshape(x.shape[1], e_pad // LANE, LANE)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "block_rows"))
+@functools.partial(jax.jit, donate_argnums=0,
+                   static_argnames=("interpret", "block_rows"))
 def shape_step(state: EdgeState, sizes: jax.Array, have_pkt: jax.Array,
                t_arrival: jax.Array, key: jax.Array, *,
-               interpret: bool | None = None, block_rows: int = 64):
+               interpret: bool | None = None, block_rows: int = 128):
     """Drop-in replacement for kubedtn_tpu.ops.netem.shape_step backed by
     the fused Pallas kernel. Same signature, same results for the same key.
 
@@ -214,7 +215,12 @@ def shape_step(state: EdgeState, sizes: jax.Array, have_pkt: jax.Array,
         interpret = jax.default_backend() != "tpu"
 
     E = state.capacity
-    br = block_rows if E >= block_rows * LANE else SUBLANES
+    # graduated block size: the largest power-of-two tile height (up to
+    # block_rows) that the edge count fills, floored at the f32 minimum —
+    # mid-sized topologies keep big tiles instead of falling to 8 rows
+    br = SUBLANES
+    while br < block_rows and br * 2 * LANE <= E:
+        br *= 2
     e_pad = -(-E // (br * LANE)) * (br * LANE)
     R = e_pad // LANE
 

@@ -102,7 +102,11 @@ def make_sharded_step(mesh, n_nodes: int):
         # 1. control plane: batched property updates (sharded scatter)
         state = es.update_links(state, urows, uprops, uvalid)
         state = pin(state)
-        # 2. data plane: per-edge shaping (no communication)
+        # 2. data plane: per-edge shaping (no communication). Deliberately
+        # the vmapped XLA path, not the Pallas kernel: this step is
+        # GSPMD-partitioned by jit, and XLA can shard elementwise HLOs
+        # along the edge axis automatically, while a pallas_call has no
+        # partitioning rule and would force replication here.
         state, res = netem.shape_step(state, sizes, have, t_arr, key)
         state = pin(state)
         # 3. observability: cross-shard per-node counters (psum over ICI)

@@ -104,7 +104,7 @@ class WireDataPlane:
                 state = engine.state
                 res_cols = []
                 for j in range(k):
-                    state, res = netem.shape_step(
+                    state, res = netem.shape_step_auto(
                         state, jnp.asarray(sizes[:, j]),
                         jnp.asarray(valid[:, j]),
                         jnp.zeros((E,), jnp.float32),

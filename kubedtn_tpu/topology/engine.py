@@ -465,7 +465,7 @@ class SimEngine:
         sizes = jnp.full((E,), size_bytes, jnp.float32)
         have = jnp.zeros((E,), bool).at[jnp.array([ra, rb])].set(True)
         t0 = jnp.zeros((E,), jnp.float32)
-        self.state, res = netem.shape_step(
+        self.state, res = netem.shape_step_auto(
             self.state, sizes, have, t0, jax.random.key(seed))
         d_ab = float(res.depart_us[ra])
         d_ba = float(res.depart_us[rb])

@@ -116,3 +116,13 @@ def test_no_recompile_on_same_shapes():
         n0 = es.apply_links._cache_size()
         s = es.apply_links(s, rows + 4, uids + 4, zeros, zeros, props, ok)
         assert es.apply_links._cache_size() == n0
+
+
+def test_update_links_empty_batch_noop():
+    import jax.numpy as jnp
+
+    st = es.init_state(8)
+    out = es.update_links(st, jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((0, es.NPROP), jnp.float32),
+                          jnp.zeros((0,), bool))
+    assert out.capacity == 8

@@ -74,6 +74,12 @@ def assert_result_equal(a: netem.ShapeResult, b: netem.ShapeResult):
                                        err_msg=f.name)
 
 
+def dcopy(state):
+    """Deep-copy an EdgeState: shaping.shape_step donates its input, and
+    these parity tests reuse/compare the original afterwards."""
+    return jax.tree.map(jnp.copy, state)
+
+
 @pytest.mark.parametrize("capacity,seed", [(1024, 0), (2048, 1), (8192, 2)])
 def test_parity_random_states(capacity, seed):
     state = random_state(capacity, seed)
@@ -86,7 +92,7 @@ def test_parity_random_states(capacity, seed):
 
     ref_state, ref_res = netem.shape_step.__wrapped__(
         state, sizes, have, t_arr, key)
-    pl_state, pl_res = shaping.shape_step(state, sizes, have, t_arr, key,
+    pl_state, pl_res = shaping.shape_step(dcopy(state), sizes, have, t_arr, key,
                                           interpret=True)
     assert_result_equal(ref_res, pl_res)
     assert_state_close(ref_state, pl_state)
@@ -102,7 +108,7 @@ def test_parity_capacity_not_tile_multiple():
         key = jax.random.key(7)
         ref_state, ref_res = netem.shape_step.__wrapped__(
             state, sizes, have, t_arr, key)
-        pl_state, pl_res = shaping.shape_step(state, sizes, have, t_arr, key,
+        pl_state, pl_res = shaping.shape_step(dcopy(state), sizes, have, t_arr, key,
                                               interpret=True)
         assert_result_equal(ref_res, pl_res)
         assert_state_close(ref_state, pl_state)
@@ -122,7 +128,7 @@ def test_parity_on_real_topology():
 
     ref_state, ref_res = netem.shape_step.__wrapped__(
         state, sizes, have, t_arr, key)
-    pl_state, pl_res = shaping.shape_step(state, sizes, have, t_arr, key,
+    pl_state, pl_res = shaping.shape_step(dcopy(state), sizes, have, t_arr, key,
                                           interpret=True)
     assert_result_equal(ref_res, pl_res)
     assert_state_close(ref_state, pl_state)
@@ -135,7 +141,7 @@ def test_inactive_and_no_packet_lanes_untouched():
     have = jnp.asarray(np.arange(1024) % 2 == 0)
     t_arr = jnp.zeros((1024,), jnp.float32)
     key = jax.random.key(11)
-    new_state, res = shaping.shape_step(state, sizes, have, t_arr, key,
+    new_state, res = shaping.shape_step(dcopy(state), sizes, have, t_arr, key,
                                         interpret=True)
     idle = ~np.asarray(have & state.active)
     assert not np.asarray(res.delivered)[idle].any()
