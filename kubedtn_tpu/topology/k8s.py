@@ -1,0 +1,272 @@
+"""K8s bridge — sync Topology CRs between a real cluster and the store.
+
+The reference talks to the Kubernetes API in two ways: a hand-rolled typed
+clientset (reference api/clientset/v1beta1/topology.go:32-188 — List/Get/
+Watch/Update/UpdateStatus against group y-young.github.io) and a shared
+informer feeding the daemon's cache (reference daemon/kubedtn/kubedtn.go:
+128-142). Here the in-process :class:`TopologyStore` plays the apiserver
+role for standalone runs; this module is the optional bridge that keeps the
+store in sync with a REAL cluster when one exists, so the same reconciler/
+engine stack runs unmodified either way:
+
+- cluster → store: initial LIST then a WATCH pump applies ADDED/MODIFIED/
+  DELETED spec changes into the store (the informer direction);
+- store → cluster: status written locally by the daemon/reconciler (the
+  placement + applied-links subresource, reference handler.go:90-147) is
+  pushed back via the status subresource endpoint (the clientset
+  UpdateStatus direction, topology.go:171-184); a vanished object reads
+  as False, transient API errors propagate to the caller's retry loop.
+
+The real-cluster transport is duck-typed (`list_topologies`,
+`watch_topologies`, `patch_status`, `patch_finalizers`): production wraps
+the `kubernetes` package's CustomObjectsApi (gated import — raises
+:class:`K8sUnavailable` when the package is missing, which it is in this
+image), and the test suite drives the same bridge with an in-memory fake
+cluster, mirroring how the reference tests controllers against envtest
+(reference controllers/suite_test.go:44-80).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterable
+
+from kubedtn_tpu import GROUP, VERSION
+from kubedtn_tpu.api.types import Topology
+from kubedtn_tpu.topology.store import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    TopologyStore,
+    retry_on_conflict,
+)
+
+PLURAL = "topologies"
+
+
+class K8sUnavailable(RuntimeError):
+    """The kubernetes client package is not importable."""
+
+
+def make_kube_api(namespace: str | None = None):
+    """Wrap the real `kubernetes` package into the bridge's transport
+    surface. Raises K8sUnavailable when the package is absent (it is not
+    baked into this image; standalone mode needs no cluster)."""
+    try:
+        import kubernetes  # type: ignore
+    except ImportError as e:
+        raise K8sUnavailable(
+            "the 'kubernetes' package is not installed; run standalone "
+            "(TopologyStore) or install the client") from e
+
+    try:
+        kubernetes.config.load_incluster_config()
+    except kubernetes.config.config_exception.ConfigException:
+        try:  # out-of-cluster operator: fall back to kubeconfig
+            kubernetes.config.load_kube_config()
+        except kubernetes.config.config_exception.ConfigException as e:
+            raise K8sUnavailable(
+                "no in-cluster service account and no kubeconfig") from e
+    api = kubernetes.client.CustomObjectsApi()
+
+    class _Api:
+        def list_topologies(self) -> tuple[list[dict], str]:
+            r = api.list_cluster_custom_object(GROUP, VERSION, PLURAL) \
+                if namespace is None else api.list_namespaced_custom_object(
+                    GROUP, VERSION, namespace, PLURAL)
+            return r.get("items", []), r["metadata"]["resourceVersion"]
+
+        def watch_topologies(self, resource_version: str):
+            w = kubernetes.watch.Watch()
+            kwargs = dict(resource_version=resource_version)
+            if namespace is None:
+                stream = w.stream(api.list_cluster_custom_object, GROUP,
+                                  VERSION, PLURAL, **kwargs)
+            else:
+                stream = w.stream(api.list_namespaced_custom_object, GROUP,
+                                  VERSION, namespace, PLURAL, **kwargs)
+            for ev in stream:
+                yield ev["type"], ev["object"]
+
+        def patch_status(self, ns: str, name: str, status: dict) -> None:
+            api.patch_namespaced_custom_object_status(
+                GROUP, VERSION, ns, PLURAL, name, {"status": status})
+
+        def patch_finalizers(self, ns: str, name: str,
+                             finalizers: list[str]) -> None:
+            api.patch_namespaced_custom_object(
+                GROUP, VERSION, ns, PLURAL, name,
+                {"metadata": {"finalizers": finalizers}})
+
+    return _Api()
+
+
+class K8sBridge:
+    """Bidirectional sync between a cluster transport and a TopologyStore."""
+
+    def __init__(self, store: TopologyStore, api: Any) -> None:
+        self.store = store
+        self.api = api
+        self.cluster_rv: str = "0"
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # last status manifest pushed per key, to break the push→watch echo
+        self._pushed_status: dict[str, dict] = {}
+        self.stats = {"applied": 0, "deleted": 0, "status_pushed": 0,
+                      "echoes_skipped": 0, "conflicts": 0}
+
+    # -- cluster → store ----------------------------------------------
+
+    def sync_once(self) -> int:
+        """Initial LIST: seed/refresh every cluster object into the store
+        (the informer's initial sync). Returns the object count."""
+        items, rv = self.api.list_topologies()
+        self.cluster_rv = rv
+        seen = set()
+        for manifest in items:
+            self._apply(manifest)
+            t = Topology.from_manifest(manifest)
+            seen.add(t.key)
+        # objects gone from the cluster while we were away
+        for t in self.store.list():
+            if t.key not in seen:
+                self._delete(t.namespace, t.name)
+        return len(items)
+
+    def pump(self, events: Iterable[tuple[str, dict]]) -> int:
+        """Apply a batch of (type, manifest) watch events. Returns the
+        number applied."""
+        n = 0
+        for ev_type, manifest in events:
+            if ev_type in ("ADDED", "MODIFIED"):
+                self._apply(manifest)
+            elif ev_type == "DELETED":
+                meta = manifest.get("metadata", {})
+                self._delete(meta.get("namespace", "default"), meta["name"])
+            rv = manifest.get("metadata", {}).get("resourceVersion")
+            if rv is not None:
+                self.cluster_rv = rv
+            n += 1
+        return n
+
+    def _apply(self, manifest: dict) -> None:
+        incoming = Topology.from_manifest(manifest)
+
+        # echo of our own status push? spec-identical + status we just
+        # wrote ⇒ nothing to fold back into the store
+        pushed = self._pushed_status.get(incoming.key)
+        if pushed is not None and manifest.get("status") == pushed:
+            try:
+                current = self.store.get(incoming.namespace, incoming.name)
+            except NotFoundError:
+                current = None
+            if current is not None and \
+                    current.to_manifest().get("spec") == \
+                    manifest.get("spec"):
+                self.stats["echoes_skipped"] += 1
+                return
+
+        def txn():
+            try:
+                current = self.store.get(incoming.namespace, incoming.name)
+            except NotFoundError:
+                try:
+                    self.store.create(incoming)
+                except AlreadyExistsError:
+                    raise ConflictError(incoming.key)
+                return
+            # status-only change by another writer: nothing to fold in —
+            # bumping the store rv here would re-trigger reconciliation
+            # cluster-wide on every peer's status write
+            if current.spec == incoming.spec:
+                return
+            # cluster owns the spec; local owners keep writing status
+            current.spec = incoming.spec
+            self.store.update(current)
+
+        try:
+            retry_on_conflict(txn)
+            self.stats["applied"] += 1
+        except ConflictError:
+            self.stats["conflicts"] += 1
+
+    def _delete(self, ns: str, name: str) -> None:
+        try:
+            self.store.delete(ns, name)
+            self.stats["deleted"] += 1
+        except NotFoundError:
+            pass
+        self._pushed_status.pop(f"{ns}/{name}", None)
+
+    # -- store → cluster ----------------------------------------------
+
+    @staticmethod
+    def _is_not_found(e: Exception) -> bool:
+        return isinstance(e, NotFoundError) or \
+            getattr(e, "status", None) == 404
+
+    def push_status(self, topology: Topology) -> bool:
+        """Write a locally-updated status (placement/applied links) to the
+        cluster's status subresource — the clientset UpdateStatus
+        direction. Returns False when the object vanished upstream (404);
+        any other API error propagates so the caller's loop can retry —
+        a transient failure must not read as deletion."""
+        manifest = topology.to_manifest()
+        status = manifest.get("status", {})
+        if self._pushed_status.get(topology.key) == status:
+            return True
+        try:
+            self.api.patch_status(topology.namespace, topology.name, status)
+        except Exception as e:
+            if self._is_not_found(e):
+                return False
+            raise
+        # record as soon as the status landed: a later finalizer-patch
+        # failure must not break suppression of this patch's echo
+        self._pushed_status[topology.key] = status
+        self.stats["status_pushed"] += 1
+        if hasattr(self.api, "patch_finalizers"):
+            try:
+                self.api.patch_finalizers(topology.namespace, topology.name,
+                                          list(topology.finalizers))
+            except Exception as e:
+                if not self._is_not_found(e):
+                    raise
+        return True
+
+    # -- background informer ------------------------------------------
+
+    def run(self, on_error: Callable[[Exception], None] | None = None,
+            stop: threading.Event | None = None) -> None:
+        """Blocking informer loop: LIST once, then WATCH forever, re-listing
+        on watch failure (the reference informer's resync behavior)."""
+        stop = stop if stop is not None else self._stop
+        while not stop.is_set():
+            try:
+                self.sync_once()
+                for ev in self.api.watch_topologies(self.cluster_rv):
+                    if stop.is_set():
+                        return
+                    self.pump([ev])
+            except Exception as e:  # watch expired / transient API error
+                if on_error is not None:
+                    on_error(e)
+                stop.wait(1.0)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        # each informer thread owns its own stop event: a predecessor
+        # blocked in a never-yielding watch stays permanently stopped and
+        # can never revive as a second pump against the same store
+        self._stop = threading.Event()
+        stop = self._stop
+        self._thread = threading.Thread(target=lambda: self.run(stop=stop),
+                                        daemon=True, name="k8s-bridge")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

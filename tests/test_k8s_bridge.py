@@ -1,0 +1,278 @@
+"""K8s bridge tests: cluster↔store sync against an in-memory fake cluster
+(the same duck-typed transport surface the real `kubernetes`-backed adapter
+implements), mirroring how the reference scaffolds controller tests against
+envtest (reference controllers/suite_test.go:44-80) — but with behavior
+actually exercised."""
+
+import pytest
+
+from kubedtn_tpu.api.types import (Link, LinkProperties, Topology,
+                                   TopologySpec)
+from kubedtn_tpu.topology.k8s import K8sBridge, K8sUnavailable, make_kube_api
+from kubedtn_tpu.topology.store import NotFoundError, TopologyStore
+
+
+class FakeClusterApi:
+    """Minimal apiserver double for the bridge transport surface. Every
+    stored/queued manifest is deep-copied — a real apiserver serializes,
+    so objects never share structure with watch events."""
+
+    def __init__(self):
+        self.objects: dict[str, dict] = {}
+        self.rv = 0
+        self.events: list[tuple[str, dict]] = []
+        self.status_patches: list[tuple[str, str, dict]] = []
+
+    # -- test helpers --------------------------------------------------
+    def put(self, manifest, event="ADDED"):
+        import copy
+
+        manifest = copy.deepcopy(manifest)
+        self.rv += 1
+        manifest.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+        key = (manifest["metadata"].get("namespace", "default") + "/"
+               + manifest["metadata"]["name"])
+        self.objects[key] = manifest
+        self.events.append((event, copy.deepcopy(manifest)))
+
+    def remove(self, ns, name):
+        key = f"{ns}/{name}"
+        manifest = dict(self.objects.pop(key))
+        self.rv += 1
+        manifest.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+        self.events.append(("DELETED", manifest))
+
+    # -- transport surface ---------------------------------------------
+    def list_topologies(self):
+        return list(self.objects.values()), str(self.rv)
+
+    def watch_topologies(self, resource_version):
+        # like the real apiserver: only events newer than the given rv
+        since = int(resource_version)
+        pending = [e for e in self.events
+                   if int(e[1]["metadata"]["resourceVersion"]) > since]
+        self.events = []
+        yield from pending
+
+    def patch_status(self, ns, name, status):
+        import copy
+
+        key = f"{ns}/{name}"
+        if key not in self.objects:
+            raise NotFoundError(key)
+        self.status_patches.append((ns, name, copy.deepcopy(status)))
+        self.rv += 1
+        obj = copy.deepcopy(self.objects[key])
+        obj["status"] = copy.deepcopy(status)
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+        self.objects[key] = obj
+        self.events.append(("MODIFIED", copy.deepcopy(obj)))
+
+
+def manifest(name, uid=1, peer="r2", latency="10ms"):
+    return {
+        "apiVersion": "y-young.github.io/v1",
+        "kind": "Topology",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"links": [{
+            "uid": uid, "local_intf": "eth1", "peer_intf": "eth1",
+            "peer_pod": peer, "properties": {"latency": latency},
+        }]},
+    }
+
+
+def test_sync_once_seeds_store_and_prunes_stale():
+    api = FakeClusterApi()
+    api.put(manifest("r1"))
+    api.put(manifest("r2", peer="r1"))
+    store = TopologyStore()
+    store.create(Topology(name="ghost", spec=TopologySpec(links=[])))
+    bridge = K8sBridge(store, api)
+    assert bridge.sync_once() == 2
+    assert {t.name for t in store.list()} == {"r1", "r2"}
+    with pytest.raises(NotFoundError):
+        store.get("default", "ghost")
+
+
+def test_watch_pump_applies_spec_changes_and_deletes():
+    api = FakeClusterApi()
+    api.put(manifest("r1"))
+    store = TopologyStore()
+    bridge = K8sBridge(store, api)
+    bridge.sync_once()
+
+    # spec edit upstream
+    m = manifest("r1", latency="50ms")
+    api.put(m, event="MODIFIED")
+    # a new pod + a deletion
+    api.put(manifest("r3", peer="r1"))
+    api.remove("default", "r1")
+    n = bridge.pump(api.watch_topologies(bridge.cluster_rv))
+    assert n == 3
+    assert {t.name for t in store.list()} == {"r3"}
+    assert bridge.stats["deleted"] == 1
+
+
+def test_spec_update_preserves_local_status():
+    """Cluster owns spec; locally-written status (placement) survives the
+    fold-in — the CNI-vs-controller split-writer discipline."""
+    api = FakeClusterApi()
+    api.put(manifest("r1"))
+    store = TopologyStore()
+    bridge = K8sBridge(store, api)
+    bridge.sync_once()
+
+    t = store.get("default", "r1")
+    t.status.src_ip, t.status.net_ns = "10.0.0.5", "/proc/ns/1"
+    store.update_status(t)
+
+    api.put(manifest("r1", latency="99ms"), event="MODIFIED")
+    bridge.pump(api.watch_topologies(bridge.cluster_rv))
+    t2 = store.get("default", "r1")
+    assert t2.spec.links[0].properties.latency == "99ms"
+    assert t2.status.src_ip == "10.0.0.5"
+
+
+def test_push_status_and_echo_suppression():
+    api = FakeClusterApi()
+    api.put(manifest("r1"))
+    store = TopologyStore()
+    bridge = K8sBridge(store, api)
+    bridge.sync_once()
+
+    t = store.get("default", "r1")
+    t.status.src_ip, t.status.net_ns = "10.0.0.7", "/proc/ns/2"
+    store.update_status(t)
+    assert bridge.push_status(store.get("default", "r1"))
+    assert api.status_patches and api.status_patches[-1][2]["src_ip"] == \
+        "10.0.0.7"
+    # identical second push is a no-op
+    assert bridge.push_status(store.get("default", "r1"))
+    assert len(api.status_patches) == 1
+    # the MODIFIED echo from our own patch does not churn the store
+    rv_before = store.get("default", "r1").resource_version
+    bridge.pump(api.watch_topologies(bridge.cluster_rv))
+    assert bridge.stats["echoes_skipped"] == 1
+    assert store.get("default", "r1").resource_version == rv_before
+
+
+def test_bridge_drives_engine_end_to_end():
+    """Cluster events -> store -> reconciler -> device arrays, with the
+    status pushed back: the reference's controller+informer loop shape."""
+    from kubedtn_tpu.topology import Reconciler, SimEngine
+
+    api = FakeClusterApi()
+    api.put(manifest("r1", peer="r2"))
+    api.put(manifest("r2", peer="r1"))
+    store = TopologyStore()
+    engine = SimEngine(store)
+    rec = Reconciler(store, engine)
+    bridge = K8sBridge(store, api)
+    bridge.sync_once()
+    for name in ("r1", "r2"):
+        engine.setup_pod(name)
+    rec.drain()
+    assert engine.num_active == 2
+    for t in store.list():
+        assert bridge.push_status(t)
+    assert len(api.status_patches) == 2
+
+    # upstream latency change flows through to the device row
+    api.put(manifest("r1", peer="r2", latency="77ms"), event="MODIFIED")
+    bridge.pump(api.watch_topologies(bridge.cluster_rv))
+    rec.drain()
+    row = engine.link_row("default/r1", 1)
+    assert row["latency_us"] == 77_000
+
+
+def test_real_client_gated():
+    with pytest.raises(K8sUnavailable):
+        make_kube_api()
+
+
+def test_foreign_status_write_does_not_churn_store():
+    """A status-only MODIFIED from ANOTHER writer (not in our pushed
+    cache) must not bump the store rv / re-trigger reconciliation."""
+    api = FakeClusterApi()
+    api.put(manifest("r1"))
+    store = TopologyStore()
+    bridge = K8sBridge(store, api)
+    bridge.sync_once()
+    rv_before = store.get("default", "r1").resource_version
+
+    peer_view = dict(api.objects["default/r1"])
+    peer_view["status"] = {"src_ip": "10.9.9.9", "net_ns": "/proc/ns/77"}
+    api.put(peer_view, event="MODIFIED")
+    bridge.pump(api.watch_topologies(bridge.cluster_rv))
+    assert store.get("default", "r1").resource_version == rv_before
+
+
+def test_push_status_transient_error_propagates_not_false():
+    """A network blip must not read as 'object deleted' (False)."""
+    api = FakeClusterApi()
+    api.put(manifest("r1"))
+    store = TopologyStore()
+    bridge = K8sBridge(store, api)
+    bridge.sync_once()
+    t = store.get("default", "r1")
+    t.status.src_ip, t.status.net_ns = "1.2.3.4", "/ns"
+    store.update_status(t)
+
+    boom = RuntimeError("apiserver 500")
+    api.patch_status_orig = api.patch_status
+    api.patch_status = lambda *a: (_ for _ in ()).throw(boom)
+    with pytest.raises(RuntimeError):
+        bridge.push_status(store.get("default", "r1"))
+    api.patch_status = api.patch_status_orig
+    assert bridge.push_status(store.get("default", "r1"))
+    # vanished upstream: False, not an exception
+    api.remove("default", "r1")
+    t.status.src_ip = "5.6.7.8"
+    assert bridge.push_status(t) is False
+
+
+def test_finalizer_patch_failure_keeps_echo_suppression():
+    api = FakeClusterApi()
+    api.put(manifest("r1"))
+    store = TopologyStore()
+    bridge = K8sBridge(store, api)
+    bridge.sync_once()
+    t = store.get("default", "r1")
+    t.status.src_ip, t.status.net_ns = "1.1.1.1", "/ns"
+    t.finalizers = ["kubedtn"]
+    store.update_status(t)
+
+    api.patch_finalizers = lambda *a: (_ for _ in ()).throw(
+        RuntimeError("transient"))
+    with pytest.raises(RuntimeError):
+        bridge.push_status(store.get("default", "r1"))
+    # the status DID land; its echo must still be suppressed
+    rv_before = store.get("default", "r1").resource_version
+    bridge.pump(api.watch_topologies(bridge.cluster_rv))
+    assert bridge.stats["echoes_skipped"] == 1
+    assert store.get("default", "r1").resource_version == rv_before
+
+
+def test_restarted_informer_gets_fresh_stop_event():
+    """A predecessor thread wedged in a watch must stay stopped: each
+    start() binds a new stop event, never un-stopping the old thread."""
+    import threading
+
+    api = FakeClusterApi()
+    store = TopologyStore()
+    bridge = K8sBridge(store, api)
+    release = threading.Event()
+
+    def blocking_watch(rv):
+        release.wait(10)
+        return iter(())
+
+    api.watch_topologies = blocking_watch
+    bridge.start()
+    ev1 = bridge._stop
+    bridge.stop()            # join times out? no — watch returns on release
+    assert ev1.is_set()
+    bridge.start()
+    assert bridge._stop is not ev1 and not bridge._stop.is_set()
+    release.set()
+    bridge.stop()
