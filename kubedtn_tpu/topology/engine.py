@@ -87,6 +87,7 @@ class EngineStats:
     dels: int = 0
     updates: int = 0
     device_calls: int = 0
+    remote_errors: int = 0  # failed peer-daemon completion RPCs
     op_ms: dict[str, list[float]] = field(default_factory=dict)
     observer: object = None  # optional LatencyHistograms
 
@@ -100,7 +101,7 @@ class SimEngine:
     """Single source of truth for the device-array realization of links."""
 
     def __init__(self, store: TopologyStore, capacity: int = 1024,
-                 node_ip: str = "10.0.0.1") -> None:
+                 node_ip: str = "10.0.0.1", dialer=None) -> None:
         # One engine serves a 16-thread gRPC pool; all state mutation is
         # serialized here (the reference daemon locks per link uid —
         # common/utils.go:21-26 — but its state lives in the kernel; ours
@@ -117,6 +118,23 @@ class SimEngine:
         self._peer: dict[tuple[str, int], tuple[str, int]] = {}
         self._free: list[int] = list(range(capacity - 1, -1, -1))
         self._topology_manager: set[str] = set()  # alive pods (metrics/TopologyManager)
+        # cross-node peer-daemon dialing (reference common/utils.go:53-62,
+        # "passthrough:///<nodeIP>:51111"): src_ip -> client with .Update.
+        # Injectable for tests / non-default ports; cached per address.
+        self._dialer = dialer
+        self._peer_clients: dict[str, object] = {}
+
+    def _peer_daemon(self, src_ip: str):
+        client = self._peer_clients.get(src_ip)
+        if client is None:
+            if self._dialer is not None:
+                client = self._dialer(src_ip)
+            else:
+                from kubedtn_tpu.wire.client import dial_daemon
+
+                client = dial_daemon(src_ip)
+            self._peer_clients[src_ip] = client
+        return client
 
     # -- registries ----------------------------------------------------
 
@@ -247,10 +265,15 @@ class SimEngine:
             self._topology_manager.discard(key)
         return True
 
-    @_locked
     def setup_pod(self, name: str, ns: str = "default",
                   net_ns: str = "") -> bool:
-        """Local.SetupPod equivalent (handler.go:495-535)."""
+        """Local.SetupPod equivalent (handler.go:495-535).
+
+        Deliberately NOT @_locked: every sub-operation takes the engine
+        lock itself, and add_links must issue its cross-node completion
+        RPCs with the lock released — holding it here would let two nodes'
+        SetupPods deadlock dialing each other (the scenario behind the
+        reference's unlock-early discipline, handler.go:442-446)."""
         t0 = time.perf_counter()
         try:
             topo = self.get_pod(name, ns)
@@ -263,9 +286,9 @@ class SimEngine:
         self.stats.observe("setup", (time.perf_counter() - t0) * 1e3)
         return True
 
-    @_locked
     def destroy_pod(self, name: str, ns: str = "default") -> bool:
-        """Local.DestroyPod equivalent (handler.go:538-590)."""
+        """Local.DestroyPod equivalent (handler.go:538-590). Not @_locked
+        for the same reason as setup_pod — sub-operations self-lock."""
         key = f"{ns or 'default'}/{name}"
         self._topology_manager.discard(key)
         try:
@@ -289,14 +312,30 @@ class SimEngine:
             return False
         return topo.is_alive()
 
-    @_locked
     def add_links(self, topo: Topology, links: list[Link]) -> bool:
         """Local.AddLinks equivalent: the reference's per-link dispatch
-        (handler.go:316-459) collapsed into one batched device op."""
+        (handler.go:316-459) collapsed into one batched device op, plus
+        peer-daemon completion RPCs for cross-node links issued AFTER the
+        engine lock is released — the reference's explicit unlock-before-
+        RPC deadlock avoidance (handler.go:442-446)."""
+        remote_calls = self._add_links_locked(topo, links)
+        ok = True
+        for src_ip, remote_pod in remote_calls:
+            try:
+                resp = self._peer_daemon(src_ip).Update(remote_pod)
+                ok = ok and bool(resp.response)
+            except Exception:
+                self.stats.remote_errors += 1
+                ok = False
+        return ok
+
+    @_locked
+    def _add_links_locked(self, topo: Topology, links: list[Link]):
         t0 = time.perf_counter()
         local_key = topo.key
         self._ensure_capacity(2 * len(links))
         entries: list[tuple[int, int, int, int, np.ndarray]] = []
+        remote_calls: list[tuple[str, object]] = []
         alive_cache: dict[str, bool] = {}
         for link in links:
             if link.is_macvlan():
@@ -326,14 +365,43 @@ class SimEngine:
                 # Peer not up: do nothing — the peer will plumb both ends
                 # when it arrives (handler.go:389-395).
                 continue
+
+            peer_src_ip = self._pod_src_ip(peer_key)
+            if peer_src_ip and self.node_ip and peer_src_ip != self.node_ip:
+                # Branch D, cross-node (handler.go:419-453): realize only
+                # the LOCAL egress end (far end = the peer node's VTEP,
+                # VNI = 5000+uid), and queue a Remote.Update so the peer
+                # daemon realizes ITS end — issued after unlock. The RPC is
+                # queued even when the local row already exists: the peer
+                # side is idempotent (CreateOrUpdate, vxlan.go:54-151), and
+                # re-sending is what heals a link left half-realized by an
+                # earlier failed completion RPC on retry.
+                if (local_key, link.uid) not in self._rows:
+                    row = self._alloc(local_key, link.uid)
+                    props = np.asarray(
+                        es.props_row(link.properties.to_numeric()))
+                    entries.append((row, link.uid, self.pod_id(local_key),
+                                    self.pod_id(f"vtep/{peer_src_ip}"),
+                                    props))
+                from kubedtn_tpu.wire import proto as pb
+
+                remote_calls.append((peer_src_ip, pb.RemotePod(
+                    net_ns="", intf_name=link.peer_intf,
+                    intf_ip=link.peer_ip, peer_vtep=self.node_ip,
+                    vni=vni_from_uid(link.uid),
+                    kube_ns=topo.namespace, name=link.peer_pod,
+                    properties=pb.props_to_proto(link.properties),
+                )))
+                continue
+
             if ((local_key, link.uid) in self._rows
                     and (peer_key, link.uid) in self._rows):
                 # Both ends already realized: do nothing, like SetupVeth's
                 # "both interfaces already exist" path (common/veth.go:73-76).
                 continue
 
-            # Both alive: this pod plumbs BOTH directions with ITS declared
-            # properties (common/veth.go:44-62, common/utils.go:39-68).
+            # Both alive same-node: this pod plumbs BOTH directions with ITS
+            # declared properties (common/veth.go:44-62, common/utils.go:39-68).
             props = np.asarray(es.props_row(link.properties.to_numeric()))
             row = self._alloc(local_key, link.uid)
             entries.append((row, link.uid, self.pod_id(local_key),
@@ -346,7 +414,14 @@ class SimEngine:
         self._apply_rows(entries)
         self.stats.adds += len(entries)
         self.stats.observe("add", (time.perf_counter() - t0) * 1e3)
-        return True
+        return remote_calls
+
+    def _pod_src_ip(self, pod_key: str) -> str:
+        ns, _, name = pod_key.partition("/")
+        try:
+            return self.store.get(ns, name).status.src_ip
+        except NotFoundError:
+            return ""
 
     @_locked
     def del_links(self, topo: Topology, links: list[Link]) -> bool:

@@ -8,6 +8,27 @@ import grpc
 
 from kubedtn_tpu.wire import proto as pb
 
+GRPC_PORT = 51111  # reference common/constants.go:9
+
+
+def daemon_address(host: str) -> str:
+    """Normalize a node address to host:port, defaulting the daemon port.
+    Handles bare IPv4, host:port, bare IPv6 (bracketed for gRPC), and
+    already-bracketed IPv6 with or without a port."""
+    if host.startswith("["):
+        return host if "]:" in host else f"{host}:{GRPC_PORT}"
+    if host.count(":") >= 2:  # bare IPv6 literal
+        return f"[{host}]:{GRPC_PORT}"
+    if ":" in host:
+        return host
+    return f"{host}:{GRPC_PORT}"
+
+
+def dial_daemon(host: str) -> "DaemonClient":
+    """Dial a peer daemon by node address (the reference's
+    `passthrough:///<nodeIP>:51111`, common/utils.go:53-62)."""
+    return DaemonClient(daemon_address(host))
+
 
 class DaemonClient:
     def __init__(self, address: str) -> None:

@@ -167,6 +167,10 @@ REMOTE_METHODS = {
 WIRE_METHODS = {
     "SendToOnce": (Packet, BoolResponse, False),
     "SendToStream": (Packet, BoolResponse, True),  # client-streaming
+    # Framework extension (absent from reference kube_dtn.proto): pod-
+    # origin injection; the reference captures pod frames via pcap instead.
+    # Reference-built clients never call it, so wire compat is unaffected.
+    "InjectFrame": (Packet, BoolResponse, False),
 }
 
 

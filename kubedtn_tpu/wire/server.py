@@ -104,12 +104,21 @@ class Daemon:
         # the per-packet role of the reference's DecodeFrame debug logging
         # (grpcwire.go:429-450), kept as cheap counters instead of strings.
         self.frame_stats: Counter[str] = Counter()
+        # daemon->daemon wire forwarding (the reference's per-frame
+        # SendToOnce to the peer daemon, grpcwire.go:452-459): send
+        # errors counted, not fatal.
+        self.forward_errors = 0
         try:
             from kubedtn_tpu import native as _native
             self._classify = (_native.classify_batch
                               if _native.have_native() else None)
         except Exception:
             self._classify = None
+
+    def _peer_wire_client(self, addr: str):
+        # one per-address client cache per node, shared with the engine's
+        # Remote.Update dialing (same channel carries both RPC kinds)
+        return self.engine._peer_daemon(addr)
 
     # -- Local ---------------------------------------------------------
 
@@ -222,12 +231,25 @@ class Daemon:
 
     # -- WireProtocol --------------------------------------------------
 
+    def _frame_in(self, wire: Wire, frame: bytes) -> None:
+        """Reference semantics split by wire kind: a cross-daemon wire
+        (peer_ip set) receives frames FROM the peer daemon, already shaped
+        on the sender's egress row — they go straight to the pod side
+        (egress), like WritePacketData into the pod veth (reference
+        handler.go:256-271). A local attachment wire has no daemon peer;
+        frames sent to it are pod-origin traffic entering the simulation
+        (ingress) — the injection surface standing in for pcap capture."""
+        if wire.peer_ip:
+            wire.egress.append(frame)
+        else:
+            wire.ingress.append(frame)
+
     def SendToOnce(self, request, context):
         wire = self.wires.get_by_id(int(request.remot_intf_id))
         if wire is None:
             context.abort(grpc.StatusCode.NOT_FOUND,
                           f"no wire {request.remot_intf_id}")
-        wire.ingress.append(bytes(request.frame))
+        self._frame_in(wire, bytes(request.frame))
         return pb.BoolResponse(response=True)
 
     def SendToStream(self, request_iterator, context):
@@ -237,9 +259,21 @@ class Daemon:
         for pkt in request_iterator:
             wire = self.wires.get_by_id(int(pkt.remot_intf_id))
             if wire is not None:
-                wire.ingress.append(bytes(pkt.frame))
+                self._frame_in(wire, bytes(pkt.frame))
                 n += 1
         return pb.BoolResponse(response=n > 0)
+
+    def InjectFrame(self, request, context):
+        """Framework extension (not in the reference proto): pod-origin
+        traffic injection for ANY wire, including cross-daemon ones where
+        SendToOnce means 'from the peer daemon'. The reference needs no
+        such RPC because pcap captures pod frames directly."""
+        wire = self.wires.get_by_id(int(request.remot_intf_id))
+        if wire is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no wire {request.remot_intf_id}")
+        wire.ingress.append(bytes(request.frame))
+        return pb.BoolResponse(response=True)
 
     # -- sim ingress/egress bridge ------------------------------------
 
@@ -264,6 +298,17 @@ class Daemon:
         wire = self.wires.get_by_key(pod_key, uid)
         if wire is None:
             return False
+        if wire.peer_ip:
+            # cross-node wire: the shaped frame crosses to the peer daemon
+            # (one unary SendToOnce per frame, reference grpcwire.go:452);
+            # errors are counted and the frame dropped, not fatal (:452-459)
+            try:
+                self._peer_wire_client(wire.peer_ip).SendToOnce(pb.Packet(
+                    remot_intf_id=wire.peer_intf_id, frame=frame))
+                return True
+            except Exception:
+                self.forward_errors += 1
+                return False
         wire.egress.append(frame)
         return True
 
@@ -282,10 +327,72 @@ def _handler(fn, req_cls, resp_cls, streaming: bool):
     )
 
 
+def _health_handlers():
+    """Standard grpc.health.v1 service (Check + server-streaming Watch),
+    built dynamically like the parity proto — the daemon-side analogue of
+    the reference controller's healthz/readyz probes (reference
+    main.go:113-120). Always reports SERVING while the server is up; a
+    stopped server fails the TCP dial, which is the NOT_SERVING signal."""
+    from google.protobuf import (descriptor_pb2, descriptor_pool,
+                                 message_factory)
+
+    fd = descriptor_pb2.FileDescriptorProto(
+        name="kubedtn_tpu/wire/health_dynamic.proto",
+        package="grpc.health.v1", syntax="proto3")
+    req = descriptor_pb2.DescriptorProto(name="HealthCheckRequest")
+    req.field.add(name="service", number=1,
+                  type=descriptor_pb2.FieldDescriptorProto.TYPE_STRING,
+                  label=descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL)
+    resp = descriptor_pb2.DescriptorProto(name="HealthCheckResponse")
+    enum = resp.enum_type.add(name="ServingStatus")
+    for i, name in enumerate(("UNKNOWN", "SERVING", "NOT_SERVING",
+                              "SERVICE_UNKNOWN")):
+        enum.value.add(name=name, number=i)
+    resp.field.add(name="status", number=1,
+                   type=descriptor_pb2.FieldDescriptorProto.TYPE_ENUM,
+                   label=descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL,
+                   type_name=".grpc.health.v1.HealthCheckResponse"
+                             ".ServingStatus")
+    fd.message_type.extend([req, resp])
+    pool = descriptor_pool.Default()
+    try:
+        filed = pool.Add(fd)
+    except TypeError:  # already registered (e.g. two servers in-process)
+        filed = pool.FindFileByName(fd.name)
+    req_cls = message_factory.GetMessageClass(
+        filed.message_types_by_name["HealthCheckRequest"])
+    resp_cls = message_factory.GetMessageClass(
+        filed.message_types_by_name["HealthCheckResponse"])
+    SERVING = 1
+
+    def check(request, context):
+        return resp_cls(status=SERVING)
+
+    def watch(request, context):
+        # per the health protocol, Watch sends the current status and then
+        # KEEPS THE STREAM OPEN, sending again only on change; this server
+        # is SERVING for its whole lifetime, so: one message, then hold
+        # until the client cancels or the server shuts down
+        yield resp_cls(status=SERVING)
+        done = threading.Event()
+        context.add_callback(done.set)
+        done.wait()
+
+    return {
+        "Check": grpc.unary_unary_rpc_method_handler(
+            check, request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString),
+        "Watch": grpc.unary_stream_rpc_method_handler(
+            watch, request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString),
+    }
+
+
 def make_server(daemon: Daemon, port: int = DEFAULT_PORT,
                 max_workers: int = 16,
                 host: str = "0.0.0.0") -> tuple[grpc.Server, int]:
-    """Build the gRPC server with the three reference services."""
+    """Build the gRPC server with the three reference services plus the
+    standard health service."""
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     tables = [
         ("Local", pb.LOCAL_METHODS),
@@ -301,6 +408,10 @@ def make_server(daemon: Daemon, port: int = DEFAULT_PORT,
             grpc.method_handlers_generic_handler(
                 f"{pb.PACKAGE}.{service}", handlers),
         ))
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(
+            "grpc.health.v1.Health", _health_handlers()),
+    ))
     # all interfaces by default: peer daemons (Remote.Update) and the
     # physical-join CLI dial in from other hosts, like the reference's
     # :51111 listener (daemon/kubedtn/kubedtn.go:104)
