@@ -301,9 +301,13 @@ class TimingWheel:
         self._lib.kdt_tw_schedule(self._h, max(0, int(when_us)), token)
 
     def advance(self, now_us: int) -> list[int]:
+        # clamp BEFORE the c_uint64 coercion: a negative elapsed time (clock
+        # skew, synthetic test clocks) would wrap to ~1.8e19 and permanently
+        # fast-forward the wheel, releasing everything ever scheduled
+        now_us = max(0, int(now_us))
         out: list[int] = []
         while True:
-            n = self._lib.kdt_tw_advance(self._h, int(now_us), self._out,
+            n = self._lib.kdt_tw_advance(self._h, now_us, self._out,
                                          len(self._out))
             out.extend(self._out[:n])
             if n < len(self._out):

@@ -273,7 +273,13 @@ class SimEngine:
         lock itself, and add_links must issue its cross-node completion
         RPCs with the lock released — holding it here would let two nodes'
         SetupPods deadlock dialing each other (the scenario behind the
-        reference's unlock-early discipline, handler.go:442-446)."""
+        reference's unlock-early discipline, handler.go:442-446).
+
+        Returns add_links' verdict: a failed cross-node completion RPC
+        surfaces as False so the caller (gRPC SetupPod → CNI, or a
+        reconcile pass) can retry instead of recording the link as
+        realized (the reference propagates the same failure,
+        handler.go:524-532)."""
         t0 = time.perf_counter()
         try:
             topo = self.get_pod(name, ns)
@@ -282,9 +288,9 @@ class SimEngine:
             return True
         self.set_alive(name, ns, self.node_ip, net_ns or f"/run/netns/{name}")
         topo = self.get_pod(name, ns)
-        self.add_links(topo, topo.spec.links)
+        ok = self.add_links(topo, topo.spec.links)
         self.stats.observe("setup", (time.perf_counter() - t0) * 1e3)
-        return True
+        return ok
 
     def destroy_pod(self, name: str, ns: str = "default") -> bool:
         """Local.DestroyPod equivalent (handler.go:538-590). Not @_locked

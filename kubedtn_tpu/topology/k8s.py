@@ -98,7 +98,10 @@ def make_kube_api(namespace: str | None = None):
                 GROUP, VERSION, ns, PLURAL, name,
                 {"metadata": {"finalizers": finalizers}})
 
-    return _Api()
+    wrapped = _Api()
+    # advertise the scope so K8sBridge.sync_once GCs only inside it
+    wrapped.namespace = namespace
+    return wrapped
 
 
 class K8sBridge:
@@ -127,8 +130,12 @@ class K8sBridge:
             self._apply(manifest)
             t = Topology.from_manifest(manifest)
             seen.add(t.key)
-        # objects gone from the cluster while we were away
-        for t in self.store.list():
+        # Objects gone from the cluster while we were away. GC only within
+        # the transport's visibility: a namespace-scoped LIST says nothing
+        # about other namespaces, so deleting store objects outside its
+        # scope would wrongly wipe them on every resync.
+        scope = getattr(self.api, "namespace", None)
+        for t in self.store.list(scope):
             if t.key not in seen:
                 self._delete(t.namespace, t.name)
         return len(items)

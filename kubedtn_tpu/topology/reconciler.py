@@ -68,6 +68,11 @@ class ReconcileResult:
     added: int = 0
     deleted: int = 0
     updated: int = 0
+    # False ⇔ an engine op reported failure (e.g. a cross-node completion
+    # RPC); status is NOT copied in that case, so the next pass re-diffs
+    # and retries — the reference returns the error to controller-runtime
+    # for requeue (topology_controller.go:120-122)
+    ok: bool = True
     phase_ms: dict[str, float] = field(default_factory=dict)
 
 
@@ -78,6 +83,9 @@ class Reconciler:
         self.store = store
         self.engine = engine
         self._watch = store.watch()
+        # keys whose last reconcile failed, retried on the next drain pass
+        # (controller-runtime's requeue-on-error)
+        self._requeue: set[tuple[str, str]] = set()
 
     def reconcile(self, namespace: str, name: str) -> ReconcileResult:
         """One reconcile pass for one Topology, mirroring Reconcile
@@ -101,17 +109,27 @@ class Reconciler:
             add, delete, changed = calc_diff(topo.status.links,
                                              topo.spec.links)
             t0 = time.perf_counter()
-            self.engine.del_links(topo, delete)
+            result.ok &= self.engine.del_links(topo, delete)
             result.phase_ms["del"] = (time.perf_counter() - t0) * 1e3
             t0 = time.perf_counter()
-            self.engine.add_links(topo, add)
+            result.ok &= self.engine.add_links(topo, add)
             result.phase_ms["add"] = (time.perf_counter() - t0) * 1e3
             t0 = time.perf_counter()
-            self.engine.update_links(topo, changed)
+            result.ok &= self.engine.update_links(topo, changed)
             result.phase_ms["update"] = (time.perf_counter() - t0) * 1e3
             result.added = len(add)
             result.deleted = len(delete)
             result.updated = len(changed)
+
+        if not result.ok:
+            # Engine failure (e.g. the peer daemon rejected a cross-node
+            # completion): leave status stale so the link is NOT recorded
+            # as realized — the next pass re-diffs and retries, exactly
+            # like controller-runtime requeueing on a returned error
+            # (reference topology_controller.go:120-122). Copying status
+            # here would declare a half-realized link done forever.
+            result.phase_ms["total"] = (time.perf_counter() - t_start) * 1e3
+            return result
 
         t0 = time.perf_counter()
 
@@ -135,15 +153,19 @@ class Reconciler:
         results: list[ReconcileResult] = []
         for _ in range(max_passes):
             events = list(self._watch.poll())
-            if not events:
+            retries, self._requeue = self._requeue, set()
+            if not events and not retries:
                 return results
             seen: set[tuple[str, str]] = set()
-            for ev in events:
-                nk = (ev.topology.namespace, ev.topology.name)
+            for nk in [(ev.topology.namespace, ev.topology.name)
+                       for ev in events] + sorted(retries):
                 if nk in seen:
                     continue
                 seen.add(nk)
-                results.append(self.reconcile(*nk))
+                res = self.reconcile(*nk)
+                if not res.ok:
+                    self._requeue.add(nk)
+                results.append(res)
         return results
 
     def reconcile_all(self) -> list[ReconcileResult]:

@@ -96,10 +96,14 @@ class WireManager:
 class Daemon:
     """Service implementations bound to one engine."""
 
-    def __init__(self, engine: SimEngine, latency_histograms=None) -> None:
+    def __init__(self, engine: SimEngine, latency_histograms=None,
+                 forward_timeout_s: float = 0.5) -> None:
         self.engine = engine
         self.wires = WireManager()
         self.hist = latency_histograms
+        # deadline on per-frame peer forwards: a blackholed peer must cost
+        # at most this long, never stall the data plane indefinitely
+        self.forward_timeout_s = forward_timeout_s
         # Per-protocol ingress counters via the native frame classifier —
         # the per-packet role of the reference's DecodeFrame debug logging
         # (grpcwire.go:429-450), kept as cheap counters instead of strings.
@@ -301,10 +305,12 @@ class Daemon:
         if wire.peer_ip:
             # cross-node wire: the shaped frame crosses to the peer daemon
             # (one unary SendToOnce per frame, reference grpcwire.go:452);
-            # errors are counted and the frame dropped, not fatal (:452-459)
+            # errors — including DEADLINE_EXCEEDED from a blackholed peer —
+            # are counted and the frame dropped, not fatal (:452-459)
             try:
-                self._peer_wire_client(wire.peer_ip).SendToOnce(pb.Packet(
-                    remot_intf_id=wire.peer_intf_id, frame=frame))
+                self._peer_wire_client(wire.peer_ip).SendToOnce(
+                    pb.Packet(remot_intf_id=wire.peer_intf_id, frame=frame),
+                    timeout=self.forward_timeout_s)
                 return True
             except Exception:
                 self.forward_errors += 1
@@ -368,15 +374,30 @@ def _health_handlers():
     def check(request, context):
         return resp_cls(status=SERVING)
 
+    # Each parked Watch stream pins one thread-pool worker for its whole
+    # lifetime (sync gRPC consumes response generators from the pool), so
+    # unbounded watchers could starve every other RPC on a 16-thread pool.
+    # Cap the parked streams; watchers beyond the cap get the current
+    # status and a clean stream close — the health protocol requires
+    # clients to re-Watch on termination, so they degrade to polling
+    # instead of starving the daemon.
+    max_parked_watchers = 4
+    watch_slots = threading.BoundedSemaphore(max_parked_watchers)
+
     def watch(request, context):
         # per the health protocol, Watch sends the current status and then
-        # KEEPS THE STREAM OPEN, sending again only on change; this server
+        # keeps the stream open, sending again only on change; this server
         # is SERVING for its whole lifetime, so: one message, then hold
         # until the client cancels or the server shuts down
         yield resp_cls(status=SERVING)
-        done = threading.Event()
-        context.add_callback(done.set)
-        done.wait()
+        if not watch_slots.acquire(blocking=False):
+            return  # over the parking cap: close; client re-Watches
+        try:
+            done = threading.Event()
+            context.add_callback(done.set)
+            done.wait()
+        finally:
+            watch_slots.release()
 
     return {
         "Check": grpc.unary_unary_rpc_method_handler(

@@ -256,3 +256,92 @@ def test_health_watch_stream_stays_open(two_nodes):
     assert q.empty(), "Watch stream closed prematurely"
     call.cancel()
     channel.close()
+
+
+def test_deliver_egress_deadline_on_blackholed_peer():
+    """Regression: a peer daemon that accepts the connection but never
+    answers must cost at most forward_timeout_s per frame, not stall the
+    tick thread forever."""
+
+    class BlackholeDaemon(Daemon):
+        def SendToOnce(self, request, context):
+            time.sleep(5)
+            return pb.BoolResponse(response=True)
+
+    store_b = TopologyStore()
+    engine_b = SimEngine(store_b, capacity=16)
+    daemon_b = BlackholeDaemon(engine_b)
+    server_b, port_b = make_server(daemon_b, port=0, host="127.0.0.1")
+    server_b.start()
+    addr_b = f"127.0.0.1:{port_b}"
+
+    store_a = TopologyStore()
+    engine_a = SimEngine(store_a, capacity=16)
+    daemon_a = Daemon(engine_a, forward_timeout_s=0.2)
+    daemon_a._add_wire(pb.WireDef(
+        local_pod_name="r1", kube_ns="default", link_uid=7,
+        intf_name_in_pod="eth1", peer_ip=addr_b, peer_intf_id=1))
+
+    t0 = time.perf_counter()
+    ok = daemon_a.deliver_egress("default/r1", 7, b"x" * 60)
+    elapsed = time.perf_counter() - t0
+    assert ok is False
+    assert daemon_a.forward_errors == 1
+    assert elapsed < 2.0, f"forward blocked {elapsed:.1f}s despite deadline"
+    server_b.stop(0)
+
+
+def test_health_watch_parking_capped(two_nodes):
+    """Regression: parked Watch streams must never starve the RPC pool —
+    beyond the parking cap, watchers get the status and a clean close,
+    and unary RPCs keep being served."""
+    import queue
+    import threading
+
+    import grpc
+
+    (_, _, _, _, addr_a), _ = two_nodes
+    channel = grpc.insecure_channel(addr_a)
+    watch = channel.unary_stream(
+        "/grpc.health.v1.Health/Watch",
+        request_serializer=lambda m: m,
+        response_deserializer=lambda b: b)
+    calls = [watch(b"") for _ in range(10)]
+    got_first: queue.Queue = queue.Queue()
+    closed: queue.Queue = queue.Queue()
+
+    def consume(call):
+        try:
+            it = iter(call)
+            got_first.put(next(it))
+            for _ in it:
+                pass
+            closed.put(True)        # server closed the stream (over cap)
+        except grpc.RpcError:
+            closed.put(False)       # cancelled at teardown (parked)
+
+    for call in calls:
+        threading.Thread(target=consume, args=(call,), daemon=True).start()
+    firsts = [got_first.get(timeout=10) for _ in range(10)]
+    assert all(f == b"\x08\x01" for f in firsts)  # everyone saw SERVING
+
+    # over-cap watchers end promptly, freeing their pool workers
+    ended = 0
+    deadline = time.time() + 5
+    while ended < 6 and time.time() < deadline:
+        try:
+            closed.get(timeout=0.2)
+            ended += 1
+        except queue.Empty:
+            pass
+    assert ended >= 6, f"only {ended} over-cap watchers closed"
+
+    # with the remaining watchers parked, unary RPCs still go through
+    check = channel.unary_unary(
+        "/grpc.health.v1.Health/Check",
+        request_serializer=lambda m: m,
+        response_deserializer=lambda b: b)
+    assert check(b"", timeout=5) == b"\x08\x01"
+    for call in calls:
+        call.cancel()
+    channel.close()

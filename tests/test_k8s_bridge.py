@@ -276,3 +276,40 @@ def test_restarted_informer_gets_fresh_stop_event():
     assert bridge._stop is not ev1 and not bridge._stop.is_set()
     release.set()
     bridge.stop()
+
+
+def test_sync_once_gc_scoped_to_transport_namespace():
+    """Regression: a namespace-scoped LIST says nothing about other
+    namespaces — resync GC must not delete store objects outside the
+    transport's scope."""
+    api = FakeClusterApi()
+    api.namespace = "scoped"          # transport advertises its scope
+    m = manifest("r1")
+    m["metadata"]["namespace"] = "scoped"
+    api.put(m)
+    store = TopologyStore()
+    store.create(Topology(name="other", namespace="default",
+                          spec=TopologySpec(links=[])))
+    # stale object INSIDE the scope: still GCed
+    store.create(Topology(name="gone", namespace="scoped",
+                          spec=TopologySpec(links=[])))
+    bridge = K8sBridge(store, api)
+    bridge.sync_once()
+    assert store.get("scoped", "r1") is not None
+    assert store.get("default", "other") is not None   # survived the resync
+    with pytest.raises(NotFoundError):
+        store.get("scoped", "gone")
+
+
+def test_sync_once_gc_cluster_scoped_unchanged():
+    """Without a namespace attribute the transport is cluster-scoped and
+    GC covers everything, as before."""
+    api = FakeClusterApi()
+    api.put(manifest("r1"))
+    store = TopologyStore()
+    store.create(Topology(name="stale", namespace="elsewhere",
+                          spec=TopologySpec(links=[])))
+    bridge = K8sBridge(store, api)
+    bridge.sync_once()
+    with pytest.raises(NotFoundError):
+        store.get("elsewhere", "stale")

@@ -359,3 +359,17 @@ def test_wheel_strict_no_early_release_randomized():
             assert tok not in released
             released.add(tok)
     assert len(released) == 500
+
+
+def test_wheel_advance_clamps_negative_time():
+    """Regression: a negative elapsed time must NOT wrap through c_uint64
+    into ~1.8e19 µs — that would release every scheduled token early and
+    permanently fast-forward the wheel."""
+    tw = native.TimingWheel(tick_us=1000)
+    tw.schedule(5_000, 42)
+    assert tw.advance(-1) == []          # clamped to 0, nothing due
+    assert tw.advance(-10_000_000) == []
+    assert len(tw) == 1                  # token survived
+    assert tw.advance(6_000) == [42]     # wheel time not fast-forwarded
+    tw.schedule(2_000, 7)                # still schedulable after the clamp
+    assert tw.advance(2_500) == [7]

@@ -266,3 +266,62 @@ def test_destroy_pod_with_pending_deletion():
     # r3's links (uids 2, 3) died in both directions; uid 1 survives
     assert engine.num_active == 2
     assert engine.row_of("default/r1", 1) is not None
+
+
+class TestEngineFailurePropagation:
+    """Regression: a failed engine op (e.g. a rejected cross-node
+    completion RPC) must not be recorded as realized — the reference
+    returns the error to controller-runtime so the request requeues
+    (reference daemon/kubedtn/handler.go:524-532,
+    controllers/topology_controller.go:120-122)."""
+
+    class FlakyEngine(SimEngine):
+        def __init__(self, *a, fail_times=1, **kw):
+            super().__init__(*a, **kw)
+            self.fail_times = fail_times
+
+        def add_links(self, topo, links):
+            if links and self.fail_times > 0:
+                self.fail_times -= 1
+                return False  # e.g. peer daemon unreachable; nothing realized
+            return super().add_links(topo, links)
+
+    def topo(self):
+        link = Link(local_intf="eth1", peer_intf="eth1", peer_pod="r2",
+                    uid=1, properties=LinkProperties(latency="10ms"))
+        t = Topology(name="r1", spec=TopologySpec(links=[link]))
+        t.status.links = []  # already seen: reconcile must plumb the add
+        return t
+
+    def test_setup_pod_propagates_add_failure(self):
+        store = TopologyStore()
+        engine = self.FlakyEngine(store, capacity=16)
+        t = self.topo()
+        t.status.links = None
+        store.create(t)
+        assert engine.setup_pod("r1") is False
+        engine.fail_times = 0
+        assert engine.setup_pod("r1") is True
+
+    def test_failed_reconcile_keeps_status_stale_and_requeues(self):
+        store = TopologyStore()
+        engine = self.FlakyEngine(store, capacity=16)
+        store.create(self.topo())
+        rec = Reconciler(store, engine)
+        results = rec.drain()
+        # pass 1: add fails -> status NOT copied; pass 2 (requeue): add
+        # succeeds -> status copied; pass 3: MODIFIED event -> noop
+        assert [r.ok for r in results] == [False, True, True]
+        assert results[0].action == "changed"
+        assert results[-1].action == "noop"
+        fresh = store.get("default", "r1")
+        assert fresh.status.links == fresh.spec.links
+
+    def test_failed_reconcile_does_not_copy_status(self):
+        store = TopologyStore()
+        engine = self.FlakyEngine(store, capacity=16, fail_times=10**9)
+        store.create(self.topo())
+        rec = Reconciler(store, engine)
+        res = rec.reconcile("default", "r1")
+        assert res.ok is False
+        assert store.get("default", "r1").status.links == []  # still stale
