@@ -21,6 +21,7 @@ the scaling mechanism.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -59,6 +60,68 @@ def calc_diff(old: list[Link], new: list[Link]):
         and old_by_id[_identity(l)].properties != l.properties
     ]
     return add, delete, changed
+
+
+class WorkQueue:
+    """client-go-style rate-unlimited workqueue: the dedup discipline that
+    lets the reference run 32 concurrent reconcile workers safely
+    (reference controllers/topology_controller.go:336 sets
+    MaxConcurrentReconciles; the queue semantics are client-go
+    util/workqueue's dirty/processing sets).
+
+    Invariants (re-derived, not translated):
+    - a key is never handed to two workers at once (per-topology ordering);
+    - add() of a key currently being processed marks it dirty, and done()
+      re-queues it — an update arriving mid-reconcile is never lost;
+    - add() of a key already queued is a no-op (dedup/coalescing).
+    """
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._queue: list = []          # FIFO of keys ready for a worker
+        self._dirty: set = set()        # keys needing (re)processing
+        self._processing: set = set()   # keys a worker holds right now
+        self._shutdown = False
+
+    def add(self, key) -> None:
+        with self._cond:
+            if self._shutdown or key in self._dirty:
+                return
+            self._dirty.add(key)
+            if key in self._processing:
+                return  # done() will re-queue it
+            self._queue.append(key)
+            self._cond.notify()
+
+    def get(self, timeout: float | None = None):
+        """Blocking take; returns None on shutdown or timeout."""
+        with self._cond:
+            while not self._queue and not self._shutdown:
+                if not self._cond.wait(timeout):
+                    return None
+            if not self._queue:
+                return None
+            key = self._queue.pop(0)
+            self._processing.add(key)
+            self._dirty.discard(key)
+            return key
+
+    def done(self, key) -> None:
+        with self._cond:
+            self._processing.discard(key)
+            if key in self._dirty and not self._shutdown:
+                self._queue.append(key)
+                self._cond.notify()
+
+    def idle(self) -> bool:
+        with self._cond:
+            return not self._queue and not self._processing \
+                and not self._dirty
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
 
 
 @dataclass
@@ -146,10 +209,16 @@ class Reconciler:
         result.phase_ms["total"] = (time.perf_counter() - t_start) * 1e3
         return result
 
-    def drain(self, max_passes: int = 64) -> list[ReconcileResult]:
+    def drain(self, max_passes: int = 64,
+              workers: int = 1) -> list[ReconcileResult]:
         """Process watch events until the store is steady — the loop the
         controller-runtime manager provides in the reference
-        (reference main.go:104-110)."""
+        (reference main.go:104-110). workers>1 runs the reference's
+        concurrent-reconciler shape (MaxConcurrentReconciles=32,
+        topology_controller.go:336) over a WorkQueue, preserving
+        per-topology ordering."""
+        if workers > 1:
+            return self._drain_concurrent(max_passes, workers)
         results: list[ReconcileResult] = []
         for _ in range(max_passes):
             events = list(self._watch.poll())
@@ -166,6 +235,63 @@ class Reconciler:
                 if not res.ok:
                     self._requeue.add(nk)
                 results.append(res)
+        return results
+
+    def _drain_concurrent(self, max_passes: int,
+                          workers: int) -> list[ReconcileResult]:
+        q = WorkQueue()
+        results: list[ReconcileResult] = []
+        lock = threading.Lock()
+        attempts: dict[tuple[str, str], int] = {}
+        stop = threading.Event()
+
+        def work() -> None:
+            while True:
+                key = q.get(timeout=0.02)
+                if key is None:
+                    if stop.is_set():
+                        return
+                    continue
+                res = self.reconcile(*key)
+                with lock:
+                    results.append(res)
+                    if not res.ok:
+                        attempts[key] = attempts.get(key, 0) + 1
+                        if attempts[key] < max_passes:
+                            q.add(key)  # bounded in-drain retry
+                        else:
+                            self._requeue.add(key)  # next drain's problem
+                q.done(key)
+
+        threads = [threading.Thread(target=work, daemon=True,
+                                    name=f"reconcile-{i}")
+                   for i in range(workers)]
+        for t in threads:
+            t.start()
+        retries, self._requeue = self._requeue, set()
+        for nk in sorted(retries):
+            q.add(nk)
+        try:
+            while True:
+                pumped = 0
+                for ev in self._watch.poll():
+                    q.add((ev.topology.namespace, ev.topology.name))
+                    pumped += 1
+                if pumped == 0 and q.idle():
+                    # workers emit status-copy events BEFORE q.done(), so
+                    # with the queue idle any stragglers are already in
+                    # the watch deque — one more empty poll means steady
+                    stragglers = list(self._watch.poll())
+                    if not stragglers:
+                        break
+                    for ev in stragglers:
+                        q.add((ev.topology.namespace, ev.topology.name))
+                time.sleep(0.001)
+        finally:
+            stop.set()
+            q.shut_down()
+            for t in threads:
+                t.join(timeout=5)
         return results
 
     def reconcile_all(self) -> list[ReconcileResult]:

@@ -74,6 +74,23 @@ class WireManager:
             self._by_id[wire.wire_id] = wire
             self._by_key[(wire.pod_key, wire.uid)] = wire
 
+    def get_or_create(self, pod_key: str, uid: int, build) -> tuple:
+        """Atomic wire-exists guard: two racing creates for the same
+        (pod, uid) yield ONE wire — the reference de-duplicates racing
+        CreateGRPCWire calls via the wire-exists/IsReady check under its
+        map lock (reference daemon/grpcwire/grpcwire.go:292-383).
+        `build(wire_id)` constructs the wire only when absent. Returns
+        (wire, created)."""
+        with self._lock:
+            wire = self._by_key.get((pod_key, uid))
+            if wire is not None:
+                return wire, False
+            self._next_wire_id += 1
+            wire = build(self._next_wire_id)
+            self._by_id[wire.wire_id] = wire
+            self._by_key[(wire.pod_key, wire.uid)] = wire
+            return wire, True
+
     def get_by_id(self, wire_id: int) -> Wire | None:
         return self._by_id.get(wire_id)
 
@@ -219,18 +236,27 @@ class Daemon:
                                      peer_intf_id=wire.wire_id)
 
     def _add_wire(self, wd) -> Wire:
+        """Idempotent per (pod, uid): two racing AddGRPCWire calls for the
+        same link get the SAME wire (parity with the reference's
+        wire-exists guard, grpcwire.go:292-383) — without it, each racer
+        would allocate its own wire and the link would split-brain."""
         pod_key = f"{wd.kube_ns or 'default'}/{wd.local_pod_name}"
+        # name generated outside the registry lock (it takes the same
+        # lock); an unused name for the loser of the race is harmless
         name = wd.veth_name_local_host or self.wires.gen_node_iface_name(
             wd.local_pod_name, wd.intf_name_in_pod)
-        wire = Wire(
-            wire_id=self.wires.next_wire_id(),
-            uid=int(wd.link_uid),
-            pod_key=pod_key,
-            node_iface_name=name,
-            peer_intf_id=int(wd.peer_intf_id),
-            peer_ip=wd.peer_ip,
-        )
-        self.wires.add(wire)
+
+        def build(wire_id: int) -> Wire:
+            return Wire(
+                wire_id=wire_id,
+                uid=int(wd.link_uid),
+                pod_key=pod_key,
+                node_iface_name=name,
+                peer_intf_id=int(wd.peer_intf_id),
+                peer_ip=wd.peer_ip,
+            )
+
+        wire, _ = self.wires.get_or_create(pod_key, int(wd.link_uid), build)
         return wire
 
     # -- WireProtocol --------------------------------------------------

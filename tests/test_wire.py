@@ -178,3 +178,47 @@ def test_concurrent_rpcs_race_free(daemon_and_client):
     # every remote row realized
     for i in range(24):
         assert engine.link_row(f"default/rp{i}", 1000 + i) is not None
+
+
+def test_racing_wire_creates_yield_one_wire():
+    """Regression: two concurrent AddGRPCWireRemote calls for the same
+    (pod, uid) must de-duplicate into ONE wire (the reference's
+    wire-exists guard, grpcwire.go:292-383), both receiving its id."""
+    import threading
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    daemon = Daemon(engine)
+    server, port = make_server(daemon, port=0, host="127.0.0.1")
+    server.start()
+    client = DaemonClient(f"127.0.0.1:{port}")
+
+    n = 8
+    barrier = threading.Barrier(n)
+    ids = []
+    lock = threading.Lock()
+
+    def create():
+        barrier.wait()
+        resp = client.AddGRPCWireRemote(pb.WireDef(
+            local_pod_name="r1", kube_ns="default", link_uid=5,
+            intf_name_in_pod="eth1", peer_ip="10.0.0.9"))
+        with lock:
+            ids.append(resp.peer_intf_id)
+
+    threads = [threading.Thread(target=create) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(ids) == n
+    assert len(set(ids)) == 1, f"racing creates split-brained: {set(ids)}"
+    assert len(daemon.wires.all()) == 1
+    # a DIFFERENT link on the same pod still gets its own wire
+    resp2 = client.AddGRPCWireRemote(pb.WireDef(
+        local_pod_name="r1", kube_ns="default", link_uid=6,
+        intf_name_in_pod="eth2", peer_ip="10.0.0.9"))
+    assert resp2.peer_intf_id not in set(ids)
+    assert len(daemon.wires.all()) == 2
+    client.close()
+    server.stop(0)
