@@ -18,31 +18,95 @@ iteration updates the other end, alternating — no caching shortcuts.
 
 Prints ONE JSON line:
   {"metric": "link-updates/sec", "value": ..., "unit": "links/s",
-   "vs_baseline": value / 1e6}
+   "vs_baseline": value / 1e6, "extras": {...}}
 vs_baseline is relative to the driver-set target of 1M link-updates/sec on
 a 100k-link topology (BASELINE.json `metric`/`north_star`).
+
+extras carries the other BASELINE evidence:
+  - reconcile_100k: reconcile-to-steady through the REAL control path
+    (store → reconciler → engine → device), target <5s @100k links, plus
+    the churn and live-gRPC UpdateLinks round-trip numbers
+    (kubedtn_tpu.scenarios.reconcile_100k);
+  - shape_vmapped_pkts_per_s / shape_pallas_pkts_per_s: the netem shaping
+    kernel timed on device both ways (ops/netem.shape_step vs
+    ops/pallas/shaping.shape_step, interpret=False on TPU) — the on-
+    hardware validation of the pallas-vs-XLA claim in ops/netem.py.
+
+Robustness: the JAX backend behind the tunneled TPU chip can hang or come
+up UNAVAILABLE. Backend init is probed in a KILLABLE subprocess with a
+deadline and retried with backoff before this process commits to it; each
+measurement phase retries transient failures; a phase that ultimately
+fails reports its error in extras instead of killing the whole bench, and
+a total failure still prints the one-line JSON (value 0, error set) so the
+driver always gets a parseable record.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from kubedtn_tpu.api.types import LinkProperties
-from kubedtn_tpu.models.topologies import clos, load_edge_list_into_state
-from kubedtn_tpu.ops import edge_state as es
+import traceback
 
 N_SPINE = 100
 N_LEAF = 500
 LINKS_PER_PAIR = 2  # 100 * 500 * 2 = 100_000 links
 ITERS = 100
+SHAPE_ITERS = 100
+
+PROBE_ATTEMPTS = 3
+PROBE_TIMEOUT_S = 240
+PHASE_ATTEMPTS = 2
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def probe_backend() -> bool:
+    """Initialize the JAX backend in a killable subprocess first: a hung
+    device tunnel then costs one bounded probe, not the whole bench."""
+    code = "import jax; print(jax.default_backend(), len(jax.devices()))"
+    for attempt in range(1, PROBE_ATTEMPTS + 1):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if r.returncode == 0:
+                log(f"backend probe ok: {r.stdout.strip()}")
+                return True
+            log(f"backend probe attempt {attempt} rc={r.returncode}: "
+                f"{r.stderr.strip()[-400:]}")
+        except subprocess.TimeoutExpired:
+            log(f"backend probe attempt {attempt} timed out "
+                f"after {PROBE_TIMEOUT_S}s")
+        time.sleep(5 * attempt)
+    return False
+
+
+def with_retry(phase: str, fn, extras: dict):
+    """Run one measurement phase with bounded retries; on final failure
+    record the error in extras and return None."""
+    for attempt in range(1, PHASE_ATTEMPTS + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — any backend error retries
+            log(f"{phase} attempt {attempt} failed: {e!r}")
+            if attempt == PHASE_ATTEMPTS:
+                extras[f"{phase}_error"] = f"{type(e).__name__}: {e}"[:300]
+                log(traceback.format_exc())
+            else:
+                time.sleep(3 * attempt)
+    return None
 
 
 def build():
+    from kubedtn_tpu.api.types import LinkProperties
+    from kubedtn_tpu.models.topologies import clos, load_edge_list_into_state
+
     el = clos(N_SPINE, N_LEAF, hosts_per_leaf=0,
               props=LinkProperties(latency="10ms", rate="10Gbit"),
               links_per_pair=LINKS_PER_PAIR)
@@ -53,6 +117,11 @@ def build():
 
 def fresh_props(n, seed):
     """Pre-stage n random-but-valid property rows on device."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubedtn_tpu.ops import edge_state as es
+
     rng = np.random.default_rng(seed)
     base = np.zeros((n, es.NPROP), np.float32)
     base[:, es.P_LATENCY_US] = rng.integers(1_000, 100_000, n)
@@ -63,8 +132,15 @@ def fresh_props(n, seed):
     return jnp.asarray(base)
 
 
-def main():
+def bench_link_updates() -> float:
+    """Headline: batched UpdateLinks throughput under one lax.scan."""
     import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubedtn_tpu.ops import edge_state as es
 
     el, state, rows = build()
     L = el.n_links
@@ -96,13 +172,168 @@ def main():
     state = run(state, ITERS)
     jax.block_until_ready(state)
     dt = time.perf_counter() - t0
+    return L * ITERS / dt
 
-    updates_per_sec = L * ITERS / dt
+
+def bench_shape_step(extras: dict) -> None:
+    """Time the netem shaping kernel on device: XLA-vmapped vs Pallas
+    (interpret=False on TPU), same key — turns the '~12% faster' claim in
+    ops/netem.py into recorded on-hardware evidence."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubedtn_tpu.ops import netem
+
+    el, state, rows = build()
+    E = state.capacity
+    n_active = int(jnp.sum(state.active))
+    sizes = jnp.full((E,), 1500.0, jnp.float32)
+    t0s = jnp.zeros((E,), jnp.float32)
+    key = jax.random.key(7)
+
+    def timed(step_fn, label):
+        @functools.partial(jax.jit, donate_argnums=0, static_argnums=1)
+        def run(st, iters):
+            def body(st, i):
+                st, _res = step_fn(st, sizes, st.active, t0s,
+                                   jax.random.fold_in(key, i))
+                return st, ()
+            st, _ = jax.lax.scan(body, st, jnp.arange(iters))
+            return st
+
+        # run donates its argument — hand each timing its own copy so the
+        # shared baseline state survives for the next variant; report the
+        # median of 3 (the tunneled chip's run-to-run variance is large)
+        samples = []
+        for _ in range(3):
+            st = run(jax.tree.map(lambda x: x.copy(), state), SHAPE_ITERS)
+            jax.block_until_ready(st.props)
+            t0 = time.perf_counter()
+            st = run(st, SHAPE_ITERS)
+            jax.block_until_ready(st.props)
+            samples.append(time.perf_counter() - t0)
+        dt = sorted(samples)[1]
+        extras[label] = round(n_active * SHAPE_ITERS / dt, 1)
+
+    timed(netem.shape_step, "shape_vmapped_pkts_per_s")
+    if jax.default_backend() == "tpu":
+        from kubedtn_tpu.ops.pallas import shaping
+
+        timed(lambda st, s, h, t, k: shaping.shape_step(
+            st, s, h, t, k, interpret=False), "shape_pallas_pkts_per_s")
+    else:
+        extras["shape_pallas_pkts_per_s"] = None
+        extras["shape_pallas_note"] = "skipped: non-TPU backend"
+
+
+def bench_wire_streaming(extras: dict) -> None:
+    """Frame-forwarding microbench over a real loopback gRPC daemon:
+    per-frame unary SendToOnce (the reference's hot loop,
+    grpcwire.go:452) vs one client-streaming SendToStream batch — the
+    evidence that the streaming egress path beats unary."""
+    from kubedtn_tpu.topology import SimEngine, TopologyStore
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+    from kubedtn_tpu.wire.server import Daemon, make_server
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    daemon = Daemon(engine)
+    server, port = make_server(daemon, port=0, host="127.0.0.1")
+    server.start()
+    client = DaemonClient(f"127.0.0.1:{port}")
+    wire = daemon._add_wire(pb.WireDef(
+        local_pod_name="w", kube_ns="default", link_uid=1,
+        intf_name_in_pod="eth0", peer_ip="10.0.0.2"))
+    n = 2000
+    pkts = [pb.Packet(remot_intf_id=wire.wire_id, frame=b"f" * 200)
+            for _ in range(n)]
+    client.SendToOnce(pkts[0])  # warm the channel
+    t0 = time.perf_counter()
+    for p in pkts:
+        client.SendToOnce(p)
+    unary_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    client.SendToStream(iter(pkts))
+    stream_s = time.perf_counter() - t0
+    assert len(wire.egress) == 2 * n + 1
+    client.close()
+    server.stop(0)
+    extras["wire_unary_frames_per_s"] = round(n / unary_s, 1)
+    extras["wire_stream_frames_per_s"] = round(n / stream_s, 1)
+    extras["wire_stream_speedup"] = round(unary_s / stream_s, 2)
+
+
+def main() -> None:
+    t_bench = time.perf_counter()
+    extras: dict = {}
+
+    if not probe_backend():
+        extras["backend_probe"] = "failed; forcing CPU fallback"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        extras["degraded"] = True
+
+    try:
+        import jax
+    except Exception as e:  # even a broken install must yield the JSON line
+        print(json.dumps({
+            "metric": "link-updates/sec", "value": 0.0, "unit": "links/s",
+            "vs_baseline": 0.0, "error": f"jax import failed: {e}",
+            "extras": extras,
+        }))
+        sys.exit(1)
+
+    # persistent compilation cache: repeat driver runs skip the big
+    # scatter/kernel compiles entirely
+    try:
+        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # cache is an optimization, never fatal
+        log(f"compilation cache unavailable: {e!r}")
+
+    try:
+        extras["backend"] = jax.default_backend()
+    except Exception as e:
+        extras["backend"] = f"unavailable: {e}"
+
+    ups = with_retry("link_updates", bench_link_updates, extras)
+
+    with_retry("shape_step", lambda: bench_shape_step(extras), extras)
+
+    def run_reconcile():
+        from kubedtn_tpu.scenarios import reconcile_100k
+
+        r = reconcile_100k()
+        extras["reconcile_100k"] = {
+            k: r[k] for k in ("reconcile_s", "churn_s", "grpc_update_s",
+                              "links", "topologies", "device_calls",
+                              "meets_target")
+        }
+
+    with_retry("reconcile_100k", run_reconcile, extras)
+
+    with_retry("wire_streaming", lambda: bench_wire_streaming(extras),
+               extras)
+
+    extras["bench_wall_s"] = round(time.perf_counter() - t_bench, 1)
+    if ups is None:
+        print(json.dumps({
+            "metric": "link-updates/sec", "value": 0.0, "unit": "links/s",
+            "vs_baseline": 0.0,
+            "error": extras.get("link_updates_error", "unknown"),
+            "extras": extras,
+        }))
+        sys.exit(1)
     print(json.dumps({
         "metric": "link-updates/sec",
-        "value": round(updates_per_sec, 1),
+        "value": round(ups, 1),
         "unit": "links/s",
-        "vs_baseline": round(updates_per_sec / 1e6, 3),
+        "vs_baseline": round(ups / 1e6, 3),
+        "extras": extras,
     }))
 
 

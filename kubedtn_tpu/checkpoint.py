@@ -153,10 +153,17 @@ def load(path: str) -> tuple[TopologyStore, SimEngine]:
     with np.load(os.path.join(path, "edge_state.npz")) as z:
         engine.state = es.EdgeState(
             **{name: jnp.asarray(z[name]) for name in z.files})
+        # rebuild the host mirror the bypass guard consults: a restored
+        # shaped link must NOT read as unshaped (that would let same-node
+        # TCP flows skip its netem/TBF chain entirely)
+        shaped = np.flatnonzero(
+            z["active"] & np.asarray(z["props"]).any(axis=1))
+        engine._shaped_rows = set(int(r) for r in shaped)
 
     eng = manifest["engine"]
     engine._pod_ids = dict(eng["pod_ids"])
     engine._rows = {(p, int(u)): int(r) for p, u, r in eng["rows"]}
+    engine._row_owner = {r: k for k, r in engine._rows.items()}
     engine._peer = {(p, int(u)): (pp, int(pu))
                     for p, u, pp, pu in eng["peer"]}
     engine._free = [int(x) for x in eng["free"]]

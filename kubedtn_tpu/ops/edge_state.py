@@ -23,10 +23,12 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # Property-matrix column indices (order mirrors the LinkProperties fields,
 # reference api/v1/topology_types.go:119-176 / proto/v1 LinkProperties).
@@ -111,9 +113,25 @@ def init_state(capacity: int) -> EdgeState:
     )
 
 
-def props_row(numeric: dict) -> jnp.ndarray:
-    """Pack a LinkProperties.to_numeric() record into one props row."""
-    return jnp.array([numeric[name] for name in PROP_NAMES], dtype=jnp.float32)
+def props_row(numeric: dict) -> np.ndarray:
+    """Pack a LinkProperties.to_numeric() record into one props row.
+
+    Returns a HOST (numpy) row: per-link rows are staged on host and only
+    the batched matrix crosses to the device — materializing one device
+    array per link forced a device→host readback per link (~80ms over a
+    tunneled chip), which dominated reconcile time."""
+    return np.array([numeric[name] for name in PROP_NAMES], dtype=np.float32)
+
+
+@functools.lru_cache(maxsize=65536)
+def props_row_cached(props) -> np.ndarray:
+    """props_row keyed by a (frozen, hashable) LinkProperties value —
+    the engine's hot path packs the same few property sets for thousands
+    of links. The returned row is shared and marked read-only; batch
+    builders copy it when stacking."""
+    row = props_row(props.to_numeric())
+    row.flags.writeable = False
+    return row
 
 
 def burst_bytes(rate_bps: jax.Array) -> jax.Array:

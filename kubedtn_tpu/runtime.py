@@ -10,9 +10,25 @@ engine's edge state, holds them for their computed netem/TBF delay, then
 releases them to the wire egress queues — virtual time bound to the wall
 clock (the "real-time binding" of SURVEY.md §7 hard-part (e)).
 
-Cumulative per-edge counters feed the Prometheus interface collector, so a
-daemon's metrics are live whenever wires carry traffic (the reference's
-per-netns statistics scrape, daemon/metrics/interface_statistics.go:79-133).
+Three native fast paths ride the tick:
+
+- **TCP/IP bypass** (the eBPF sockops/redir capability, reference
+  bpf/lib/sockops.c, redir.c): same-node TCP flows over UNSHAPED links
+  short-circuit the shaping kernels entirely — the frame crosses to the
+  peer wire in the same tick, and `bypassed` counts it. A flow that ever
+  crosses a row with non-zero shaping properties is disabled forever
+  (redir_disable semantics, reference bpf/lib/redir_disable.c:44-48; the
+  guard attaches wherever qdiscs exist, common/qdisc.go:285-287).
+- **Lock-free shaping**: the tick snapshots row bindings under the engine
+  lock, runs the device kernels OUTSIDE it, and merges only the shaping-
+  dynamic columns back — a control-plane AddLinks never waits for a
+  data-plane device dispatch.
+- **Ring-staged streaming egress**: released cross-node frames stage in
+  the native SPSC FrameRing (the reference's per-wire pcap buffer role,
+  grpcwire.go:398-409) and cross to each peer daemon as ONE SendToStream
+  batch per tick instead of one unary SendToOnce per frame (the
+  reference's known per-packet weakness, grpcwire.go:452). Ring overflow
+  drops are counted in `counters.dropped_ring`.
 
 Delayed releases are held in the native hierarchical timing wheel
 (native/kubedtn_native.cc, via kubedtn_tpu.native.TimingWheel) — the role
@@ -22,9 +38,12 @@ a pure-Python heap fallback when the native library is unavailable.
 
 from __future__ import annotations
 
+import dataclasses
 import heapq
+import struct
 import threading
 import time
+from collections import deque
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +52,100 @@ import numpy as np
 from kubedtn_tpu import native
 from kubedtn_tpu.ops import netem
 from kubedtn_tpu.ops.queues import EdgeCounters, init_counters
+
+# Non-donating re-jits of the shaping kernels for the lock-free tick: the
+# stock kernels donate their EdgeState argument, which would invalidate
+# the very buffers engine._state still holds while shaping runs outside
+# the engine lock. Fresh-output versions cost one extra allocation per
+# tick and keep every concurrent reader safe.
+_VMAPPED_NODONATE = jax.jit(netem.shape_step.__wrapped__)
+_PALLAS_NODONATE = None
+
+
+def _shape_step_nodonate(state, sizes, have, t0s, key):
+    global _PALLAS_NODONATE
+    if jax.default_backend() == "tpu":
+        if _PALLAS_NODONATE is None:
+            from kubedtn_tpu.ops.pallas import shaping
+
+            _PALLAS_NODONATE = jax.jit(
+                shaping.shape_step.__wrapped__,
+                static_argnames=("interpret", "block_rows"))
+        return _PALLAS_NODONATE(state, sizes, have, t0s, key,
+                                interpret=False)
+    return _VMAPPED_NODONATE(state, sizes, have, t0s, key)
+
+_ETH_IPV4 = 0x0800
+_PROTO_TCP = 6
+
+
+def parse_tcp_flow(frame: bytes) -> tuple[int, int, int, int] | None:
+    """(src_ip, src_port, dst_ip, dst_port) for an IPv4/TCP ethernet
+    frame, else None — the 4-tuple the bypass flow table keys on (the
+    sockops programs see the same tuple, reference bpf/lib/sockops.c)."""
+    if len(frame) < 14:
+        return None
+    off = 14
+    ethertype = (frame[12] << 8) | frame[13]
+    if ethertype == 0x8100 and len(frame) >= 18:  # 802.1Q
+        ethertype = (frame[16] << 8) | frame[17]
+        off = 18
+    if ethertype != _ETH_IPV4 or len(frame) < off + 20:
+        return None
+    ihl = (frame[off] & 0x0F) * 4
+    if frame[off] >> 4 != 4 or ihl < 20 or len(frame) < off + ihl + 4:
+        return None
+    if frame[off + 9] != _PROTO_TCP:
+        return None
+    # any fragment (MF set or non-zero offset) is out: non-first fragments
+    # carry payload where the TCP header would be, and a fragmented flow
+    # can't be consistently redirected anyway
+    frag = ((frame[off + 6] << 8) | frame[off + 7]) & 0x3FFF
+    if frag != 0:
+        return None
+    sip, dip = struct.unpack_from(">II", frame, off + 12)
+    sport, dport = struct.unpack_from(">HH", frame, off + ihl)
+    return sip, sport, dip, dport
+
+
+class _RemoteStage:
+    """Staging queue for released cross-node frames: native SPSC FrameRing
+    when available (bounded, overflow-counted), deque fallback. Packed
+    entry: u16 addr_len | addr | u32 peer_intf_id | frame bytes."""
+
+    def __init__(self, capacity_bytes: int = 4 << 20) -> None:
+        self._ring: native.FrameRing | None = None
+        try:
+            self._ring = native.FrameRing(capacity_bytes)
+        except native.NativeUnavailable:
+            self._dq: deque[bytes] = deque()
+
+    def push(self, addr: str, intf_id: int, frame: bytes) -> bool:
+        a = addr.encode()
+        blob = struct.pack(">H", len(a)) + a + struct.pack(">I", intf_id) \
+            + frame
+        if self._ring is not None:
+            return bool(self._ring.push(blob))
+        self._dq.append(blob)
+        return True
+
+    def pop(self) -> tuple[str, int, bytes] | None:
+        if self._ring is not None:
+            blob = self._ring.pop()
+            if blob is None:
+                return None
+        else:
+            if not self._dq:
+                return None
+            blob = self._dq.popleft()
+        alen = struct.unpack_from(">H", blob)[0]
+        addr = blob[2:2 + alen].decode()
+        intf = struct.unpack_from(">I", blob, 2 + alen)[0]
+        return addr, intf, blob[6 + alen:]
+
+    @property
+    def dropped(self) -> int:
+        return self._ring.dropped if self._ring is not None else 0
 
 
 class WireDataPlane:
@@ -47,6 +160,9 @@ class WireDataPlane:
         self._key = jax.random.key(seed)
         self._heap: list = []          # (release_s, seq, pod_key, uid, frame)
         self._seq = 0
+        # one tick at a time; the ENGINE lock is held only for snapshot
+        # and write-back, never across device dispatch
+        self._tick_lock = threading.Lock()
         # wheel time is µs since the first tick's clock (which may be the
         # wall clock or a synthetic test clock); token → payload map held
         # Python-side, the wheel orders and releases
@@ -57,6 +173,13 @@ class WireDataPlane:
                 tick_us=1000)
         except native.NativeUnavailable:
             self._wheel = None
+        # TCP/IP bypass flow table (eBPF sockops/redir equivalent)
+        try:
+            self._flowtable: native.FlowTable | None = (
+                native.FlowTable() if native.have_native() else None)
+        except native.NativeUnavailable:
+            self._flowtable = None
+        self._remote = _RemoteStage()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.counters: EdgeCounters = init_counters(
@@ -64,12 +187,70 @@ class WireDataPlane:
         self.ticks = 0
         self.shaped = 0
         self.dropped = 0
+        self.bypassed = 0      # frames that skipped shaping entirely
+
+    # -- bypass --------------------------------------------------------
+
+    def _try_bypass(self, row: int, frame: bytes,
+                    target: tuple[str, int] | None,
+                    shaped_rows: set[int]) -> bool:
+        """eBPF-bypass semantics per frame. Returns True when the frame
+        short-circuited shaping and was delivered."""
+        ft = self._flowtable
+        if ft is None or target is None:
+            return False
+        # sockops redirection is strictly SAME-NODE (socket-to-socket,
+        # redir.c:24-42): the peer end must be a local wire with no
+        # daemon hop — a cross-node bypass would also re-introduce a
+        # blocking per-frame unary send inside the tick
+        peer_wire = self.daemon.wires.get_by_key(*target)
+        if peer_wire is None or peer_wire.peer_ip:
+            return False
+        tup = parse_tcp_flow(frame)
+        if tup is None:
+            return False  # sockops only ever accelerates TCP
+        sip, sport, dip, dport = tup
+        if ft.flag(sip, sport, dip, dport) is None:
+            # first sight of the flow: both endpoints are local wires, so
+            # both sockops hooks fire here (active then passive establish).
+            # In the reference this happens at connection setup, BEFORE any
+            # frame crosses a device — so it precedes any disable below.
+            ft.active_established(sip, sport, dip, dport)
+            ft.passive_established(dip, dport, sip, sport)
+        if row in shaped_rows:
+            # traffic crossing a shaped device disables the flow FOREVER,
+            # even if the device is later unshaped (redir_disable.c:44-48)
+            ft.shaped_egress(sip, sport, dip, dport)
+            return False
+        if ft.msg_redirect(sip, sport, dip, dport):
+            self.bypassed += 1
+            self.daemon.deliver_egress(*target, frame)  # latency ≈ 0
+            return True
+        return False
+
+    @property
+    def ring_dropped(self) -> int:
+        """Frames lost to remote-stage ring overflow (bounded-memory
+        backpressure, like pcap buffer drops in the reference)."""
+        return self._remote.dropped
+
+    @property
+    def flow_stats(self) -> dict:
+        ft = self._flowtable
+        if ft is None:
+            return {"available": False}
+        return {"available": True, "flows": len(ft),
+                "bypassed": ft.bypassed, "passed": ft.passed}
 
     # -- one step ------------------------------------------------------
 
     def tick(self, now_s: float | None = None) -> int:
         """Drain ingress, shape, schedule releases; release due frames.
         Returns the number of frames shaped this tick."""
+        with self._tick_lock:
+            return self._tick_inner(now_s)
+
+    def _tick_inner(self, now_s: float | None) -> int:
         if now_s is None:
             now_s = time.monotonic()
         if self._origin_s is None:
@@ -78,39 +259,90 @@ class WireDataPlane:
         shaped = 0
         if batches:
             engine = self.engine
+            # -- snapshot under the engine lock (no device work) --------
             with engine._lock:
-                E = engine.state.capacity
+                state = engine.state  # flushes pending control-plane ops
+                E = state.capacity
                 if self.counters.tx_packets.shape[0] != E:
                     self.counters = init_counters(E)  # engine grew
-                k = max(len(b[1]) for b in batches)
+                # frames entering a directed edge exit at the PEER pod's
+                # wire (the reference writes into the peer's pod-side
+                # veth, grpcwire.go:256-271); _row_owner is maintained
+                # incrementally, so this is O(batch), not O(rows)
+                rowinfo: dict[int, tuple[str, int] | None] = {}
+                for row, _lens, _fr in batches:
+                    key = engine._row_owner.get(row)
+                    rowinfo[row] = (engine._peer.get(key, key)
+                                    if key is not None else None)
+                shaped_rows = set(engine._shaped_rows)
+                # rows the control plane touches from here on keep their
+                # own dynamic state at write-back
+                engine._rows_touched.clear()
+
+            # -- bypass split + shaping OUTSIDE the engine lock ---------
+            kept: list[tuple[int, list[int], list[bytes]]] = []
+            for row, lens, frames_list in batches:
+                target = rowinfo.get(row)
+                k_lens: list[int] = []
+                k_frames: list[bytes] = []
+                for ln, f in zip(lens, frames_list):
+                    if self._try_bypass(row, f, target, shaped_rows):
+                        continue
+                    k_lens.append(ln)
+                    k_frames.append(f)
+                if k_frames:
+                    kept.append((row, k_lens, k_frames))
+
+            if kept:
+                k = max(len(b[1]) for b in kept)
                 sizes = np.zeros((E, k), np.float32)
                 valid = np.zeros((E, k), bool)
                 frames: dict[tuple[int, int], bytes] = {}
-                # frames entering a directed edge exit at the PEER pod's
-                # wire (the reference writes into the peer's pod-side veth,
-                # grpcwire.go:256-271)
-                inv = {r: key for key, r in engine._rows.items()}
-                rowinfo: dict[int, tuple[str, int] | None] = {}
-                for row, lens, fr in batches:
+                for row, lens, fr in kept:
                     for j, (ln, f) in enumerate(zip(lens, fr)):
                         sizes[row, j] = float(ln)
                         valid[row, j] = True
                         frames[(row, j)] = f
-                    key = inv.get(row)
-                    rowinfo[row] = (engine._peer.get(key, key)
-                                    if key is not None else None)
 
                 self._key, sub = jax.random.split(self._key)
-                state = engine.state
                 res_cols = []
                 for j in range(k):
-                    state, res = netem.shape_step_auto(
+                    state, res = _shape_step_nodonate(
                         state, jnp.asarray(sizes[:, j]),
                         jnp.asarray(valid[:, j]),
                         jnp.zeros((E,), jnp.float32),
                         jax.random.fold_in(sub, j))
                     res_cols.append(jax.tree.map(np.asarray, res))
-                engine.state = state
+
+                # -- write back dynamic columns under the lock ----------
+                with engine._lock:
+                    cur = engine._state
+                    if cur.capacity == state.capacity:
+                        touched = engine._rows_touched
+                        if touched:
+                            # rows applied/updated/deleted mid-shaping:
+                            # their flushed initialization (token fill,
+                            # cleared backlog) must win over our stale
+                            # pre-snapshot dynamics
+                            idx = jnp.asarray(sorted(touched), jnp.int32)
+
+                            def merge(new, old):
+                                return new.at[idx].set(old[idx])
+                        else:
+                            def merge(new, old):  # noqa: ARG001
+                                return new
+                        engine._state = dataclasses.replace(
+                            cur,
+                            tokens=merge(state.tokens, cur.tokens),
+                            t_last=merge(state.t_last, cur.t_last),
+                            backlog_until=merge(state.backlog_until,
+                                                cur.backlog_until),
+                            corr=merge(state.corr, cur.corr),
+                            pkt_count=merge(state.pkt_count,
+                                            cur.pkt_count))
+                    # else: engine grew mid-shaping — drop this tick's
+                    # dynamic-state advance rather than corrupt shapes;
+                    # results below still schedule deliveries
 
                 for (row, j), frame in frames.items():
                     res = res_cols[j]
@@ -160,15 +392,62 @@ class WireDataPlane:
             reordered=c.reordered,
         )
 
+    # -- release + cross-node streaming egress -------------------------
+
     def _release(self, now_s: float) -> None:
+        due: list[tuple[str, int, bytes]] = []
         if self._wheel is not None:
             for token in self._wheel.advance((now_s - self._origin_s) * 1e6):
-                pod_key, uid, frame = self._pending.pop(token)
-                self.daemon.deliver_egress(pod_key, uid, frame)
-            return
-        while self._heap and self._heap[0][0] <= now_s:
-            _, _, pod_key, uid, frame = heapq.heappop(self._heap)
-            self.daemon.deliver_egress(pod_key, uid, frame)
+                due.append(self._pending.pop(token))
+        else:
+            while self._heap and self._heap[0][0] <= now_s:
+                _, _, pod_key, uid, frame = heapq.heappop(self._heap)
+                due.append((pod_key, uid, frame))
+        staged = False
+        for pod_key, uid, frame in due:
+            wire = self.daemon.wires.get_by_key(pod_key, uid)
+            if wire is None:
+                continue
+            if wire.peer_ip:
+                # stage for the per-peer stream batch below
+                if self._remote.push(wire.peer_ip, wire.peer_intf_id, frame):
+                    staged = True
+                else:
+                    # overflow: charge the drop to this frame's edge so it
+                    # shows up in the interface metrics (tx_dropped)
+                    row = self.engine._rows.get((pod_key, uid))
+                    if row is not None and row < \
+                            self.counters.dropped_ring.shape[0]:
+                        dr = np.asarray(self.counters.dropped_ring).copy()
+                        dr[row] += 1.0
+                        self.counters = dataclasses.replace(
+                            self.counters, dropped_ring=dr)
+            else:
+                wire.egress.append(frame)
+        if staged:
+            self._flush_remote()
+
+    def _flush_remote(self) -> None:
+        """Ship all staged cross-node frames: ONE SendToStream per peer
+        daemon per tick (vs the reference's unary-per-frame hot loop,
+        grpcwire.go:452-459). Per-peer deadline bounds a blackholed peer
+        to one timeout per tick, and errors are counted, not fatal."""
+        from kubedtn_tpu.wire import proto as pb
+
+        by_peer: dict[str, list] = {}
+        while True:
+            item = self._remote.pop()
+            if item is None:
+                break
+            addr, intf, frame = item
+            by_peer.setdefault(addr, []).append(
+                pb.Packet(remot_intf_id=intf, frame=frame))
+        for addr, packets in by_peer.items():
+            try:
+                self.daemon._peer_wire_client(addr).SendToStream(
+                    iter(packets), timeout=self.daemon.forward_timeout_s)
+            except Exception:
+                self.daemon.forward_errors += len(packets)
 
     # -- metrics feed --------------------------------------------------
 

@@ -185,10 +185,135 @@ def clos_100k(steps: int = 50, dt_us: float = 1000.0):
     }
 
 
+def reconcile_100k(n_spine: int = 100, n_leaf: int = 500,
+                   links_per_pair: int = 2, workers: int = 1,
+                   grpc_batch: int = 1000):
+    """Rung 6: reconcile-to-steady at 100k links through the REAL control
+    path — store → reconciler → engine (BASELINE "reconcile-to-steady <5s
+    @100k links"; reference contract controllers/topology_controller.go:
+    61-156). Unlike bench.py's device-side headline (which times the
+    batched scatter alone), every link here enters as a Link in a Topology
+    CR, is diffed by the reconciler, allocated a row by the engine, and
+    lands on device via the engine's coalesced flush.
+
+    Three measured phases:
+    - realize_s: 600 CRs / 100k links / 200k directed rows from empty
+      status to fully realized + status copied back;
+    - churn_s:   every link's properties replaced through spec updates,
+      re-reconciled (the UpdateLinks path end to end);
+    - grpc_update_s: one live-daemon Local.UpdateLinks round trip for a
+      `grpc_batch`-link batch over real gRPC (wire-serialization cost).
+    """
+    from dataclasses import replace
+
+    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+
+    t0 = time.perf_counter()
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=1 << 18, node_ip="10.0.0.1")
+    props = LinkProperties(latency="10ms", rate="10Gbit")
+    spines = [[] for _ in range(n_spine)]
+    leaves = [[] for _ in range(n_leaf)]
+    uid = 0
+    for s in range(n_spine):
+        for l in range(n_leaf):
+            for k in range(links_per_pair):
+                uid += 1
+                spines[s].append(Link(
+                    local_intf=f"e{l}-{k}", peer_intf=f"e{s}-{k}",
+                    peer_pod=f"leaf{l}", uid=uid, properties=props))
+                leaves[l].append(Link(
+                    local_intf=f"e{s}-{k}", peer_intf=f"e{l}-{k}",
+                    peer_pod=f"spine{s}", uid=uid, properties=props))
+    n_links = uid
+
+    def mk(name, links):
+        t = Topology(name=name, spec=TopologySpec(links=links))
+        # placement known (CNI ran), links not yet realized
+        t.status.src_ip, t.status.net_ns = "10.0.0.1", f"/run/netns/{name}"
+        t.status.links = []
+        store.create(t)
+
+    for s in range(n_spine):
+        mk(f"spine{s}", spines[s])
+    for l in range(n_leaf):
+        mk(f"leaf{l}", leaves[l])
+    setup_s = time.perf_counter() - t0
+
+    # pre-compile the batched kernels at full width — a steady-state
+    # controller reconciles with warm kernels; the one-time XLA compile is
+    # not what the <5s reconcile target measures
+    engine.warm_kernels()
+
+    rec = Reconciler(store, engine)
+    t0 = time.perf_counter()
+    rec.drain(workers=workers)
+    jax.block_until_ready(engine.state.props)  # includes the device flush
+    realize_s = time.perf_counter() - t0
+    assert engine.num_active == 2 * n_links, engine.num_active
+
+    # churn: replace every link's properties through the spec
+    new_props = LinkProperties(latency="20ms", jitter="1ms", rate="1Gbit")
+    t0 = time.perf_counter()
+    for t in store.list():
+        t.spec.links = [replace(l, properties=new_props) for l in t.spec.links]
+        store.update(t)
+    rec.drain(workers=workers)
+    jax.block_until_ready(engine.state.props)
+    churn_s = time.perf_counter() - t0
+
+    # spot-check BEFORE the gRPC phase re-applies old props to spine0
+    lat_col = es.PROP_NAMES.index("latency_us")
+    churned = float(np.asarray(engine.state.props[0, lat_col]))
+    assert churned == 20_000.0, churned
+
+    # gRPC surface: one live UpdateLinks round trip for a big batch
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+    from kubedtn_tpu.wire.server import Daemon, make_server
+
+    daemon = Daemon(engine)
+    server, port = make_server(daemon, port=0, host="127.0.0.1")
+    server.start()
+    client = DaemonClient(f"127.0.0.1:{port}")
+    batch = [pb.link_to_proto(l) for l in spines[0][:grpc_batch]]
+    q = pb.LinksBatchQuery(
+        local_pod=pb.Pod(name="spine0", kube_ns="default"),
+        links=batch)
+    client.UpdateLinks(q)  # warm the path once...
+    engine.flush()         # ...including the small-bucket kernel compile
+    jax.block_until_ready(engine.state.props)
+    t0 = time.perf_counter()
+    resp = client.UpdateLinks(q)
+    engine.flush()
+    jax.block_until_ready(engine.state.props)
+    grpc_update_s = time.perf_counter() - t0
+    client.close()
+    server.stop(0)
+
+    return {
+        "scenario": "reconcile_100k",
+        "topologies": n_spine + n_leaf,
+        "links": n_links,
+        "directed_rows": 2 * n_links,
+        "setup_s": round(setup_s, 3),
+        "reconcile_s": round(realize_s, 3),
+        "churn_s": round(churn_s, 3),
+        "grpc_update_s": round(grpc_update_s, 4),
+        "grpc_update_links": len(batch),
+        "grpc_ok": bool(resp.response),
+        "device_calls": engine.stats.device_calls,
+        "spot_check_latency_us": churned,
+        "target_s": 5.0,
+        "meets_target": realize_s < 5.0,
+    }
+
+
 LADDER = {
     "3node": three_node,
     "fat_tree_64": fat_tree_64,
     "churn_1k": churn_1k,
     "routes_10k": routes_10k,
     "clos_100k": clos_100k,
+    "reconcile_100k": reconcile_100k,
 }

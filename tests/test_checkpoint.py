@@ -147,3 +147,33 @@ def test_checkpoint_with_sim_state(tmp_path):
     sim3, _ = S.sim_step(sim2, spec, jax.random.key(1), 2,
                          jnp.float32(1000.0))
     assert float(sim3.clock_us) > clock2
+
+
+def test_restored_engine_rebuilds_shaped_rows(tmp_path):
+    """Regression: a restored shaped link must still read as shaped to the
+    TCP-bypass guard — otherwise same-node TCP flows would skip its
+    netem/TBF chain entirely after a daemon restart."""
+    from kubedtn_tpu import checkpoint as cp
+    from kubedtn_tpu.api.types import (Link, LinkProperties, Topology,
+                                       TopologySpec)
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    t = Topology(name="s", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth9",
+             peer_pod="physical/10.0.0.9", uid=1,
+             properties=LinkProperties(latency="10ms")),
+        Link(local_intf="eth2", peer_intf="eth8",
+             peer_pod="physical/10.0.0.8", uid=2),  # unshaped
+    ]))
+    store.create(t)
+    engine.setup_pod("s")
+    shaped_row = engine.row_of("default/s", 1)
+    plain_row = engine.row_of("default/s", 2)
+    assert engine.is_shaped(shaped_row) and not engine.is_shaped(plain_row)
+
+    path = str(tmp_path / "ckpt")
+    cp.save(path, store, engine)
+    store2, engine2 = cp.load(path)
+    assert engine2.is_shaped(engine2.row_of("default/s", 1))
+    assert not engine2.is_shaped(engine2.row_of("default/s", 2))

@@ -345,3 +345,54 @@ def test_health_watch_parking_capped(two_nodes):
     for call in calls:
         call.cancel()
     channel.close()
+
+
+def test_cross_node_egress_batches_over_sendtostream():
+    """Released cross-node frames cross as ONE SendToStream batch per peer
+    per tick, not one unary RPC per frame (the reference's per-packet hot
+    loop, grpcwire.go:452)."""
+    from kubedtn_tpu.runtime import WireDataPlane
+
+    class CountingDaemon(Daemon):
+        stream_calls = 0
+
+        def SendToStream(self, request_iterator, context):
+            resp = super().SendToStream(request_iterator, context)
+            type(self).stream_calls += 1
+            return resp
+
+    CountingDaemon.stream_calls = 0
+    store_b = TopologyStore()
+    engine_b = SimEngine(store_b, capacity=64)
+    daemon_b = CountingDaemon(engine_b)
+    server_b, port_b = make_server(daemon_b, port=0, host="127.0.0.1")
+    server_b.start()
+    addr_b = f"127.0.0.1:{port_b}"
+
+    store_a = TopologyStore()
+    engine_a = SimEngine(store_a, capacity=64)
+    engine_a.node_ip = "127.0.0.1:1"
+    daemon_a = Daemon(engine_a)
+    t1, _ = seed(store_a, engine_a.node_ip, addr_b, latency="")
+    engine_a.add_links(t1, t1.spec.links)  # A's local row, unshaped
+
+    wire_b = daemon_b._add_wire(pb.WireDef(
+        local_pod_name="r2", kube_ns="default", link_uid=7,
+        intf_name_in_pod="eth1", peer_ip="127.0.0.1:1", peer_intf_id=1))
+    wire_a = daemon_a._add_wire(pb.WireDef(
+        local_pod_name="r1", kube_ns="default", link_uid=7,
+        intf_name_in_pod="eth1", peer_ip=addr_b,
+        peer_intf_id=wire_b.wire_id))
+
+    dp_a = WireDataPlane(daemon_a, max_slots=16)
+    n = 6
+    for i in range(n):
+        wire_a.ingress.append(bytes([i]) * 60)
+    dp_a.tick(now_s=5.0)
+    dp_a.tick(now_s=5.001)  # unshaped: released immediately
+    got = list(wire_b.egress)
+    assert len(got) == n, f"only {len(got)}/{n} frames crossed"
+    assert CountingDaemon.stream_calls == 1, \
+        f"{CountingDaemon.stream_calls} stream calls for one tick's batch"
+    assert daemon_a.forward_errors == 0
+    server_b.stop(0)

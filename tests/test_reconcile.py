@@ -419,12 +419,18 @@ class TestConcurrentReconcile:
         writers_done = th.Event()
 
         def writer(seed):
+            # Link/LinkProperties are frozen: spec changes REPLACE the Link
+            # (mutating in place would raise FrozenInstanceError)
+            from dataclasses import replace
+
             rng = random.Random(seed)
             for v in range(2, 12):
                 for i in rng.sample(range(self.N), self.N // 2):
                     def txn():
                         t = store.get("default", f"p{i}")
-                        t.spec.links[0].properties.latency = f"{v}ms"
+                        t.spec.links = [replace(
+                            t.spec.links[0],
+                            properties=LinkProperties(latency=f"{v}ms"))]
                         store.update(t)
                     retry_on_conflict(txn, retries=50)
                     time.sleep(0.0005)
@@ -460,3 +466,40 @@ class TestConcurrentReconcile:
             t = store.get("default", f"p{i}")
             assert t.status.links == t.spec.links
             assert engine.link_row(f"default/p{i}", i) is not None
+
+
+def test_concurrent_drain_surfaces_worker_exception():
+    """Regression: an exception inside a reconcile worker must raise out
+    of drain(workers>1) — not strand the key in the workqueue's
+    processing set and hang the drain forever."""
+
+    class ExplodingEngine(SimEngine):
+        def add_links(self, topo, links):
+            raise RuntimeError("boom")
+
+    store = TopologyStore()
+    engine = ExplodingEngine(store, capacity=16)
+    link = Link(local_intf="eth1", peer_intf="eth0",
+                peer_pod="physical/10.9.9.9", uid=1)
+    t = Topology(name="p0", spec=TopologySpec(links=[link]))
+    t.status.links = []
+    store.create(t)
+    rec = Reconciler(store, engine)
+
+    done = {}
+
+    def run():
+        try:
+            rec.drain(workers=4)
+            done["outcome"] = "returned"
+        except RuntimeError as e:
+            done["outcome"] = f"raised:{e}"
+
+    import threading as th
+    worker = th.Thread(target=run, daemon=True)
+    worker.start()
+    worker.join(timeout=20)
+    assert not worker.is_alive(), "drain hung on worker exception"
+    assert done["outcome"] == "raised:boom"
+    # the key requeues so a later (healthy) drain can converge
+    assert ("default", "p0") in rec._requeue

@@ -182,3 +182,140 @@ def test_dataplane_uses_native_wheel_when_available():
     dp.tick(now_s=10.5)  # all 10ms deadlines long past
     assert len(w2.egress) == 5
     assert len(dp._wheel) == 0 and not dp._pending
+
+
+# ---- TCP/IP bypass fast path (eBPF sockops/redir equivalent) ---------
+
+def tcp_frame(sip="10.0.0.1", sport=4321, dip="10.0.0.2", dport=80,
+              payload=b"x" * 32):
+    """Minimal ethernet/IPv4/TCP frame for the bypass flow table."""
+    import struct as st
+
+    def ip(s):
+        a = [int(x) for x in s.split(".")]
+        return (a[0] << 24) | (a[1] << 16) | (a[2] << 8) | a[3]
+
+    eth = b"\x02" * 6 + b"\x04" * 6 + b"\x08\x00"
+    tcp = st.pack(">HHIIBBHHH", sport, dport, 1, 0, 0x50, 0x18, 8192, 0, 0)
+    total = 20 + len(tcp) + len(payload)
+    ipv4 = st.pack(">BBHHHBBHII", 0x45, 0, total, 7, 0, 64, 6, 0,
+                   ip(sip), ip(dip))
+    return eth + ipv4 + tcp + payload
+
+
+native_only = pytest.mark.skipif(
+    not __import__("kubedtn_tpu.native", fromlist=["have_native"])
+    .have_native(), reason="native library unavailable")
+
+
+@native_only
+def test_bypass_unshaped_tcp_flow_skips_shaping():
+    """Same-node TCP flow over an UNSHAPED link: after the first message
+    (which falls through, eBPF parity), frames skip the shaping kernels
+    entirely and cross in the same tick."""
+    daemon, engine = make_daemon(THREE_NODE)  # no shaping props
+    w1 = add_wire(daemon, "r1", 1)
+    w2 = add_wire(daemon, "r2", 1)
+    dp = WireDataPlane(daemon)
+    assert not engine.is_shaped(engine.row_of("default/r1", 1))
+
+    f = tcp_frame()
+    w1.ingress.append(f)
+    assert dp.tick(now_s=10.0) == 1          # first message: shaped path
+    assert dp.bypassed == 0
+    w1.ingress.append(f)
+    shaped = dp.tick(now_s=10.001)
+    assert shaped == 0                        # second message: bypassed
+    assert dp.bypassed == 1
+    assert f in w2.egress                     # delivered in the SAME tick
+    assert dp.flow_stats["bypassed"] >= 1
+
+
+@native_only
+def test_bypass_disabled_forever_on_shaped_link():
+    """A flow crossing a shaped row is DISABLED permanently — even after
+    the link's shaping is later removed (redir_disable.c:44-48)."""
+    daemon, engine = make_daemon(LATENCY)  # uid 1 shaped (10ms)
+    w1 = add_wire(daemon, "r1", 1)
+    add_wire(daemon, "r2", 1)
+    dp = WireDataPlane(daemon)
+    row = engine.row_of("default/r1", 1)
+    assert engine.is_shaped(row)
+
+    f = tcp_frame(dport=443)
+    for i in range(3):
+        w1.ingress.append(f)
+        dp.tick(now_s=20.0 + i * 0.001)
+    assert dp.bypassed == 0                   # never bypassed while shaped
+
+    # strip the shaping: row no longer shaped, but the flow stays disabled
+    topo = engine.get_pod("r1")
+    from kubedtn_tpu.api.types import LinkProperties
+    from dataclasses import replace as _replace
+    topo.spec.links = [_replace(l, properties=LinkProperties())
+                       for l in topo.spec.links]
+    engine.update_links(topo, topo.spec.links)
+    assert not engine.is_shaped(row)
+    w1.ingress.append(f)
+    dp.tick(now_s=21.0)
+    assert dp.bypassed == 0                   # DISABLED is forever
+    from kubedtn_tpu import native as _n
+    sip, sport, dip, dport = 0x0A000001, 4321, 0x0A000002, 443
+    assert dp._flowtable.flag(sip, sport, dip, dport) == _n.PROXY_DISABLED
+
+
+def test_addlinks_not_blocked_by_busy_dataplane():
+    """Control-plane ops must not wait for a data-plane device dispatch:
+    the tick holds the engine lock only for snapshot and write-back."""
+    daemon, engine = make_daemon(THREE_NODE)
+    w1 = add_wire(daemon, "r1", 1)
+    add_wire(daemon, "r2", 1)
+    dp = WireDataPlane(daemon, dt_us=500.0, max_slots=64)
+    dp.start()
+    try:
+        stop = threading.Event()
+
+        def feeder():
+            while not stop.is_set():
+                if len(w1.ingress) < 256:
+                    for _ in range(64):
+                        w1.ingress.append(b"q" * 200)
+                stop.wait(0.001)
+
+        t = threading.Thread(target=feeder, daemon=True)
+        t.start()
+        deadline = threading.Event()
+        deadline.wait(0.2)  # let the plane get busy
+        import time as _t
+
+        topo = engine.get_pod("r3")
+        worst = 0.0
+        for _ in range(5):
+            t0 = _t.perf_counter()
+            engine.update_links(topo, topo.spec.links)
+            worst = max(worst, _t.perf_counter() - t0)
+        stop.set()
+        assert worst < 2.0, f"control op blocked {worst:.2f}s by data plane"
+        assert dp.shaped > 0
+    finally:
+        dp.stop()
+
+
+@native_only
+def test_bypass_never_for_cross_node_wires():
+    """sockops redirection is socket-to-socket on ONE node: a flow whose
+    peer wire crosses to another daemon must take the shaped+streamed
+    path, never the in-tick bypass."""
+    daemon, engine = make_daemon(THREE_NODE)  # unshaped links
+    w1 = add_wire(daemon, "r1", 1)
+    # peer end is a cross-daemon wire (peer_ip set)
+    daemon._add_wire(pb.WireDef(
+        local_pod_name="r2", kube_ns="default", link_uid=1,
+        intf_name_in_pod="eth1", peer_ip="127.0.0.1:1", peer_intf_id=3))
+    dp = WireDataPlane(daemon)
+    f = tcp_frame(dport=7777)
+    for i in range(3):
+        w1.ingress.append(f)
+        dp.tick(now_s=30.0 + i * 0.001)
+    assert dp.bypassed == 0
+    assert dp.shaped == 3
