@@ -37,3 +37,19 @@ docker:                    ## container image for the daemon DaemonSet
 clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
+
+manager:                   ## run the controller manager (probes + leader election)
+	$(PY) -m kubedtn_tpu.cli manager
+
+loc:                       ## reproducible LoC diagnostic (exact commands recorded here)
+	@echo "repo (non-test Python + C++):"
+	@find kubedtn_tpu native \( -name '*.py' -o -name '*.cc' -o -name '*.h' \) \
+		-print0 | xargs -0 cat | wc -l
+	@echo "tests:"
+	@find tests -name '*.py' -print0 | xargs -0 cat | wc -l
+	@echo "reference core (hand-written Go + eBPF C, excluding generated+tests):"
+	@find /root/reference \
+		\( \( -name '*.go' ! -name '*.pb.go' ! -name 'zz_generated*' \
+		      ! -name '*_bpfe[lb].go' ! -name '*_test.go' \) \
+		   -o -name '*.c' -o -name '*.h' \) \
+		! -path '*/test/*' -print0 2>/dev/null | xargs -0 cat | wc -l

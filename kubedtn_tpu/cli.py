@@ -21,6 +21,7 @@ import json
 import math
 import os
 import sys
+import time
 
 
 def _json_safe(obj):
@@ -114,9 +115,15 @@ def _env_port(var: str, default: int) -> int:
 def cmd_daemon(args) -> int:
     from kubedtn_tpu.metrics.metrics import MetricsServer, make_registry
     from kubedtn_tpu.topology import SimEngine, TopologyStore
+    from kubedtn_tpu.utils.logging import fields, get_logger, setup
     from kubedtn_tpu.wire.server import Daemon, make_server
 
     from kubedtn_tpu.runtime import WireDataPlane
+
+    # structured logs for the whole daemon (level: KUBEDTN_LOG_LEVEL),
+    # the zap/logrus setup of the reference (main.go:61-78)
+    setup()
+    log = get_logger("daemon")
 
     if args.port is None:
         args.port = _env_port("GRPC_PORT", 51111)
@@ -136,6 +143,9 @@ def cmd_daemon(args) -> int:
     metrics.start()
     server.start()
     dataplane.start()
+    log.info("daemon up %s", fields(grpc_port=port,
+                                    metrics_port=metrics.port,
+                                    node_ip=args.node_ip))
     print(f"kubedtn-tpu daemon: gRPC on :{port}, "
           f"metrics on :{metrics.port}/metrics", flush=True)
     try:
@@ -144,6 +154,37 @@ def cmd_daemon(args) -> int:
         server.stop(0)
         dataplane.stop()
         metrics.stop()
+    return 0
+
+
+def cmd_manager(args) -> int:
+    """Run the controller manager standalone — the reference's controller
+    binary (reference main.go:80-126): continuous reconcile with worker
+    pool, healthz/readyz probes, optional leader election."""
+    from kubedtn_tpu.topology import SimEngine, TopologyStore
+    from kubedtn_tpu.topology.manager import ControllerManager
+    from kubedtn_tpu.utils.logging import fields, get_logger, setup
+
+    setup()
+    log = get_logger("manager")
+    store = TopologyStore()
+    engine = SimEngine(store, node_ip=args.node_ip)
+    mgr = ControllerManager(store, engine, identity=args.identity,
+                            workers=args.workers,
+                            leader_election=args.leader_elect,
+                            probe_port=args.probe_port)
+    mgr.start()
+    log.info("manager up %s", fields(identity=args.identity,
+                                     workers=args.workers,
+                                     probe_port=mgr.probe_port,
+                                     leader_election=args.leader_elect))
+    print(f"kubedtn-tpu manager: probes on :{mgr.probe_port} "
+          f"(healthz/readyz)", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        mgr.stop()
     return 0
 
 
@@ -309,6 +350,21 @@ def main(argv=None) -> int:
     dp.add_argument("--node-ip",
                     default=os.environ.get("HOST_IP", "10.0.0.1"))
     dp.set_defaults(fn=cmd_daemon)
+
+    mp = sub.add_parser("manager",
+                        help="run the topology controller manager "
+                             "(reconcile loop + probes + leader election)")
+    mp.add_argument("--workers", type=int, default=32,
+                    help="concurrent reconcile workers (reference: 32)")
+    mp.add_argument("--probe-port", type=int, default=8081,
+                    help="healthz/readyz port (reference probe-addr :8081)")
+    mp.add_argument("--leader-elect", action="store_true",
+                    help="enable leader election (reference "
+                         "--leader-elect)")
+    mp.add_argument("--identity", default="manager-0")
+    mp.add_argument("--node-ip", default=os.environ.get("HOST_IP",
+                                                        "10.0.0.1"))
+    mp.set_defaults(fn=cmd_manager)
 
     cp = sub.add_parser("crd", help="render the Topology CRD manifest")
     cp.set_defaults(fn=cmd_crd)

@@ -324,15 +324,46 @@ def shape_step(state: EdgeState, sizes: jax.Array, have_pkt: jax.Array,
 def shape_step_auto(state: EdgeState, sizes: jax.Array, have_pkt: jax.Array,
                     t_arrival: jax.Array, key: jax.Array):
     """shape_step, dispatched to the fastest backend for this platform:
-    the fused Pallas kernel on TPU (measured ~12% over the XLA-fused
-    vmapped path at the 100k-link bench shape — 171 vs 153 M packets/s on
-    one v4 chip), the vmapped XLA path everywhere else. Bit-identical
-    results either way for the same key."""
+    the fused Pallas kernel on TPU (measured ~18% over the XLA-fused
+    vmapped path at the 100k-link bench shape — 250 vs 212 M packets/s
+    median-of-5 on one chip, bench.py extras), the vmapped XLA path
+    everywhere else. Bit-identical results either way for the same key.
+
+    DONATES `state` — callers must replace every reference to the input
+    afterwards. Concurrent holders of the same buffers (the data plane's
+    lock-free snapshot) must use shape_step_nodonate instead."""
     if jax.default_backend() == "tpu":
         from kubedtn_tpu.ops.pallas import shaping
 
         return shaping.shape_step(state, sizes, have_pkt, t_arrival, key)
     return shape_step(state, sizes, have_pkt, t_arrival, key)
+
+
+_shape_step_nd = None
+_pallas_step_nd = None
+
+
+def shape_step_nodonate(state: EdgeState, sizes: jax.Array,
+                        have_pkt: jax.Array, t_arrival: jax.Array,
+                        key: jax.Array):
+    """shape_step_auto without state donation: the input buffers stay
+    valid, at the cost of one fresh output allocation. The right variant
+    whenever another thread may still hold references to the same buffers
+    (e.g. the engine's `_state` while the data plane shapes a snapshot
+    outside the engine lock)."""
+    global _shape_step_nd, _pallas_step_nd
+    if jax.default_backend() == "tpu":
+        if _pallas_step_nd is None:
+            from kubedtn_tpu.ops.pallas import shaping
+
+            _pallas_step_nd = jax.jit(
+                shaping.shape_step.__wrapped__,
+                static_argnames=("interpret", "block_rows"))
+        return _pallas_step_nd(state, sizes, have_pkt, t_arrival, key,
+                               interpret=False)
+    if _shape_step_nd is None:
+        _shape_step_nd = jax.jit(shape_step.__wrapped__)
+    return _shape_step_nd(state, sizes, have_pkt, t_arrival, key)
 
 
 @partial(jax.jit, donate_argnums=0, static_argnums=2)

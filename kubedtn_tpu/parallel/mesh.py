@@ -54,8 +54,13 @@ def init_distributed(coordinator_address: str | None = None,
             num_processes=num_processes,
             process_id=process_id,
         )
-    except RuntimeError:
-        pass  # already initialized
+    except RuntimeError as e:
+        # tolerate ONLY re-initialization ("distributed.initialize should
+        # only be called once." in current jax); a connect/config failure
+        # must surface (swallowing it leaves a silent single-process run)
+        msg = str(e).lower()
+        if "already" not in msg and "only be called once" not in msg:
+            raise
 
 
 def make_multihost_mesh() -> Mesh:

@@ -53,27 +53,10 @@ from kubedtn_tpu import native
 from kubedtn_tpu.ops import netem
 from kubedtn_tpu.ops.queues import EdgeCounters, init_counters
 
-# Non-donating re-jits of the shaping kernels for the lock-free tick: the
-# stock kernels donate their EdgeState argument, which would invalidate
-# the very buffers engine._state still holds while shaping runs outside
-# the engine lock. Fresh-output versions cost one extra allocation per
-# tick and keep every concurrent reader safe.
-_VMAPPED_NODONATE = jax.jit(netem.shape_step.__wrapped__)
-_PALLAS_NODONATE = None
-
-
-def _shape_step_nodonate(state, sizes, have, t0s, key):
-    global _PALLAS_NODONATE
-    if jax.default_backend() == "tpu":
-        if _PALLAS_NODONATE is None:
-            from kubedtn_tpu.ops.pallas import shaping
-
-            _PALLAS_NODONATE = jax.jit(
-                shaping.shape_step.__wrapped__,
-                static_argnames=("interpret", "block_rows"))
-        return _PALLAS_NODONATE(state, sizes, have, t0s, key,
-                                interpret=False)
-    return _VMAPPED_NODONATE(state, sizes, have, t0s, key)
+# The tick shapes with netem.shape_step_nodonate: the stock kernels
+# donate their EdgeState argument, which would invalidate the very
+# buffers engine._state still holds while shaping runs outside the
+# engine lock.
 
 _ETH_IPV4 = 0x0800
 _PROTO_TCP = 6
@@ -188,6 +171,7 @@ class WireDataPlane:
         self.shaped = 0
         self.dropped = 0
         self.bypassed = 0      # frames that skipped shaping entirely
+        self.tick_errors = 0   # unexpected tick failures (thread survives)
 
     # -- bypass --------------------------------------------------------
 
@@ -307,7 +291,7 @@ class WireDataPlane:
                 self._key, sub = jax.random.split(self._key)
                 res_cols = []
                 for j in range(k):
-                    state, res = _shape_step_nodonate(
+                    state, res = netem.shape_step_nodonate(
                         state, jnp.asarray(sizes[:, j]),
                         jnp.asarray(valid[:, j]),
                         jnp.zeros((E,), jnp.float32),
@@ -404,6 +388,7 @@ class WireDataPlane:
                 _, _, pod_key, uid, frame = heapq.heappop(self._heap)
                 due.append((pod_key, uid, frame))
         staged = False
+        ring_drops: dict[int, int] = {}
         for pod_key, uid, frame in due:
             wire = self.daemon.wires.get_by_key(pod_key, uid)
             if wire is None:
@@ -416,14 +401,18 @@ class WireDataPlane:
                     # overflow: charge the drop to this frame's edge so it
                     # shows up in the interface metrics (tx_dropped)
                     row = self.engine._rows.get((pod_key, uid))
-                    if row is not None and row < \
-                            self.counters.dropped_ring.shape[0]:
-                        dr = np.asarray(self.counters.dropped_ring).copy()
-                        dr[row] += 1.0
-                        self.counters = dataclasses.replace(
-                            self.counters, dropped_ring=dr)
+                    if row is not None:
+                        ring_drops[row] = ring_drops.get(row, 0) + 1
             else:
                 wire.egress.append(frame)
+        if ring_drops:
+            # one counter-array copy per release, however many frames fell
+            dr = np.asarray(self.counters.dropped_ring).copy()
+            for row, n in ring_drops.items():
+                if row < dr.shape[0]:
+                    dr[row] += float(n)
+            self.counters = dataclasses.replace(self.counters,
+                                                dropped_ring=dr)
         if staged:
             self._flush_remote()
 
@@ -463,10 +452,30 @@ class WireDataPlane:
         self._stop.clear()
 
         def loop():
+            from kubedtn_tpu.utils.logging import fields, get_logger
+
+            log = get_logger("dataplane")
             period = self.dt_us / 1e6
+            last_error: str | None = None
             while not self._stop.is_set():
                 t0 = time.monotonic()
-                self.tick(t0)
+                try:
+                    self.tick(t0)
+                    last_error = None
+                except Exception as e:
+                    # a tick must never kill the data plane — but a
+                    # persistent failure at dt_us cadence must not emit
+                    # ~100 tracebacks/s either: full traceback only when
+                    # the error CHANGES, a counter carries the rest
+                    self.tick_errors += 1
+                    sig = f"{type(e).__name__}: {e}"
+                    if sig != last_error:
+                        last_error = sig
+                        log.exception("tick failed (continuing) %s",
+                                      fields(tick_errors=self.tick_errors))
+                    elif log.isEnabledFor(10):  # DEBUG
+                        log.debug("tick failed again %s", fields(
+                            error=sig, tick_errors=self.tick_errors))
                 budget = period - (time.monotonic() - t0)
                 if budget > 0:
                     self._stop.wait(budget)

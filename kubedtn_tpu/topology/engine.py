@@ -40,6 +40,7 @@ import numpy as np
 
 from kubedtn_tpu.api.types import LOCALHOST, Link, Topology
 from kubedtn_tpu.ops import edge_state as es
+from kubedtn_tpu.utils.logging import fields as _fields
 from kubedtn_tpu.topology.store import (
     NotFoundError,
     TopologyStore,
@@ -49,6 +50,16 @@ from kubedtn_tpu.topology.store import (
 # VXLAN VNI base kept for wire-level parity (reference common/constants.go:8,
 # common/utils.go:29-36: vni = 5000 + uid).
 VXLAN_BASE = 5000
+
+# Non-donating re-jits of the batched link kernels for the engine's flush.
+# The stock kernels donate their state argument; donation here would
+# invalidate buffers a concurrent data-plane tick still references in its
+# lock-free snapshot (runtime.py shapes OUTSIDE the engine lock) — the
+# donated-buffer crash would kill the dataplane thread. One extra output
+# allocation per flush is the price of that safety.
+_apply_links_nd = jax.jit(es.apply_links.__wrapped__)
+_delete_links_nd = jax.jit(es.delete_links.__wrapped__)
+_update_links_nd = jax.jit(es.update_links.__wrapped__)
 
 
 def vni_from_uid(uid: int) -> int:
@@ -131,6 +142,12 @@ class SimEngine:
         # state instead of its pre-snapshot copy (see runtime.py)
         self._rows_touched: set[int] = set()
         self.stats = EngineStats()
+        # per-action structured logs, the role of the reference's
+        # WithField("daemon"/"action") context loggers
+        # (reference common/context.go:11-29)
+        from kubedtn_tpu.utils.logging import get_logger
+
+        self.log = get_logger("engine")
         # host-side registries (the daemon's managers):
         self._pod_ids: dict[str, int] = {}   # endpoint name -> node index
         self._rows: dict[tuple[str, int], int] = {}  # (pod_key, uid) -> row
@@ -264,7 +281,7 @@ class SimEngine:
             self._pending_delete.clear()
             n = len(rows_list)
             (rows,), valid = self._pad([np.array(rows_list, np.int32)], n)
-            self._state = es.delete_links(self._state, rows, valid)
+            self._state = _delete_links_nd(self._state, rows, valid)
             self.stats.device_calls += 1
         if self._pending_apply:
             items = sorted(self._pending_apply.items())
@@ -277,8 +294,8 @@ class SimEngine:
             props = np.stack([e[3] for _, e in items]).astype(np.float32)
             (rows, uids, src, dst, props), valid = self._pad(
                 [rows, uids, src, dst, props], n)
-            self._state = es.apply_links(self._state, rows, uids, src, dst,
-                                         props, valid)
+            self._state = _apply_links_nd(self._state, rows, uids, src,
+                                          dst, props, valid)
             self.stats.device_calls += 1
         if self._pending_update:
             items = sorted(self._pending_update.items())
@@ -287,7 +304,7 @@ class SimEngine:
             rows = np.fromiter((r for r, _ in items), np.int32, n)
             props = np.stack([p for _, p in items]).astype(np.float32)
             (rows, props), valid = self._pad([rows, props], n)
-            self._state = es.update_links(self._state, rows, props, valid)
+            self._state = _update_links_nd(self._state, rows, props, valid)
             self.stats.device_calls += 1
 
     def flush(self) -> None:
@@ -309,10 +326,10 @@ class SimEngine:
             zeros = jnp.zeros((n,), jnp.int32)
             valid = jnp.zeros((n,), bool)
             props = jnp.zeros((n, es.NPROP), jnp.float32)
-            self._state = es.delete_links(self._state, rows, valid)
-            self._state = es.apply_links(self._state, rows, zeros, zeros,
-                                         zeros, props, valid)
-            self._state = es.update_links(self._state, rows, props, valid)
+            self._state = _delete_links_nd(self._state, rows, valid)
+            self._state = _apply_links_nd(self._state, rows, zeros, zeros,
+                                          zeros, props, valid)
+            self._state = _update_links_nd(self._state, rows, props, valid)
             jax.block_until_ready(self._state.props)
 
     @property
@@ -400,7 +417,11 @@ class SimEngine:
         self.set_alive(name, ns, self.node_ip, net_ns or f"/run/netns/{name}")
         topo = self.get_pod(name, ns)
         ok = self.add_links(topo, topo.spec.links)
-        self.stats.observe("setup", (time.perf_counter() - t0) * 1e3)
+        ms = (time.perf_counter() - t0) * 1e3
+        self.stats.observe("setup", ms)
+        self.log.info("setup_pod %s", _fields(
+            pod=f"{ns or 'default'}/{name}", links=len(topo.spec.links),
+            ok=ok, ms=round(ms, 2)))
         return ok
 
     def destroy_pod(self, name: str, ns: str = "default") -> bool:
@@ -441,9 +462,16 @@ class SimEngine:
             try:
                 resp = self._peer_daemon(src_ip).Update(remote_pod)
                 ok = ok and bool(resp.response)
-            except Exception:
+            except Exception as e:
                 self.stats.remote_errors += 1
+                self.log.warning("remote completion failed %s", _fields(
+                    action="add", pod=topo.key, peer_daemon=src_ip,
+                    error=type(e).__name__))
                 ok = False
+        if links:
+            self.log.debug("add_links %s", _fields(
+                action="add", pod=topo.key, links=len(links),
+                remote_calls=len(remote_calls), ok=ok))
         return ok
 
     @_locked
@@ -570,6 +598,9 @@ class SimEngine:
         self._enqueue_delete(rows)
         self.stats.dels += len(rows)
         self.stats.observe("del", (time.perf_counter() - t0) * 1e3)
+        if rows:
+            self.log.debug("del_links %s", _fields(
+                action="delete", pod=local_key, rows=len(rows)))
         return True
 
     @_locked
@@ -587,6 +618,9 @@ class SimEngine:
         self._enqueue_update(entries)
         self.stats.updates += len(entries)
         self.stats.observe("update", (time.perf_counter() - t0) * 1e3)
+        if entries:
+            self.log.debug("update_links %s", _fields(
+                action="update", pod=local_key, rows=len(entries)))
         return True
 
     @_locked
@@ -662,7 +696,9 @@ class SimEngine:
         sizes = jnp.full((E,), size_bytes, jnp.float32)
         have = jnp.zeros((E,), bool).at[jnp.array([ra, rb])].set(True)
         t0 = jnp.zeros((E,), jnp.float32)
-        self.state, res = netem.shape_step_auto(
+        # non-donating: a concurrent data-plane tick may hold these
+        # buffers in its lock-free snapshot
+        self.state, res = netem.shape_step_nodonate(
             self.state, sizes, have, t0, jax.random.key(seed))
         d_ab = float(res.depart_us[ra])
         d_ba = float(res.depart_us[rb])

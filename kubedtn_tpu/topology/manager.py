@@ -1,0 +1,278 @@
+"""ControllerManager — the controller-runtime manager equivalent.
+
+The reference wraps its reconciler in a controller-runtime Manager with
+leader election, health/ready probes, and a signal-driven run loop
+(reference main.go:80-126: NewManager with LeaderElection +
+LeaderElectionID "ac2ba29f.y-young.github.io", HealthProbeBindAddress,
+AddHealthzCheck/AddReadyzCheck, mgr.Start). This module provides the same
+operational surface for the in-process stack:
+
+- **Leader election** over a coordination.k8s.io/Lease-shaped record with
+  the store's optimistic concurrency as the CAS: candidates try to
+  acquire/renew `{holder, acquired_at, renew_at, lease_duration}`; a
+  stale lease (renew older than the lease duration) is taken over. Only
+  the leader runs reconcile drains — exactly what LeaderElection=true
+  buys the reference in an HA deployment.
+- **healthz/readyz** on a tiny HTTP server: healthz answers 200 whenever
+  the manager thread is alive (healthz.Ping parity); readyz answers 200
+  only once the manager completed its initial full resync AND — with
+  leader election on — reflects this instance's ability to serve.
+- **Run loop**: a background thread pumping watch events through the
+  (optionally concurrent) Reconciler until stopped.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubedtn_tpu.topology.reconciler import Reconciler
+from kubedtn_tpu.utils.logging import fields as _fields
+from kubedtn_tpu.utils.logging import get_logger
+
+# parity with the reference's LeaderElectionID (main.go:87)
+LEADER_ELECTION_ID = "ac2ba29f.y-young.github.io"
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease essentials."""
+
+    name: str
+    holder: str = ""
+    acquired_at: float = 0.0
+    renewed_at: float = 0.0
+    lease_duration_s: float = 15.0
+    transitions: int = 0
+
+
+class LeaseStore:
+    """Minimal lease registry with compare-and-swap semantics — the role
+    the apiserver's resourceVersion CAS plays for client-go's
+    leaderelection package. Thread-safe; shared by all candidates of one
+    in-process 'cluster' (in a real cluster this is the Lease CR)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._leases: dict[str, Lease] = {}
+
+    def try_acquire(self, name: str, identity: str, now: float,
+                    lease_duration_s: float) -> bool:
+        """Acquire if unheld/expired/ours; renew if ours. Atomic."""
+        with self._lock:
+            lease = self._leases.get(name)
+            if lease is None:
+                self._leases[name] = Lease(
+                    name=name, holder=identity, acquired_at=now,
+                    renewed_at=now, lease_duration_s=lease_duration_s)
+                return True
+            if lease.holder == identity:
+                lease.renewed_at = now
+                return True
+            if now - lease.renewed_at > lease.lease_duration_s:
+                # stale: take over (leader transition)
+                lease.holder = identity
+                lease.acquired_at = now
+                lease.renewed_at = now
+                lease.lease_duration_s = lease_duration_s
+                lease.transitions += 1
+                return True
+            return False
+
+    def release(self, name: str, identity: str) -> None:
+        """Voluntary step-down (LeaderElectionReleaseOnCancel semantics —
+        the next candidate need not wait out the lease)."""
+        with self._lock:
+            lease = self._leases.get(name)
+            if lease is not None and lease.holder == identity:
+                lease.holder = ""
+                lease.renewed_at = 0.0
+
+    def holder(self, name: str) -> str:
+        with self._lock:
+            lease = self._leases.get(name)
+            return lease.holder if lease else ""
+
+
+@dataclass
+class ManagerStatus:
+    alive: bool = False
+    synced: bool = False     # initial full resync completed
+    is_leader: bool = False
+    reconciles: int = 0
+    errors: int = 0
+    checks: dict = field(default_factory=dict)
+
+
+class _ProbeHandler(BaseHTTPRequestHandler):
+    manager: "ControllerManager" = None  # set per server
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        mgr = self.manager
+        if self.path.startswith("/healthz"):
+            ok, body = mgr.healthz()
+        elif self.path.startswith("/readyz"):
+            ok, body = mgr.readyz()
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        payload = json.dumps(body).encode()
+        self.send_response(200 if ok else 503)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *args):  # probes are too chatty for stdout
+        pass
+
+
+class ControllerManager:
+    """Runs a Reconciler continuously with optional leader election and
+    health/ready probes (reference main.go:80-126)."""
+
+    def __init__(self, store, engine, identity: str = "manager-0",
+                 workers: int = 1,
+                 leader_election: bool = False,
+                 lease_store: LeaseStore | None = None,
+                 lease_duration_s: float = 2.0,
+                 renew_interval_s: float = 0.5,
+                 probe_port: int | None = None,
+                 probe_host: str = "0.0.0.0",
+                 poll_interval_s: float = 0.02) -> None:
+        self.store = store
+        self.engine = engine
+        self.identity = identity
+        self.workers = workers
+        self.leader_election = leader_election
+        self.leases = lease_store if lease_store is not None else LeaseStore()
+        self.lease_duration_s = lease_duration_s
+        self.renew_interval_s = renew_interval_s
+        self.poll_interval_s = poll_interval_s
+        self.status = ManagerStatus()
+        self.log = get_logger("manager")
+        self.reconciler: Reconciler | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._http: ThreadingHTTPServer | None = None
+        self.probe_port: int | None = None
+        if probe_port is not None:
+            handler = type("Handler", (_ProbeHandler,), {"manager": self})
+            # all interfaces by default: kubelet httpGet probes dial the
+            # pod IP (reference HealthProbeBindAddress ":8081")
+            self._http = ThreadingHTTPServer((probe_host, probe_port),
+                                             handler)
+            self.probe_port = self._http.server_port
+            threading.Thread(target=self._http.serve_forever, daemon=True,
+                             name=f"probes-{identity}").start()
+
+    # -- probes --------------------------------------------------------
+
+    def healthz(self) -> tuple[bool, dict]:
+        """healthz.Ping parity: alive ⇔ the manager loop is running."""
+        ok = self.status.alive
+        return ok, {"status": "ok" if ok else "not started",
+                    "checks": {"ping": ok}}
+
+    def readyz(self) -> tuple[bool, dict]:
+        """Leader: ready once the initial resync completed. Standby: ready
+        by virtue of being able to take over (it has no watch open yet, so
+        `synced` cannot be its criterion) — mirroring controller-runtime,
+        where readyz does not gate on leadership."""
+        standby = (self.leader_election and not self.status.is_leader)
+        ok = self.status.alive and (self.status.synced or standby)
+        return ok, {
+            "status": "ok" if ok else "not ready",
+            "checks": {"alive": self.status.alive,
+                       "synced": self.status.synced,
+                       "standby": standby,
+                       "leader": self.status.is_leader},
+        }
+
+    # -- leadership ----------------------------------------------------
+
+    def _try_leadership(self) -> bool:
+        if not self.leader_election:
+            return True
+        now = time.monotonic()
+        got = self.leases.try_acquire(LEADER_ELECTION_ID, self.identity,
+                                      now, self.lease_duration_s)
+        if got and not self.status.is_leader:
+            self.log.info("became leader %s", _fields(
+                identity=self.identity, lease=LEADER_ELECTION_ID))
+        elif not got and self.status.is_leader:
+            self.log.warning("lost leadership %s", _fields(
+                identity=self.identity))
+        self.status.is_leader = got
+        return got
+
+    def _renew_loop(self) -> None:
+        """Dedicated lease renewer: leadership is kept alive INDEPENDENTLY
+        of drain duration — a multi-second drain (reconcile_100k measures
+        seconds) must not let the lease expire mid-drain and split-brain
+        into a second concurrent leader."""
+        while not self._stop.is_set():
+            if self.status.is_leader:
+                self._try_leadership()
+            self._stop.wait(self.renew_interval_s)
+
+    # -- run loop ------------------------------------------------------
+
+    def _run(self) -> None:
+        self.status.alive = True
+        last_renew = 0.0
+        try:
+            while not self._stop.is_set():
+                now = time.monotonic()
+                if now - last_renew >= self.renew_interval_s:
+                    self._try_leadership()
+                    last_renew = now
+                if not self.status.is_leader and self.leader_election:
+                    # standby: stay synced-false until first leadership
+                    self._stop.wait(self.renew_interval_s)
+                    continue
+                if self.reconciler is None:
+                    # the watch opens at leadership start: replay delivers
+                    # the full current state (informer initial LIST)
+                    self.reconciler = Reconciler(self.store, self.engine)
+                try:
+                    results = self.reconciler.drain(workers=self.workers)
+                    self.status.reconciles += len(results)
+                    if not self.status.synced:
+                        self.status.synced = True
+                        self.log.info("initial resync complete %s", _fields(
+                            identity=self.identity,
+                            reconciles=self.status.reconciles))
+                except Exception:
+                    self.status.errors += 1
+                    self.log.exception("drain failed (continuing)")
+                self._stop.wait(self.poll_interval_s)
+        finally:
+            self.status.alive = False
+            self.status.is_leader = False
+            if self.leader_election:
+                self.leases.release(LEADER_ELECTION_ID, self.identity)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"manager-{self.identity}")
+        self._thread.start()
+        if self.leader_election:
+            threading.Thread(target=self._renew_loop, daemon=True,
+                             name=f"lease-renew-{self.identity}").start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._http is not None:
+            self._http.shutdown()
+            self._http = None

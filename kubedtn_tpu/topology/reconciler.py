@@ -32,6 +32,8 @@ from kubedtn_tpu.topology.store import (
     TopologyStore,
     retry_on_conflict,
 )
+from kubedtn_tpu.utils.logging import fields as _fields
+from kubedtn_tpu.utils.logging import get_logger
 
 
 def _identity(link: Link) -> tuple:
@@ -149,6 +151,9 @@ class Reconciler:
         # keys whose last reconcile failed, retried on the next drain pass
         # (controller-runtime's requeue-on-error)
         self._requeue: set[tuple[str, str]] = set()
+        # controller-side structured logs (the reference controller logs
+        # through zap, main.go:61-78)
+        self.log = get_logger("reconciler")
 
     def reconcile(self, namespace: str, name: str) -> ReconcileResult:
         """One reconcile pass for one Topology, mirroring Reconcile
@@ -192,6 +197,10 @@ class Reconciler:
             # (reference topology_controller.go:120-122). Copying status
             # here would declare a half-realized link done forever.
             result.phase_ms["total"] = (time.perf_counter() - t_start) * 1e3
+            self.log.warning("reconcile failed %s", _fields(
+                topology=key, action=result.action, added=result.added,
+                deleted=result.deleted, updated=result.updated,
+                requeue=True))
             return result
 
         t0 = time.perf_counter()
@@ -207,6 +216,11 @@ class Reconciler:
         retry_on_conflict(txn)
         result.phase_ms["retry"] = (time.perf_counter() - t0) * 1e3
         result.phase_ms["total"] = (time.perf_counter() - t_start) * 1e3
+        if result.action != "noop":
+            self.log.debug("reconcile %s", _fields(
+                topology=key, action=result.action, added=result.added,
+                deleted=result.deleted, updated=result.updated,
+                ms=round(result.phase_ms["total"], 2)))
         return result
 
     def drain(self, max_passes: int = 64,

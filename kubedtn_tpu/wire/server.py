@@ -437,10 +437,20 @@ def _health_handlers():
 
 def make_server(daemon: Daemon, port: int = DEFAULT_PORT,
                 max_workers: int = 16,
-                host: str = "0.0.0.0") -> tuple[grpc.Server, int]:
+                host: str = "0.0.0.0",
+                log_rpcs: bool = True) -> tuple[grpc.Server, int]:
     """Build the gRPC server with the three reference services plus the
-    standard health service."""
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    standard health service. log_rpcs installs the per-RPC structured
+    logging interceptor (reference kubedtn.go:175-189); whether lines are
+    emitted is the logging config's call (cli.py sets it up from
+    KUBEDTN_LOG_LEVEL)."""
+    interceptors = ()
+    if log_rpcs:
+        from kubedtn_tpu.utils.logging import GrpcLoggingInterceptor
+
+        interceptors = (GrpcLoggingInterceptor(),)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
+                         interceptors=interceptors)
     tables = [
         ("Local", pb.LOCAL_METHODS),
         ("Remote", pb.REMOTE_METHODS),

@@ -1,0 +1,162 @@
+"""Structured logging: KV formatter, gRPC request interceptor, and the
+engine/reconciler per-action fields — the logrus/zap parity subsystem
+(reference daemon/kubedtn/kubedtn.go:175-189 request/response
+interceptors, common/context.go:11-29 field loggers, main.go:61-78 zap)."""
+
+import io
+import logging
+
+import grpc
+import pytest
+
+from kubedtn_tpu.api.types import (Link, LinkProperties, Topology,
+                                   TopologySpec)
+from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+from kubedtn_tpu.utils.logging import (KVFormatter, fields, get_logger,
+                                       setup)
+from kubedtn_tpu.wire import proto as pb
+from kubedtn_tpu.wire.client import DaemonClient
+from kubedtn_tpu.wire.server import Daemon, make_server
+
+
+def test_fields_rendering():
+    assert fields(a=1, b="x") == "a=1 b=x"
+    assert fields(msg="two words") == 'msg="two words"'
+    assert fields(q='say "hi"') == 'q="say \\"hi\\""'
+    assert fields(empty="") == 'empty=""'
+    assert fields(eq="a=b") == 'eq="a=b"'
+
+
+def test_formatter_logrus_shape():
+    logger = logging.getLogger("kubedtn.test.fmt")
+    logger.setLevel(logging.DEBUG)
+    buf = io.StringIO()
+    h = logging.StreamHandler(buf)
+    h.setFormatter(KVFormatter())
+    logger.addHandler(h)
+    try:
+        logger.info("hello %s", fields(pod="default/r1"))
+        line = buf.getvalue().strip()
+        assert line.startswith("time=")
+        assert " level=info " in line
+        assert 'msg="hello pod=default/r1"' in line
+        assert line.endswith("logger=kubedtn.test.fmt")
+    finally:
+        logger.removeHandler(h)
+
+
+def test_setup_idempotent_and_level():
+    root = setup(level="warning", stream=io.StringIO())
+    assert root.level == logging.WARNING
+    n = len(root.handlers)
+    setup(level="info", stream=io.StringIO())
+    assert len(logging.getLogger("kubedtn").handlers) == n  # replaced
+
+
+@pytest.fixture
+def capture():
+    """Capture kubedtn.* records at DEBUG without global side effects."""
+    records = []
+
+    class Sink(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    root = logging.getLogger("kubedtn")
+    old_level = root.level
+    sink = Sink(level=logging.DEBUG)
+    root.addHandler(sink)
+    root.setLevel(logging.DEBUG)
+    yield records
+    root.removeHandler(sink)
+    root.setLevel(old_level)
+
+
+def test_grpc_interceptor_logs_ok_and_error(capture):
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    store.create(Topology(name="r1", spec=TopologySpec(links=[])))
+    daemon = Daemon(engine)
+    server, port = make_server(daemon, port=0, host="127.0.0.1")
+    server.start()
+    client = DaemonClient(f"127.0.0.1:{port}")
+
+    client.Get(pb.PodQuery(name="r1"))
+    with pytest.raises(grpc.RpcError):
+        client.Get(pb.PodQuery(name="ghost"))   # NOT_FOUND abort
+
+    msgs = [(r.levelname, r.getMessage()) for r in capture
+            if r.name == "kubedtn.grpc"]
+    ok = [m for lvl, m in msgs
+          if lvl == "INFO" and "Local/Get" in m and "code=OK" in m]
+    failed = [m for lvl, m in msgs
+              if lvl == "WARNING" and "Local/Get" in m]
+    assert ok, msgs
+    assert failed, msgs
+    assert "ms=" in ok[0]
+    client.close()
+    server.stop(0)
+
+
+def test_engine_and_reconciler_action_fields(capture):
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    t = Topology(name="p", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth0",
+             peer_pod="physical/10.0.0.9", uid=1,
+             properties=LinkProperties(latency="1ms"))]))
+    t.status.links = []
+    store.create(t)
+    rec = Reconciler(store, engine)
+    rec.drain()
+
+    eng = [r.getMessage() for r in capture if r.name == "kubedtn.engine"]
+    ctl = [r.getMessage() for r in capture
+           if r.name == "kubedtn.reconciler"]
+    assert any("action=add" in m and "pod=default/p" in m for m in eng), eng
+    assert any("action=changed" in m and "topology=default/p" in m
+               for m in ctl), ctl
+
+
+def test_reconcile_failure_logged_warning(capture):
+    class Failing(SimEngine):
+        def add_links(self, topo, links):
+            return False if links else True
+
+    store = TopologyStore()
+    engine = Failing(store, capacity=16)
+    t = Topology(name="p", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth0",
+             peer_pod="physical/10.0.0.9", uid=1)]))
+    t.status.links = []
+    store.create(t)
+    Reconciler(store, engine).reconcile("default", "p")
+    warnings = [r for r in capture
+                if r.name == "kubedtn.reconciler"
+                and r.levelname == "WARNING"]
+    assert warnings and "requeue=True" in warnings[0].getMessage()
+
+
+def test_wire_data_rpcs_log_at_debug_not_info(capture):
+    """Per-frame RPCs must not emit info-level lines (kpps rates would
+    throttle forwarding); control-plane RPCs stay at info."""
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    daemon = Daemon(engine)
+    server, port = make_server(daemon, port=0, host="127.0.0.1")
+    server.start()
+    client = DaemonClient(f"127.0.0.1:{port}")
+    wire = daemon._add_wire(pb.WireDef(
+        local_pod_name="w", kube_ns="default", link_uid=1,
+        intf_name_in_pod="eth0", peer_ip="10.0.0.2"))
+    client.SendToOnce(pb.Packet(remot_intf_id=wire.wire_id, frame=b"x" * 60))
+    client.GenerateNodeInterfaceName(pb.GenerateNodeInterfaceNameRequest(
+        pod_name="p", pod_intf_name="eth0"))
+    grpc_logs = [(r.levelname, r.getMessage()) for r in capture
+                 if r.name == "kubedtn.grpc"]
+    send = [lvl for lvl, m in grpc_logs if "SendToOnce" in m]
+    ctrl = [lvl for lvl, m in grpc_logs if "GenerateNodeInterfaceName" in m]
+    assert send == ["DEBUG"], grpc_logs
+    assert ctrl == ["INFO"], grpc_logs
+    client.close()
+    server.stop(0)

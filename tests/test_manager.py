@@ -1,0 +1,219 @@
+"""ControllerManager: leader election, failover, health/ready probes, and
+the continuous reconcile loop — the controller-runtime Manager surface of
+the reference (reference main.go:80-126)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubedtn_tpu.api.types import (Link, LinkProperties, Topology,
+                                   TopologySpec)
+from kubedtn_tpu.topology import SimEngine, TopologyStore
+from kubedtn_tpu.topology.manager import (LEADER_ELECTION_ID,
+                                          ControllerManager, LeaseStore)
+
+
+def mk_cluster(n_pods=3):
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=64)
+    for i in range(n_pods):
+        t = Topology(name=f"p{i}", spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth0",
+                 peer_pod="physical/10.0.0.9", uid=i,
+                 properties=LinkProperties(latency="1ms"))]))
+        t.status.links = []
+        store.create(t)
+    return store, engine
+
+
+def wait_for(cond, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_manager_reconciles_continuously():
+    store, engine = mk_cluster()
+    mgr = ControllerManager(store, engine, workers=4)
+    mgr.start()
+    try:
+        assert wait_for(lambda: engine.num_active == 3)
+        assert wait_for(lambda: mgr.status.synced)
+        # a NEW topology created while running is picked up (no restart)
+        t = Topology(name="late", spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth0",
+                 peer_pod="physical/10.0.0.9", uid=99)]))
+        t.status.links = []
+        store.create(t)
+        assert wait_for(lambda: engine.num_active == 4)
+    finally:
+        mgr.stop()
+    assert not mgr.status.alive
+
+
+def test_leader_election_single_leader_and_failover():
+    store, engine = mk_cluster()
+    leases = LeaseStore()
+    a = ControllerManager(store, engine, identity="a", leader_election=True,
+                          lease_store=leases, lease_duration_s=0.5,
+                          renew_interval_s=0.05)
+    b = ControllerManager(store, engine, identity="b", leader_election=True,
+                          lease_store=leases, lease_duration_s=0.5,
+                          renew_interval_s=0.05)
+    a.start()
+    assert wait_for(lambda: a.status.is_leader)
+    b.start()
+    try:
+        time.sleep(0.3)
+        # exactly one leader, and it reconciles
+        assert a.status.is_leader and not b.status.is_leader
+        assert leases.holder(LEADER_ELECTION_ID) == "a"
+        assert wait_for(lambda: engine.num_active == 3)
+
+        # leader dies -> standby takes over within the lease duration
+        a.stop()
+        assert wait_for(lambda: b.status.is_leader, timeout=5)
+        assert leases.holder(LEADER_ELECTION_ID) == "b"
+        # and the new leader serves fresh work
+        t = Topology(name="post-failover", spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth0",
+                 peer_pod="physical/10.0.0.9", uid=50)]))
+        t.status.links = []
+        store.create(t)
+        assert wait_for(lambda: engine.num_active == 4)
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_voluntary_release_speeds_up_takeover():
+    """stop() releases the lease (ReleaseOnCancel semantics): the standby
+    must NOT have to wait out the full lease duration."""
+    store, engine = mk_cluster(0)
+    leases = LeaseStore()
+    kw = dict(leader_election=True, lease_store=leases,
+              lease_duration_s=30.0, renew_interval_s=0.05)
+    a = ControllerManager(store, engine, identity="a", **kw)
+    b = ControllerManager(store, engine, identity="b", **kw)
+    a.start()
+    assert wait_for(lambda: a.status.is_leader)
+    b.start()
+    a.stop()  # releases the 30s lease voluntarily
+    try:
+        assert wait_for(lambda: b.status.is_leader, timeout=5), \
+            "takeover waited on a released lease"
+    finally:
+        b.stop()
+
+
+def test_probe_endpoints():
+    store, engine = mk_cluster()
+    mgr = ControllerManager(store, engine, probe_port=0)
+
+    def get(path):
+        url = f"http://127.0.0.1:{mgr.probe_port}{path}"
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                raw = r.read()
+                return r.status, json.loads(raw) if raw else {}
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            return e.code, json.loads(raw) if raw else {}
+
+    # not started: healthz/readyz 503
+    code, _ = get("/healthz")
+    assert code == 503
+    mgr.start()
+    try:
+        assert wait_for(lambda: mgr.status.synced)
+        code, body = get("/healthz")
+        assert code == 200 and body["checks"]["ping"]
+        code, body = get("/readyz")
+        assert code == 200 and body["checks"]["synced"]
+        code, _ = get("/nope")
+        assert code == 404
+        # a stopped manager reports unhealthy (probe still answering here;
+        # in deployment the pod's probe failures trigger restart)
+        mgr._stop.set()
+        mgr._thread.join(timeout=10)
+        mgr._thread = None
+        code, _ = get("/readyz")
+        assert code == 503
+        code, _ = get("/healthz")
+        assert code == 503
+    finally:
+        mgr.stop()
+
+
+def test_standby_is_ready_but_idle():
+    """A non-leader standby reports ready (it can take over) but performs
+    no reconciles while the leader holds the lease."""
+    store, engine = mk_cluster()
+    leases = LeaseStore()
+    kw = dict(leader_election=True, lease_store=leases,
+              lease_duration_s=5.0, renew_interval_s=0.05)
+    a = ControllerManager(store, engine, identity="a", **kw)
+    a.start()
+    assert wait_for(lambda: a.status.synced)
+    b = ControllerManager(store, engine, identity="b", probe_port=0, **kw)
+    b.start()
+    try:
+        time.sleep(0.3)
+        assert not b.status.is_leader
+        assert b.status.reconciles == 0
+        # the standby is healthy AND ready: it can take over at any time
+        # (controller-runtime readyz does not gate on leadership)
+        for path in ("/healthz", "/readyz"):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{b.probe_port}{path}",
+                    timeout=5) as r:
+                assert r.status == 200, path
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_leadership_survives_long_drain():
+    """The lease renews from a dedicated thread, so a drain longer than
+    the lease duration must NOT lose leadership mid-drain (split-brain)."""
+    store, engine = mk_cluster(0)
+    leases = LeaseStore()
+
+    class SlowReconciler:
+        pass
+
+    kw = dict(leader_election=True, lease_store=leases,
+              lease_duration_s=0.4, renew_interval_s=0.05)
+    a = ControllerManager(store, engine, identity="a", **kw)
+    b = ControllerManager(store, engine, identity="b", **kw)
+    a.start()
+    assert wait_for(lambda: a.status.is_leader)
+
+    # make a's drains slower than the whole lease duration
+    orig_drain = None
+
+    def slow_drain(*args, **kwargs):
+        time.sleep(1.0)  # 2.5x the lease duration
+        return orig_drain(*args, **kwargs)
+
+    assert wait_for(lambda: a.reconciler is not None)
+    orig_drain = a.reconciler.drain
+    a.reconciler.drain = slow_drain
+    b.start()
+    try:
+        time.sleep(2.0)  # several slow drains
+        assert a.status.is_leader, "leader lost lease during a long drain"
+        assert not b.status.is_leader, "split-brain: standby took the lease"
+        assert leases.holder(LEADER_ELECTION_ID) == "a"
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_leader_election_id_parity():
+    assert LEADER_ELECTION_ID == "ac2ba29f.y-young.github.io"

@@ -1,0 +1,106 @@
+"""Two-process multi-host smoke test.
+
+Proves the jax.distributed path works end to end: two OS processes (the
+stand-ins for two TPU hosts) join one coordinator, build the host-major
+multihost mesh, and reduce an edge-sharded array across BOTH processes'
+devices — the initialization the reference performs when each node's
+daemon joins the cluster and peers over gRPC (reference
+daemon/main.go:20-107), re-expressed as jax.distributed + collectives
+(kubedtn_tpu/parallel/mesh.py:43-70).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import json, os, sys
+
+pid = int(sys.argv[1])
+coord = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, sys.argv[3])
+
+import jax
+
+# the axon TPU-tunnel platform overrides JAX_PLATFORMS; the explicit
+# config update is what actually pins the CPU backend (see conftest.py)
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubedtn_tpu.parallel.mesh import (edge_sharding, init_distributed,
+                                       make_multihost_mesh)
+
+init_distributed(coordinator_address=coord, num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+mesh = make_multihost_mesh()
+assert mesh.devices.size == 4, mesh.devices.size
+# host-major: this process's two devices hold consecutive shards
+sh = edge_sharding(mesh)
+
+E = 8  # 2 rows per device
+data = np.arange(E, dtype=np.float32) + 1.0  # 1..8, global
+x = jax.make_array_from_callback((E,), sh, lambda idx: data[idx])
+
+total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(x)
+# each process also checks its addressable shards carry the right slices
+local_rows = sorted(int(s.index[0].start) for s in x.addressable_shards)
+print(json.dumps({
+    "pid": pid,
+    "procs": jax.process_count(),
+    "devices": int(mesh.devices.size),
+    "total": float(total),
+    "local_shard_starts": local_rows,
+}), flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_multihost_mesh(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), coord, REPO],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError("multihost worker hung")
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    for pid, o in enumerate(sorted(outs, key=lambda o: o["pid"])):
+        assert o["pid"] == pid
+        assert o["procs"] == 2
+        assert o["devices"] == 4
+        assert o["total"] == 36.0  # sum(1..8) reduced across BOTH hosts
+    # host-major layout: process 0 owns rows [0,2), [2,4); process 1 the rest
+    starts = {o["pid"]: o["local_shard_starts"] for o in outs}
+    assert starts[0] == [0, 2]
+    assert starts[1] == [4, 6]
