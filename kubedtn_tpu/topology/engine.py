@@ -59,7 +59,8 @@ VXLAN_BASE = 5000
 # allocation per flush is the price of that safety.
 _apply_links_nd = jax.jit(es.apply_links.__wrapped__)
 _delete_links_nd = jax.jit(es.delete_links.__wrapped__)
-_update_links_nd = jax.jit(es.update_links.__wrapped__)
+_update_links_nd = jax.jit(es.update_links.__wrapped__,
+                           static_argnums=(4,))
 
 
 def vni_from_uid(uid: int) -> int:
@@ -261,16 +262,20 @@ class SimEngine:
         non-zero netem/TBF field)."""
         return row in self._shaped_rows
 
-    def _pad(self, arrs: list[np.ndarray], n: int):
-        """Pad host batches to a power-of-two lane count."""
+    def _pad_host(self, arrs: list[np.ndarray], n: int):
+        """Pad host batches to a power-of-two lane count (host arrays —
+        the single place the padding policy lives)."""
         b = _next_pow2(max(n, 1))
-        out = []
-        for a in arrs:
-            pad_width = [(0, b - n)] + [(0, 0)] * (a.ndim - 1)
-            out.append(jnp.asarray(np.pad(a, pad_width)))
+        out = [np.pad(a, [(0, b - n)] + [(0, 0)] * (a.ndim - 1))
+               for a in arrs]
         valid = np.zeros((b,), dtype=bool)
         valid[:n] = True
-        return out, jnp.asarray(valid)
+        return out, valid
+
+    def _pad(self, arrs: list[np.ndarray], n: int):
+        """_pad_host, staged onto device."""
+        out, valid = self._pad_host(arrs, n)
+        return [jnp.asarray(a) for a in out], jnp.asarray(valid)
 
     def _flush_device_locked(self) -> None:
         """Apply all pending ops as at most three batched device calls.
@@ -303,8 +308,16 @@ class SimEngine:
             n = len(items)
             rows = np.fromiter((r for r, _ in items), np.int32, n)
             props = np.stack([p for _, p in items]).astype(np.float32)
-            (rows, props), valid = self._pad([rows, props], n)
-            self._state = _update_links_nd(self._state, rows, props, valid)
+            # consecutive-row batches (the allocator hands out consecutive
+            # rows, so whole-topology updates usually qualify) take the
+            # gather/scatter-free streaming path
+            (rows_pad, props_pad), valid_np = self._pad_host(
+                [rows, props], n)
+            contig = es.contiguous_window(rows_pad, valid_np,
+                                          self._state.capacity)
+            self._state = _update_links_nd(
+                self._state, jnp.asarray(rows_pad), jnp.asarray(props_pad),
+                jnp.asarray(valid_np), contig)
             self.stats.device_calls += 1
 
     def flush(self) -> None:
@@ -329,7 +342,10 @@ class SimEngine:
             self._state = _delete_links_nd(self._state, rows, valid)
             self._state = _apply_links_nd(self._state, rows, zeros, zeros,
                                           zeros, props, valid)
-            self._state = _update_links_nd(self._state, rows, props, valid)
+            self._state = _update_links_nd(self._state, rows, props, valid,
+                                           False)
+            self._state = _update_links_nd(self._state, rows, props, valid,
+                                           True)
             jax.block_until_ready(self._state.props)
 
     @property

@@ -159,16 +159,27 @@ class ControllerManager:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._http: ThreadingHTTPServer | None = None
+        self._probe_host = probe_host
+        self._probe_port_req = probe_port
         self.probe_port: int | None = None
         if probe_port is not None:
-            handler = type("Handler", (_ProbeHandler,), {"manager": self})
-            # all interfaces by default: kubelet httpGet probes dial the
-            # pod IP (reference HealthProbeBindAddress ":8081")
-            self._http = ThreadingHTTPServer((probe_host, probe_port),
-                                             handler)
-            self.probe_port = self._http.server_port
-            threading.Thread(target=self._http.serve_forever, daemon=True,
-                             name=f"probes-{identity}").start()
+            # probes answer from construction (503 until started), like a
+            # pod whose kubelet probes begin before the process is ready
+            self._start_probes()
+
+    def _start_probes(self) -> None:
+        if self._http is not None or self._probe_port_req is None:
+            return
+        handler = type("Handler", (_ProbeHandler,), {"manager": self})
+        # all interfaces by default: kubelet httpGet probes dial the
+        # pod IP (reference HealthProbeBindAddress ":8081"); a restart
+        # rebinds the SAME port the first bind chose
+        port = self.probe_port if self.probe_port is not None \
+            else self._probe_port_req
+        self._http = ThreadingHTTPServer((self._probe_host, port), handler)
+        self.probe_port = self._http.server_port
+        threading.Thread(target=self._http.serve_forever, daemon=True,
+                         name=f"probes-{self.identity}").start()
 
     # -- probes --------------------------------------------------------
 
@@ -260,6 +271,7 @@ class ControllerManager:
     def start(self) -> None:
         if self._thread is not None:
             return
+        self._start_probes()  # recreate after a stop()
         self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"manager-{self.identity}")
@@ -275,4 +287,5 @@ class ControllerManager:
             self._thread = None
         if self._http is not None:
             self._http.shutdown()
+            self._http.server_close()  # release the listening socket
             self._http = None

@@ -30,13 +30,17 @@ def get_logger(name: str) -> logging.Logger:
 
 
 def fields(**kv) -> str:
-    """Render key=value fields logrus-text style: values with spaces or
-    quotes are double-quoted with escaping."""
+    """Render key=value fields logrus-text style: values with spaces,
+    quotes, or newlines are double-quoted with escaping — newlines are
+    escaped so one record can never split into (or forge) a second log
+    line."""
     parts = []
     for k, v in kv.items():
         s = str(v)
-        if any(c in s for c in ' "=') or s == "":
-            s = '"' + s.replace('\\', '\\\\').replace('"', '\\"') + '"'
+        if any(c in s for c in ' "=\n\r') or s == "":
+            s = (s.replace('\\', '\\\\').replace('"', '\\"')
+                  .replace("\n", "\\n").replace("\r", "\\r"))
+            s = f'"{s}"'
         parts.append(f"{k}={s}")
     return " ".join(parts)
 

@@ -126,3 +126,110 @@ def test_update_links_empty_batch_noop():
                           jnp.zeros((0, es.NPROP), jnp.float32),
                           jnp.zeros((0,), bool))
     assert out.capacity == 8
+
+
+class TestContiguousUpdate:
+    """update_links(contiguous=True) — the dynamic-slice streaming path —
+    must be bit-identical to the general formulation."""
+
+    def _mk(self, E=64, B=16, seed=0):
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        state = es.init_state(E)
+        # make every row active with random props/dynamics so resets and
+        # preserved lanes are both observable
+        rows0 = jnp.arange(E, dtype=jnp.int32)
+        state = es.apply_links(
+            state, rows0, rows0, rows0, rows0,
+            jnp.asarray(rng.random((E, es.NPROP), np.float32)),
+            jnp.ones((E,), bool))
+        props = jnp.asarray(rng.random((B, es.NPROP), np.float32) * 1e6)
+        return state, props
+
+    def _clone(self, st):
+        return jax.tree.map(lambda x: x.copy(), st)
+
+    def assert_equal(self, a, b):
+        import numpy as np
+
+        for name in ("props", "tokens", "corr", "pkt_count",
+                     "backlog_until", "uid", "active"):
+            av, bv = np.asarray(getattr(a, name)), np.asarray(
+                getattr(b, name))
+            assert np.array_equal(av, bv), name
+
+    def test_matches_general_path_full_valid(self):
+        state, props = self._mk()
+        rows = jnp.arange(8, 24, dtype=jnp.int32)
+        valid = jnp.ones((16,), bool)
+        ref = es.update_links(self._clone(state), rows, props, valid)
+        got = es.update_links(self._clone(state), rows, props, valid,
+                              True)
+        self.assert_equal(ref, got)
+
+    def test_matches_general_path_with_padding(self):
+        import numpy as np
+
+        state, props = self._mk(B=16)
+        # 11 real lanes + 5 padding lanes (valid False, garbage rows)
+        rows = np.arange(40, 56, dtype=np.int32)
+        rows[11:] = 0  # pad garbage
+        valid = np.zeros((16,), bool)
+        valid[:11] = True
+        ref = es.update_links(self._clone(state), jnp.asarray(rows),
+                              props, jnp.asarray(valid))
+        got = es.update_links(self._clone(state), jnp.asarray(rows),
+                              props, jnp.asarray(valid), True)
+        self.assert_equal(ref, got)
+
+    def test_window_detection(self):
+        import numpy as np
+
+        cw = es.contiguous_window
+        r = np.arange(8, 24, dtype=np.int32)
+        v = np.ones((16,), bool)
+        assert cw(r, v, 64)
+        assert not cw(r, v, 20)            # window out of bounds
+        r2 = r.copy(); r2[5] = 99
+        assert not cw(r2, v, 64)           # hole
+        v2 = v.copy(); v2[5] = False       # hole only in a padding lane
+        assert cw(r2, v2, 64)
+        assert not cw(r, np.zeros((16,), bool), 64)  # first lane invalid
+        assert not cw(np.array([], np.int32), np.array([], bool), 64)
+
+    def test_engine_flush_uses_contiguous_when_possible(self, monkeypatch):
+        from kubedtn_tpu.api.types import (Link, LinkProperties, Topology,
+                                           TopologySpec)
+        from kubedtn_tpu.topology import SimEngine, TopologyStore
+        from kubedtn_tpu.topology import engine as engine_mod
+
+        # record the static `contiguous` arg actually handed to the kernel
+        # — the end state alone can't distinguish the two paths
+        seen: list[bool] = []
+        real = engine_mod._update_links_nd
+
+        def spy(state, rows, props, valid, contiguous=False):
+            seen.append(contiguous)
+            return real(state, rows, props, valid, contiguous)
+
+        monkeypatch.setattr(engine_mod, "_update_links_nd", spy)
+
+        store = TopologyStore()
+        engine = SimEngine(store, capacity=64)
+        links = [Link(local_intf=f"e{u}", peer_intf=f"p{u}",
+                      peer_pod=f"physical/10.0.0.{u % 250}", uid=u,
+                      properties=LinkProperties(latency="1ms"))
+                 for u in range(1, 17)]
+        t = Topology(name="c", spec=TopologySpec(links=links))
+        store.create(t)
+        engine.setup_pod("c")
+        engine.flush()
+        from dataclasses import replace as _rp
+        new = [_rp(l, properties=LinkProperties(
+            latency="7ms")) for l in links]
+        engine.update_links(t, new)
+        engine.flush()
+        assert seen == [True], f"contiguous path not taken: {seen}"
+        for u in range(1, 17):
+            assert engine.link_row("default/c", u)["latency_us"] == 7000.0

@@ -160,3 +160,10 @@ def test_wire_data_rpcs_log_at_debug_not_info(capture):
     assert ctrl == ["INFO"], grpc_logs
     client.close()
     server.stop(0)
+
+
+def test_fields_escapes_newlines():
+    """A value with newlines must stay ONE log line (no record forgery)."""
+    out = fields(error="bad\ntime=x level=info msg=forged")
+    assert "\n" not in out
+    assert out == 'error="bad\\ntime=x level=info msg=forged"'

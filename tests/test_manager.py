@@ -217,3 +217,24 @@ def test_leadership_survives_long_drain():
 
 def test_leader_election_id_parity():
     assert LEADER_ELECTION_ID == "ac2ba29f.y-young.github.io"
+
+
+def test_manager_restart_recreates_probes():
+    """stop() must release the probe socket and start() must bring the
+    probes back on the SAME port — a restarted manager with dead probes
+    would be killed by its orchestrator."""
+    store, engine = mk_cluster(0)
+    mgr = ControllerManager(store, engine, probe_port=0)
+    port = mgr.probe_port
+    mgr.start()
+    assert wait_for(lambda: mgr.status.alive)
+    mgr.stop()
+    mgr.start()
+    try:
+        assert mgr.probe_port == port
+        assert wait_for(lambda: mgr.status.alive)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+            assert r.status == 200
+    finally:
+        mgr.stop()
