@@ -139,8 +139,11 @@ def bench_link_updates(extras: dict) -> float:
     are one consecutive block (the allocator hands out consecutive rows,
     and the engine's flush coalesces a whole drain into one sorted
     batch) — so the headline uses update_links' contiguous streaming
-    path. extras also records the general inverse-map path driven with a
-    RANDOM row permutation ("scattered"), the worst-case layout.
+    path. extras also records the general inverse-map path driven with
+    SORTED-but-non-contiguous rows ("scattered"): the engine's realistic
+    non-contiguous case, since its flush always sorts a batch. (A fully
+    unsorted order would be slower still, but no engine path produces
+    one.)
     """
     import functools
 

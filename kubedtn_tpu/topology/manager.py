@@ -173,10 +173,18 @@ class ControllerManager:
         handler = type("Handler", (_ProbeHandler,), {"manager": self})
         # all interfaces by default: kubelet httpGet probes dial the
         # pod IP (reference HealthProbeBindAddress ":8081"); a restart
-        # rebinds the SAME port the first bind chose
+        # prefers the SAME port the first bind chose, but if someone took
+        # it while we were stopped, fall back to the requested port (a
+        # fresh ephemeral when that was 0) — start() must never raise
         port = self.probe_port if self.probe_port is not None \
             else self._probe_port_req
-        self._http = ThreadingHTTPServer((self._probe_host, port), handler)
+        try:
+            self._http = ThreadingHTTPServer((self._probe_host, port),
+                                             handler)
+        except OSError:
+            self.log.warning("probe port %s taken; rebinding", port)
+            self._http = ThreadingHTTPServer(
+                (self._probe_host, self._probe_port_req), handler)
         self.probe_port = self._http.server_port
         threading.Thread(target=self._http.serve_forever, daemon=True,
                          name=f"probes-{self.identity}").start()

@@ -31,6 +31,37 @@ from kubedtn_tpu.wire import proto as pb
 DEFAULT_PORT = 51111  # reference common/constants.go:9
 
 
+class _NotifyingDeque(deque):
+    """deque that fires a callback on any enqueue — direct `wire.ingress
+    .append(...)` (tests, embedders) marks the wire hot exactly like the
+    RPC ingestion paths do. The registry (WireManager) installs the
+    callback on every wire it learns about, whatever constructed it."""
+
+    def __init__(self, notify=None) -> None:
+        super().__init__()
+        self._notify = notify
+
+    def _fire(self) -> None:
+        if self._notify is not None:
+            self._notify()
+
+    def append(self, item) -> None:  # noqa: A003
+        super().append(item)
+        self._fire()
+
+    def appendleft(self, item) -> None:
+        super().appendleft(item)
+        self._fire()
+
+    def extend(self, items) -> None:
+        super().extend(items)
+        self._fire()
+
+    def extendleft(self, items) -> None:
+        super().extendleft(items)
+        self._fire()
+
+
 @dataclass
 class Wire:
     """One attachment of an external endpoint to a simulated link end."""
@@ -41,7 +72,7 @@ class Wire:
     node_iface_name: str
     peer_intf_id: int = 0
     peer_ip: str = ""
-    ingress: deque = field(default_factory=deque)  # frames awaiting the sim
+    ingress: deque = field(default_factory=_NotifyingDeque)  # awaiting sim
     egress: deque = field(default_factory=deque)   # frames the sim delivered
 
 
@@ -50,12 +81,27 @@ class WireManager:
     (grpcwire.go:100-158): by (netns, uid) for lookups and by interface id
     for O(1) per-packet dispatch."""
 
-    def __init__(self) -> None:
+    def __init__(self, on_ingress=None) -> None:
         self._lock = threading.Lock()
         self._next_index = 0
         self._next_wire_id = 1000
         self._by_id: dict[int, Wire] = {}
         self._by_key: dict[tuple[str, int], Wire] = {}
+        # called with the wire whenever frames are queued on its ingress
+        # (the daemon wires this to its hot set); installed on EVERY
+        # registered wire regardless of who constructed it
+        self._on_ingress = on_ingress
+
+    def _install_notify(self, wire: Wire) -> None:
+        if self._on_ingress is None:
+            return
+        if not isinstance(wire.ingress, _NotifyingDeque):
+            nd = _NotifyingDeque()
+            nd.extend(wire.ingress)  # preserve pre-registration frames
+            wire.ingress = nd
+        wire.ingress._notify = lambda: self._on_ingress(wire)
+        if wire.ingress:  # frames queued before registration
+            self._on_ingress(wire)
 
     def next_wire_id(self) -> int:
         with self._lock:
@@ -73,6 +119,7 @@ class WireManager:
         with self._lock:
             self._by_id[wire.wire_id] = wire
             self._by_key[(wire.pod_key, wire.uid)] = wire
+            self._install_notify(wire)
 
     def get_or_create(self, pod_key: str, uid: int, build) -> tuple:
         """Atomic wire-exists guard: two racing creates for the same
@@ -89,6 +136,7 @@ class WireManager:
             wire = build(self._next_wire_id)
             self._by_id[wire.wire_id] = wire
             self._by_key[(wire.pod_key, wire.uid)] = wire
+            self._install_notify(wire)
             return wire, True
 
     def get_by_id(self, wire_id: int) -> Wire | None:
@@ -116,7 +164,12 @@ class Daemon:
     def __init__(self, engine: SimEngine, latency_histograms=None,
                  forward_timeout_s: float = 0.5) -> None:
         self.engine = engine
-        self.wires = WireManager()
+        # wires with queued ingress — the data plane drains only these,
+        # so a tick is O(active wires), not O(all wires); the registry
+        # installs the marking hook on every wire it learns about
+        self._hot_lock = threading.Lock()
+        self._hot: set[int] = set()
+        self.wires = WireManager(on_ingress=self.mark_hot)
         self.hist = latency_histograms
         # deadline on per-frame peer forwards: a blackholed peer must cost
         # at most this long, never stall the data plane indefinitely
@@ -261,6 +314,11 @@ class Daemon:
 
     # -- WireProtocol --------------------------------------------------
 
+    def mark_hot(self, wire: Wire) -> None:
+        """Note queued ingress on a wire for the next drain."""
+        with self._hot_lock:
+            self._hot.add(wire.wire_id)
+
     def _frame_in(self, wire: Wire, frame: bytes) -> None:
         """Reference semantics split by wire kind: a cross-daemon wire
         (peer_ip set) receives frames FROM the peer daemon, already shaped
@@ -272,7 +330,7 @@ class Daemon:
         if wire.peer_ip:
             wire.egress.append(frame)
         else:
-            wire.ingress.append(frame)
+            wire.ingress.append(frame)  # the deque's notify marks it hot
 
     def SendToOnce(self, request, context):
         wire = self.wires.get_by_id(int(request.remot_intf_id))
@@ -309,15 +367,26 @@ class Daemon:
 
     def drain_ingress(self, max_per_wire: int = 64):
         """Collect queued external frames as (row, sizes) batches for the
-        next sim step."""
+        next sim step. Only wires marked hot are visited — O(wires with
+        traffic), not O(all wires); a wire left with residue (more than
+        max_per_wire queued, or no realized row yet) stays hot."""
+        with self._hot_lock:
+            hot, self._hot = self._hot, set()
         out = []
-        for wire in self.wires.all():
+        for wire_id in hot:
+            wire = self.wires.get_by_id(wire_id)
+            if wire is None:
+                continue  # deleted since marked
             row = self.engine.row_of(wire.pod_key, wire.uid)
             if row is None:
+                if wire.ingress:
+                    self.mark_hot(wire)  # retry once the link is realized
                 continue
             frames = []
             while wire.ingress and len(frames) < max_per_wire:
                 frames.append(wire.ingress.popleft())
+            if wire.ingress:
+                self.mark_hot(wire)  # residue beyond this tick's budget
             if frames:
                 if self._classify is not None:
                     self.frame_stats.update(self._classify(frames))

@@ -222,3 +222,80 @@ def test_racing_wire_creates_yield_one_wire():
     assert len(daemon.wires.all()) == 2
     client.close()
     server.stop(0)
+
+
+def test_drain_ingress_visits_only_hot_wires():
+    """drain_ingress is O(wires with traffic): untouched wires are never
+    visited, residue beyond the per-tick budget stays hot, and a wire
+    whose link is not yet realized is retried once it is."""
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=64)
+    daemon = Daemon(engine)
+    wires = [daemon._add_wire(pb.WireDef(
+        local_pod_name=f"p{i}", kube_ns="default", link_uid=i,
+        intf_name_in_pod="eth0")) for i in range(20)]
+    # realize rows for pods 0..19
+    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+    for i in range(20):
+        t = Topology(name=f"p{i}", spec=TopologySpec(links=[
+            Link(local_intf="eth0", peer_intf="e", uid=i,
+                 peer_pod="physical/10.0.0.9")]))
+        store.create(t)
+        engine.setup_pod(f"p{i}")
+
+    visited = []
+    real_get = daemon.wires.get_by_id
+    daemon.wires.get_by_id = lambda i: (visited.append(i),
+                                        real_get(i))[1]
+    # traffic on exactly one wire, more than one tick's budget
+    for _ in range(70):
+        wires[7].ingress.append(b"x" * 60)
+    out = daemon.drain_ingress(max_per_wire=64)
+    assert len(out) == 1 and len(out[0][2]) == 64
+    assert set(visited) == {wires[7].wire_id}  # nobody else visited
+    visited.clear()
+    out = daemon.drain_ingress(max_per_wire=64)  # residue still hot
+    assert len(out) == 1 and len(out[0][2]) == 6
+    assert daemon.drain_ingress() == []          # drained -> cold
+
+    # unrealized link: frames wait, wire stays hot until the row exists
+    w = daemon._add_wire(pb.WireDef(
+        local_pod_name="late", kube_ns="default", link_uid=99,
+        intf_name_in_pod="eth0"))
+    w.ingress.append(b"y" * 60)
+    assert daemon.drain_ingress() == []
+    t = Topology(name="late", spec=TopologySpec(links=[
+        Link(local_intf="eth0", peer_intf="e", uid=99,
+             peer_pod="physical/10.0.0.9")]))
+    store.create(t)
+    engine.setup_pod("late")
+    out = daemon.drain_ingress()
+    assert len(out) == 1 and out[0][2] == [b"y" * 60]
+
+
+def test_directly_constructed_wire_not_starved():
+    """A Wire built by an embedder (plain dataclass) and registered via
+    WireManager.add must still be drained: the registry installs the
+    hot-marking hook on every wire it learns about — including frames
+    queued BEFORE registration."""
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    daemon = Daemon(engine)
+    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+    t = Topology(name="emb", spec=TopologySpec(links=[
+        Link(local_intf="eth0", peer_intf="e", uid=3,
+             peer_pod="physical/10.0.0.9")]))
+    store.create(t)
+    engine.setup_pod("emb")
+
+    from kubedtn_tpu.wire.server import Wire
+    wire = Wire(wire_id=7777, uid=3, pod_key="default/emb",
+                node_iface_name="emb-eth0")
+    wire.ingress.append(b"early" + b"\x00" * 55)  # BEFORE registration
+    daemon.wires.add(wire)
+    out = daemon.drain_ingress()
+    assert len(out) == 1 and out[0][2][0].startswith(b"early")
+    # post-registration direct appends (and extend) also mark hot
+    wire.ingress.extend([b"l" * 60, b"m" * 60])
+    out = daemon.drain_ingress()
+    assert len(out) == 1 and len(out[0][2]) == 2
