@@ -164,6 +164,10 @@ class WireDataPlane:
             self._flowtable = None
         self._remote = _RemoteStage()
         self._stop = threading.Event()
+        # set by the daemon whenever ingress queues: the runner wakes and
+        # ticks immediately instead of sleeping out the period
+        self._wake = threading.Event()
+        daemon.ingress_signal = self._wake
         self._thread: threading.Thread | None = None
         self.counters: EdgeCounters = init_counters(
             self.engine.state.capacity)
@@ -459,6 +463,7 @@ class WireDataPlane:
             last_error: str | None = None
             while not self._stop.is_set():
                 t0 = time.monotonic()
+                self._wake.clear()  # signals during the tick re-arm it
                 try:
                     self.tick(t0)
                     last_error = None
@@ -476,9 +481,20 @@ class WireDataPlane:
                     elif log.isEnabledFor(10):  # DEBUG
                         log.debug("tick failed again %s", fields(
                             error=sig, tick_errors=self.tick_errors))
-                budget = period - (time.monotonic() - t0)
+                now = time.monotonic()
+                budget = period - (now - t0)
+                # wake EARLY for the next scheduled release: the native
+                # wheel's next_due_us is a safe lower bound, so release
+                # jitter stays below the tick period instead of at it
+                # (the qdisc-watchdog precision of the reference's netem)
+                if self._wheel is not None and self._origin_s is not None:
+                    nd = self._wheel.next_due_us()
+                    if nd is not None:
+                        due_in = self._origin_s + nd / 1e6 - now
+                        budget = min(budget, max(due_in, 0.0))
                 if budget > 0:
-                    self._stop.wait(budget)
+                    # wakes early on new ingress (daemon signal) or stop
+                    self._wake.wait(budget)
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="wire-dataplane")
@@ -486,6 +502,7 @@ class WireDataPlane:
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()  # unblock a sleeping runner
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

@@ -176,15 +176,20 @@ class ControllerManager:
         # prefers the SAME port the first bind chose, but if someone took
         # it while we were stopped, fall back to the requested port (a
         # fresh ephemeral when that was 0) — start() must never raise
-        port = self.probe_port if self.probe_port is not None \
+        preferred = self.probe_port if self.probe_port is not None \
             else self._probe_port_req
-        try:
-            self._http = ThreadingHTTPServer((self._probe_host, port),
-                                             handler)
-        except OSError:
-            self.log.warning("probe port %s taken; rebinding", port)
-            self._http = ThreadingHTTPServer(
-                (self._probe_host, self._probe_port_req), handler)
+        # preferred port → requested port → any free port: a restart must
+        # come back with probes on SOME port, never raise
+        for port in dict.fromkeys((preferred, self._probe_port_req, 0)):
+            try:
+                self._http = ThreadingHTTPServer(
+                    (self._probe_host, port), handler)
+                break
+            except OSError:
+                self.log.warning("probe port %s unavailable; trying next",
+                                 port)
+        else:  # pragma: no cover — port 0 cannot fail to bind
+            return
         self.probe_port = self._http.server_port
         threading.Thread(target=self._http.serve_forever, daemon=True,
                          name=f"probes-{self.identity}").start()

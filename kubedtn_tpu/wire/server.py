@@ -61,6 +61,15 @@ class _NotifyingDeque(deque):
         super().extendleft(items)
         self._fire()
 
+    def insert(self, index, item) -> None:
+        super().insert(index, item)
+        self._fire()
+
+    def __iadd__(self, items):
+        # deque's C-level __iadd__ would bypass the extend override
+        self.extend(items)
+        return self
+
 
 @dataclass
 class Wire:
@@ -96,9 +105,20 @@ class WireManager:
         if self._on_ingress is None:
             return
         if not isinstance(wire.ingress, _NotifyingDeque):
+            # exotic embedder replaced the default _NotifyingDeque with a
+            # plain one: swap it out, then drain stragglers that raced in
+            # between the copy and the swap. A producer that cached the
+            # OLD deque object past registration is on its own — use the
+            # default factory or re-read wire.ingress after registering.
+            old = wire.ingress
             nd = _NotifyingDeque()
-            nd.extend(wire.ingress)  # preserve pre-registration frames
+            nd.extend(old)
             wire.ingress = nd
+            while len(nd) != len(old):  # post-copy racers
+                try:
+                    nd.append(old[len(nd)])
+                except IndexError:  # pragma: no cover — shrank mid-check
+                    break
         wire.ingress._notify = lambda: self._on_ingress(wire)
         if wire.ingress:  # frames queued before registration
             self._on_ingress(wire)
@@ -169,6 +189,10 @@ class Daemon:
         # installs the marking hook on every wire it learns about
         self._hot_lock = threading.Lock()
         self._hot: set[int] = set()
+        # optional wake-up for the data plane: set by WireDataPlane so
+        # ingress arriving mid-sleep starts a tick immediately instead of
+        # waiting out the period
+        self.ingress_signal: threading.Event | None = None
         self.wires = WireManager(on_ingress=self.mark_hot)
         self.hist = latency_histograms
         # deadline on per-frame peer forwards: a blackholed peer must cost
@@ -315,7 +339,18 @@ class Daemon:
     # -- WireProtocol --------------------------------------------------
 
     def mark_hot(self, wire: Wire) -> None:
-        """Note queued ingress on a wire for the next drain."""
+        """Note queued ingress on a wire and wake the data plane — the
+        entry point for EXTERNAL ingestion."""
+        self._remark(wire)
+        signal = self.ingress_signal
+        if signal is not None:
+            signal.set()
+
+    def _remark(self, wire: Wire) -> None:
+        """Keep a wire hot for the NEXT scheduled tick without waking the
+        runner: used by the drain itself for residue/unrealized retries —
+        signaling here would make the wake-early runner busy-spin on a
+        wire whose link never realizes."""
         with self._hot_lock:
             self._hot.add(wire.wire_id)
 
@@ -380,13 +415,13 @@ class Daemon:
             row = self.engine.row_of(wire.pod_key, wire.uid)
             if row is None:
                 if wire.ingress:
-                    self.mark_hot(wire)  # retry once the link is realized
+                    self._remark(wire)  # retry once the link is realized
                 continue
             frames = []
             while wire.ingress and len(frames) < max_per_wire:
                 frames.append(wire.ingress.popleft())
             if wire.ingress:
-                self.mark_hot(wire)  # residue beyond this tick's budget
+                self._remark(wire)  # residue beyond this tick's budget
             if frames:
                 if self._classify is not None:
                     self.frame_stats.update(self._classify(frames))

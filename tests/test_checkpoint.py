@@ -177,3 +177,51 @@ def test_restored_engine_rebuilds_shaped_rows(tmp_path):
     store2, engine2 = cp.load(path)
     assert engine2.is_shaped(engine2.row_of("default/s", 1))
     assert not engine2.is_shaped(engine2.row_of("default/s", 2))
+
+
+def test_daemon_restart_resumes_shaping_e2e(tmp_path):
+    """Full daemon-restart story (the reference's restart rescan,
+    SURVEY §5.3-5.4): checkpoint a live daemon's store+engine, 'crash'
+    it, restore into a NEW daemon, re-attach wires, and verify traffic
+    still shapes with the original link properties."""
+    from kubedtn_tpu import checkpoint as cp
+    from kubedtn_tpu.api.types import load_yaml
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon, make_server
+
+    LATENCY = "/root/reference/config/samples/tc/latency.yaml"
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=64)
+    for t in load_yaml(LATENCY):
+        store.create(t)
+        engine.setup_pod(t.name, t.namespace)
+    n_active = engine.num_active
+    assert n_active > 0
+
+    path = str(tmp_path / "daemon-ckpt")
+    cp.save(path, store, engine)
+    del store, engine  # the 'crash'
+
+    store2, engine2 = cp.load(path)
+    assert engine2.num_active == n_active
+    daemon2 = Daemon(engine2)
+    server2, port2 = make_server(daemon2, port=0, host="127.0.0.1")
+    server2.start()
+    try:
+        # wires re-attach (pods reconnect after a daemon restart)
+        w1 = daemon2._add_wire(pb.WireDef(
+            local_pod_name="r1", kube_ns="default", link_uid=1,
+            intf_name_in_pod="eth1"))
+        w2 = daemon2._add_wire(pb.WireDef(
+            local_pod_name="r2", kube_ns="default", link_uid=1,
+            intf_name_in_pod="eth1"))
+        dp = WireDataPlane(daemon2)
+        frame = b"\x02" * 12 + b"\x08\x06" + b"\x00" * 50
+        w1.ingress.append(frame)
+        assert dp.tick(now_s=10.0) == 1
+        assert not w2.egress          # 10ms latency survived the restart
+        dp.tick(now_s=10.011)
+        assert list(w2.egress) == [frame]
+    finally:
+        server2.stop(0)

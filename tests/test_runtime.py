@@ -319,3 +319,60 @@ def test_bypass_never_for_cross_node_wires():
         dp.tick(now_s=30.0 + i * 0.001)
     assert dp.bypassed == 0
     assert dp.shaped == 3
+
+
+def test_wheel_wakes_early_for_due_releases():
+    """With a coarse tick period, a short netem delay still releases near
+    its deadline: the runner sleeps only until the wheel's next due time,
+    not a full period (the qdisc-watchdog precision of the reference)."""
+    from kubedtn_tpu import native
+
+    if not native.have_native():
+        import pytest
+        pytest.skip("native toolchain unavailable")
+    daemon, engine = make_daemon(LATENCY)  # r1<->r2 uid1: 10ms
+    w1 = add_wire(daemon, "r1", 1)
+    w2 = add_wire(daemon, "r2", 1)
+    dp = WireDataPlane(daemon, dt_us=200_000.0)  # 200ms period
+    # warm the shaping compile OUTSIDE the timed window
+    w1.ingress.append(b"w" * 60)
+    dp.tick()
+    import time as _t
+
+    _t.sleep(0.02)
+    dp.tick()
+    w2.egress.clear()
+
+    dp.start()
+    try:
+        t0 = _t.monotonic()
+        w1.ingress.append(b"z" * 64)
+        deadline = t0 + 2.0
+        while not w2.egress and _t.monotonic() < deadline:
+            _t.sleep(0.002)
+        elapsed = _t.monotonic() - t0
+        assert w2.egress, "frame never delivered"
+        # 10ms delay + scheduling slack must beat the 200ms period
+        assert elapsed < 0.15, f"release waited a full period: {elapsed:.3f}s"
+    finally:
+        dp.stop()
+
+
+def test_unrealized_hot_wire_does_not_busy_spin():
+    """A wire with frames but no realized link must NOT wake the runner
+    in a tight loop — it stays hot for scheduled ticks only."""
+    daemon, engine = make_daemon(THREE_NODE)
+    w = daemon._add_wire(pb.WireDef(
+        local_pod_name="ghost-pod", kube_ns="default", link_uid=77,
+        intf_name_in_pod="eth0"))
+    dp = WireDataPlane(daemon, dt_us=20_000.0)  # 20ms period
+    w.ingress.append(b"x" * 60)
+    dp.start()
+    try:
+        import time as _t
+        _t.sleep(0.5)
+        # ~25 scheduled ticks in 0.5s at 20ms; a busy spin would be 1000s
+        assert dp.ticks < 100, f"busy spin: {dp.ticks} ticks in 0.5s"
+        assert len(w.ingress) == 1  # frame still waiting, not lost
+    finally:
+        dp.stop()

@@ -299,3 +299,23 @@ def test_directly_constructed_wire_not_starved():
     wire.ingress.extend([b"l" * 60, b"m" * 60])
     out = daemon.drain_ingress()
     assert len(out) == 1 and len(out[0][2]) == 2
+
+
+def test_iadd_on_ingress_marks_hot():
+    """`wire.ingress += [...]` must mark the wire hot (deque's C-level
+    __iadd__ would bypass a plain extend override)."""
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    daemon = Daemon(engine)
+    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+    t = Topology(name="ia", spec=TopologySpec(links=[
+        Link(local_intf="eth0", peer_intf="e", uid=4,
+             peer_pod="physical/10.0.0.9")]))
+    store.create(t)
+    engine.setup_pod("ia")
+    wire = daemon._add_wire(pb.WireDef(
+        local_pod_name="ia", kube_ns="default", link_uid=4,
+        intf_name_in_pod="eth0"))
+    wire.ingress += [b"a" * 60, b"b" * 60]
+    out = daemon.drain_ingress()
+    assert len(out) == 1 and len(out[0][2]) == 2
