@@ -361,7 +361,8 @@ def main(argv=None) -> int:
     mp.add_argument("--leader-elect", action="store_true",
                     help="enable leader election (reference "
                          "--leader-elect)")
-    mp.add_argument("--identity", default="manager-0")
+    mp.add_argument("--identity",
+                    default=os.environ.get("POD_NAME", "manager-0"))
     mp.add_argument("--node-ip", default=os.environ.get("HOST_IP",
                                                         "10.0.0.1"))
     mp.set_defaults(fn=cmd_manager)

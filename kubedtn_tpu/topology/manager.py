@@ -97,6 +97,176 @@ class LeaseStore:
             return lease.holder if lease else ""
 
 
+class KubeLeaseStore:
+    """LeaseStore over real coordination.k8s.io/v1 Lease objects — the
+    backend that makes leader election work ACROSS manager pods (the
+    in-process LeaseStore only arbitrates within one process). Uses the
+    apiserver's resourceVersion CAS exactly like client-go's
+    leaderelection resourcelock.
+
+    Time domain: the caller's `now` (the manager passes time.monotonic())
+    is IGNORED — per-process monotonic clocks are meaningless between
+    pods. Freshness is judged on the wall clock (`clock`, default
+    time.time; the same NTP assumption client-go's lease durations make),
+    and renewTime round-trips as an RFC3339 MicroTime so leases written
+    by client-go interoperate.
+
+    Duck-typed to the LeaseStore try_acquire/release/holder surface;
+    construction requires the `kubernetes` package (gated, like
+    topology.k8s.make_kube_api) or an injected api object with
+    read/create/replace_namespaced_lease methods."""
+
+    def __init__(self, namespace: str = "kubedtn-tpu", api=None,
+                 clock=None) -> None:
+        if api is None:
+            try:
+                import kubernetes  # type: ignore
+            except ImportError as e:
+                raise RuntimeError(
+                    "KubeLeaseStore needs the 'kubernetes' package (or an "
+                    "injected api); in-process managers can share a plain "
+                    "LeaseStore instead") from e
+            api = kubernetes.client.CoordinationV1Api()
+        self.api = api
+        self.namespace = namespace
+        self.clock = clock if clock is not None else time.time
+
+    # -- field normalization ------------------------------------------
+
+    @staticmethod
+    def _field(obj, camel: str, snake: str, default=None):
+        """One accessor for dict manifests (camelCase) and kubernetes
+        client models (snake_case attributes)."""
+        if obj is None:
+            return default
+        if isinstance(obj, dict):
+            v = obj.get(camel, default)
+        else:
+            v = getattr(obj, snake, default)
+        return default if v is None else v
+
+    @staticmethod
+    def _epoch(renew) -> float:
+        """renewTime → epoch seconds: accepts datetime (real client),
+        RFC3339 string (dict manifests), or a number (test fakes)."""
+        import datetime as dt
+
+        if renew is None or renew == "":
+            return 0.0
+        if isinstance(renew, (int, float)):
+            return float(renew)
+        if isinstance(renew, str):
+            renew = dt.datetime.fromisoformat(renew.replace("Z", "+00:00"))
+        if renew.tzinfo is None:
+            renew = renew.replace(tzinfo=dt.timezone.utc)
+        return renew.timestamp()
+
+    @staticmethod
+    def _rfc3339(epoch: float) -> str:
+        import datetime as dt
+
+        return dt.datetime.fromtimestamp(
+            epoch, dt.timezone.utc).isoformat().replace("+00:00", "Z")
+
+    @staticmethod
+    def _is_conflict_or_missing(e: Exception) -> tuple[bool, bool]:
+        status = getattr(e, "status", None)
+        return status == 409, status == 404
+
+    def _read(self, name: str):
+        lease = self.api.read_namespaced_lease(name, self.namespace)
+        spec = self._field(lease, "spec", "spec", {})
+        meta = self._field(lease, "metadata", "metadata", {})
+        return {
+            "holder": self._field(spec, "holderIdentity",
+                                  "holder_identity", "") or "",
+            "renew_epoch": self._epoch(self._field(spec, "renewTime",
+                                                   "renew_time", 0.0)),
+            "duration": float(self._field(spec, "leaseDurationSeconds",
+                                          "lease_duration_seconds", 0)
+                              or 0),
+            "transitions": int(self._field(spec, "leaseTransitions",
+                                           "lease_transitions", 0) or 0),
+            "rv": self._field(meta, "resourceVersion", "resource_version"),
+        }
+
+    def _body(self, name: str, identity: str, lease_duration_s: float,
+              transitions: int, rv=None) -> dict:
+        body = {
+            "metadata": {"name": name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": identity,
+                # apiserver validation requires a positive duration
+                "leaseDurationSeconds": max(1, int(lease_duration_s)),
+                "renewTime": self._rfc3339(self.clock()),
+                "leaseTransitions": transitions,
+            },
+        }
+        if rv is not None:
+            body["metadata"]["resourceVersion"] = rv
+        return body
+
+    def try_acquire(self, name: str, identity: str, now: float,
+                    lease_duration_s: float) -> bool:
+        del now  # cross-pod freshness uses self.clock, not caller time
+        try:
+            cur = self._read(name)
+        except Exception as e:
+            _, missing = self._is_conflict_or_missing(e)
+            if not missing:
+                raise
+            try:
+                self.api.create_namespaced_lease(
+                    self.namespace,
+                    self._body(name, identity, lease_duration_s, 0))
+                return True
+            except Exception as e2:
+                conflict, _ = self._is_conflict_or_missing(e2)
+                if conflict:
+                    return False  # racer created it first
+                raise
+        fresh = cur["holder"] and (
+            self.clock() - cur["renew_epoch"] <= (cur["duration"]
+                                                  or lease_duration_s))
+        if cur["holder"] != identity and fresh:
+            return False
+        transitions = cur["transitions"] + (
+            1 if cur["holder"] and cur["holder"] != identity else 0)
+        try:
+            self.api.replace_namespaced_lease(
+                name, self.namespace,
+                self._body(name, identity, lease_duration_s, transitions,
+                           rv=cur["rv"]))
+            return True
+        except Exception as e:
+            conflict, _ = self._is_conflict_or_missing(e)
+            if conflict:
+                return False  # lost the CAS to another candidate
+            raise
+
+    def release(self, name: str, identity: str) -> None:
+        try:
+            cur = self._read(name)
+        except Exception:
+            return
+        if cur["holder"] != identity:
+            return
+        # empty holder + ancient renewTime: validation-legal and instantly
+        # stale, so the next candidate takes over without waiting
+        body = self._body(name, "", 1, cur["transitions"], rv=cur["rv"])
+        body["spec"]["renewTime"] = self._rfc3339(0.0)
+        try:
+            self.api.replace_namespaced_lease(name, self.namespace, body)
+        except Exception:
+            pass  # a failed release just expires naturally
+
+    def holder(self, name: str) -> str:
+        try:
+            return self._read(name)["holder"]
+        except Exception:
+            return ""
+
+
 @dataclass
 class ManagerStatus:
     alive: bool = False

@@ -106,19 +106,13 @@ class WireManager:
             return
         if not isinstance(wire.ingress, _NotifyingDeque):
             # exotic embedder replaced the default _NotifyingDeque with a
-            # plain one: swap it out, then drain stragglers that raced in
-            # between the copy and the swap. A producer that cached the
-            # OLD deque object past registration is on its own — use the
-            # default factory or re-read wire.ingress after registering.
-            old = wire.ingress
+            # plain one: swap it out, preserving what's queued. Producers
+            # must not enqueue CONCURRENTLY with registration on a plain
+            # deque (no chase loop can close that race); use the default
+            # factory, or re-read wire.ingress after registering.
             nd = _NotifyingDeque()
-            nd.extend(old)
+            nd.extend(wire.ingress)
             wire.ingress = nd
-            while len(nd) != len(old):  # post-copy racers
-                try:
-                    nd.append(old[len(nd)])
-                except IndexError:  # pragma: no cover — shrank mid-check
-                    break
         wire.ingress._notify = lambda: self._on_ingress(wire)
         if wire.ingress:  # frames queued before registration
             self._on_ingress(wire)

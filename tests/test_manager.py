@@ -238,3 +238,159 @@ def test_manager_restart_recreates_probes():
             assert r.status == 200
     finally:
         mgr.stop()
+
+
+class _LeaseApiErr(Exception):
+    def __init__(self, status):
+        super().__init__(f"http {status}")
+        self.status = status
+
+
+class FakeLeaseApi:
+    """coordination.k8s.io double with resourceVersion CAS — the same
+    envtest-style surface the KubeLeaseStore adapter drives in a real
+    cluster."""
+
+    def __init__(self):
+        import copy as _c
+        self._c = _c
+        self.obj = None
+        self.rv = 0
+
+    def read_namespaced_lease(self, name, ns):
+        if self.obj is None:
+            raise _LeaseApiErr(404)
+        return self._c.deepcopy(self.obj)
+
+    def create_namespaced_lease(self, ns, body):
+        if self.obj is not None:
+            raise _LeaseApiErr(409)
+        self.rv += 1
+        body = self._c.deepcopy(body)
+        body["metadata"]["resourceVersion"] = str(self.rv)
+        self.obj = body
+
+    def replace_namespaced_lease(self, name, ns, body):
+        if self.obj is None:
+            raise _LeaseApiErr(404)
+        if body["metadata"].get("resourceVersion") != \
+                self.obj["metadata"]["resourceVersion"]:
+            raise _LeaseApiErr(409)
+        self.rv += 1
+        body = self._c.deepcopy(body)
+        body["metadata"]["resourceVersion"] = str(self.rv)
+        self.obj = body
+
+
+class _WallClock:
+    """Injectable wall clock: the adapter judges freshness on ITS clock
+    (cross-pod), ignoring the caller's process-local monotonic time."""
+
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestKubeLeaseStore:
+    def mk(self):
+        from kubedtn_tpu.topology.manager import KubeLeaseStore
+
+        api = FakeLeaseApi()
+        clock = _WallClock()
+        return (KubeLeaseStore(namespace="ns", api=api, clock=clock),
+                KubeLeaseStore(namespace="ns", api=api, clock=clock),
+                api, clock)
+
+    def test_acquire_renew_deny(self):
+        a, b, api, clock = self.mk()
+        assert a.try_acquire("lock", "a", now=0, lease_duration_s=5.0)
+        assert a.holder("lock") == "a"
+        clock.t += 1
+        assert not b.try_acquire("lock", "b", now=0, lease_duration_s=5.0)
+        clock.t += 2
+        assert a.try_acquire("lock", "a", now=0, lease_duration_s=5.0)
+        clock.t += 6  # stale: last renew 6s ago, duration 5
+        assert b.try_acquire("lock", "b", now=0, lease_duration_s=5.0)
+        assert b.holder("lock") == "b"
+        assert api.obj["spec"]["leaseTransitions"] == 1
+        # renewal by the SAME holder preserves the transition count
+        clock.t += 1
+        assert b.try_acquire("lock", "b", now=0, lease_duration_s=5.0)
+        assert api.obj["spec"]["leaseTransitions"] == 1
+        # renewTime is a real RFC3339 MicroTime, not a raw float
+        assert api.obj["spec"]["renewTime"].endswith("Z")
+
+    def test_cas_race_loses_cleanly(self):
+        a, b, api, clock = self.mk()
+        assert a.try_acquire("lock", "a", 0, 5.0)
+        clock.t += 10  # stale, so b will try to take over
+        real_read = api.read_namespaced_lease
+        state = {}
+
+        def racing_read(name, ns):
+            lease = real_read(name, ns)
+            if "raced" not in state:
+                state["raced"] = True
+                a.try_acquire("lock", "a", 0, 5.0)  # rv bump mid-read
+            return lease
+
+        api.read_namespaced_lease = racing_read
+        assert not b.try_acquire("lock", "b", 0, 5.0)
+
+    def test_release_allows_immediate_takeover(self):
+        a, b, api, clock = self.mk()
+        assert a.try_acquire("lock", "a", 0, 30.0)
+        a.release("lock", "a")
+        # released lease is validation-legal (positive duration) and stale
+        assert api.obj["spec"]["leaseDurationSeconds"] >= 1
+        clock.t += 0.1
+        assert b.try_acquire("lock", "b", 0, 30.0)
+
+    def test_interoperates_with_client_go_written_lease(self):
+        """A lease written by client-go arrives with datetime renewTime
+        (MicroTime) and snake_case-modeled objects; the adapter must read
+        it without blowing up."""
+        import datetime as dt
+
+        from kubedtn_tpu.topology.manager import KubeLeaseStore
+
+        api = FakeLeaseApi()
+        clock = _WallClock()
+        s = KubeLeaseStore(namespace="ns", api=api, clock=clock)
+        api.obj = {"metadata": {"name": "lock", "resourceVersion": "5"},
+                   "spec": {"holderIdentity": "other",
+                            "leaseDurationSeconds": 15,
+                            "renewTime": dt.datetime.fromtimestamp(
+                                clock.t - 2, dt.timezone.utc),
+                            "leaseTransitions": 3}}
+        assert s.holder("lock") == "other"
+        assert not s.try_acquire("lock", "me", 0, 15.0)  # fresh
+        clock.t += 20
+        assert s.try_acquire("lock", "me", 0, 15.0)      # expired
+        assert api.obj["spec"]["leaseTransitions"] == 4
+
+    def test_managers_failover_over_kube_lease(self):
+        """End to end: two managers arbitrate through the Lease CAS."""
+        from kubedtn_tpu.topology.manager import KubeLeaseStore
+
+        api = FakeLeaseApi()
+        store, engine = mk_cluster()
+        kw = dict(leader_election=True, lease_duration_s=0.5,
+                  renew_interval_s=0.05)
+        a = ControllerManager(store, engine, identity="a",
+                              lease_store=KubeLeaseStore("ns", api), **kw)
+        b = ControllerManager(store, engine, identity="b",
+                              lease_store=KubeLeaseStore("ns", api), **kw)
+        a.start()
+        assert wait_for(lambda: a.status.is_leader)
+        b.start()
+        try:
+            assert wait_for(lambda: engine.num_active == 3)
+            assert not b.status.is_leader
+            a.stop()
+            assert wait_for(lambda: b.status.is_leader, timeout=5)
+        finally:
+            a.stop()
+            b.stop()

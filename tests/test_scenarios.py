@@ -1,0 +1,38 @@
+"""BASELINE-ladder scenarios at CI scale: the same code paths bench.py
+and the CLI drive on hardware, shrunk so the suite exercises them on the
+virtual CPU mesh every run."""
+
+from kubedtn_tpu import scenarios as S
+
+
+def test_three_node_reference_sample():
+    r = S.three_node()
+    assert r["links"] == 3
+    assert r["reachable"] is True
+    # latency-free sample: RTTs are finite and tiny
+    assert all(v >= 0 for v in r["pings"].values())
+
+
+def test_reconcile_scenario_small_scale():
+    """reconcile_100k's full pipeline (store → reconciler → engine →
+    device → gRPC round trip) at 40 links."""
+    r = S.reconcile_100k(n_spine=4, n_leaf=10, links_per_pair=1,
+                         grpc_batch=10)
+    assert r["links"] == 40
+    assert r["directed_rows"] == 80
+    assert r["grpc_ok"] is True
+    assert r["spot_check_latency_us"] == 20_000.0
+    assert r["meets_target"] is True  # trivially, at this scale
+    assert r["device_calls"] <= 6     # coalescing holds at small scale too
+
+
+def test_churn_scenario_small_scale():
+    r = S.churn_1k(n_nodes=50, n_links=120, seconds=2.0)
+    assert r["churn_links_total"] == 24
+    assert r["updates_per_sec"] > 0
+
+
+def test_routes_scenario_small_scale():
+    r = S.routes_10k(n_nodes=200, n_links=600, events=2, dst_chunk=50)
+    assert 0 < r["reachable_frac"] <= 1.0
+    assert r["recompute_s_first"] > 0
