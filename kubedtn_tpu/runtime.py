@@ -293,12 +293,12 @@ class WireDataPlane:
                         frames[(row, j)] = f
 
                 self._key, sub = jax.random.split(self._key)
+                t_arrival = jnp.zeros((E,), jnp.float32)  # shared per tick
                 res_cols = []
                 for j in range(k):
                     state, res = netem.shape_step_nodonate(
                         state, jnp.asarray(sizes[:, j]),
-                        jnp.asarray(valid[:, j]),
-                        jnp.zeros((E,), jnp.float32),
+                        jnp.asarray(valid[:, j]), t_arrival,
                         jax.random.fold_in(sub, j))
                     res_cols.append(jax.tree.map(np.asarray, res))
 

@@ -41,6 +41,7 @@ import numpy as np
 from kubedtn_tpu.api.types import LOCALHOST, Link, Topology
 from kubedtn_tpu.ops import edge_state as es
 from kubedtn_tpu.utils.logging import fields as _fields
+from kubedtn_tpu.utils.logging import get_logger
 from kubedtn_tpu.topology.store import (
     NotFoundError,
     TopologyStore,
@@ -146,8 +147,6 @@ class SimEngine:
         # per-action structured logs, the role of the reference's
         # WithField("daemon"/"action") context loggers
         # (reference common/context.go:11-29)
-        from kubedtn_tpu.utils.logging import get_logger
-
         self.log = get_logger("engine")
         # host-side registries (the daemon's managers):
         self._pod_ids: dict[str, int] = {}   # endpoint name -> node index

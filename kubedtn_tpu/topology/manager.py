@@ -418,13 +418,17 @@ class ControllerManager:
 
     def _run(self) -> None:
         self.status.alive = True
-        last_renew = 0.0
+        last_acquire = 0.0
         try:
             while not self._stop.is_set():
                 now = time.monotonic()
-                if now - last_renew >= self.renew_interval_s:
+                # acquisition only — once leading, the dedicated renew
+                # thread keeps the lease alive (doubling renewals here
+                # would just double apiserver traffic)
+                if not self.status.is_leader and \
+                        now - last_acquire >= self.renew_interval_s:
                     self._try_leadership()
-                    last_renew = now
+                    last_acquire = now
                 if not self.status.is_leader and self.leader_election:
                     # standby: stay synced-false until first leadership
                     self._stop.wait(self.renew_interval_s)

@@ -117,11 +117,6 @@ class WireManager:
         if wire.ingress:  # frames queued before registration
             self._on_ingress(wire)
 
-    def next_wire_id(self) -> int:
-        with self._lock:
-            self._next_wire_id += 1
-            return self._next_wire_id
-
     def gen_node_iface_name(self, pod_name: str, pod_intf: str) -> str:
         """Unique per-node interface name, reference format
         "%.5s%.5s-%04d" (grpcwire.go:270-288)."""
