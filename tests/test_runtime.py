@@ -376,3 +376,45 @@ def test_unrealized_hot_wire_does_not_busy_spin():
         assert len(w.ingress) == 1  # frame still waiting, not lost
     finally:
         dp.stop()
+
+
+def test_parse_tcp_flow_never_crashes_on_garbage():
+    """The bypass parser faces arbitrary wire bytes: any input must parse
+    to a tuple or None, never raise."""
+    import random
+
+    from kubedtn_tpu.runtime import parse_tcp_flow
+
+    rng = random.Random(42)
+    for n in (0, 1, 13, 14, 17, 18, 33, 34, 53, 54, 60, 200):
+        for _ in range(50):
+            frame = bytes(rng.randrange(256) for _ in range(n))
+            out = parse_tcp_flow(frame)
+            assert out is None or (len(out) == 4
+                                   and all(isinstance(x, int) for x in out))
+
+
+def test_parse_tcp_flow_variants():
+    from kubedtn_tpu.runtime import parse_tcp_flow
+
+    base = tcp_frame()
+    assert parse_tcp_flow(base) == (0x0A000001, 4321, 0x0A000002, 80)
+
+    # 802.1Q VLAN tag shifts the IP header by 4
+    vlan = base[:12] + b"\x81\x00\x00\x2a\x08\x00" + base[14:]
+    assert parse_tcp_flow(vlan) == (0x0A000001, 4321, 0x0A000002, 80)
+
+    # fragmented packets (MF or offset) never parse
+    frag_mf = bytearray(base)
+    frag_mf[14 + 6] = 0x20  # MF flag
+    assert parse_tcp_flow(bytes(frag_mf)) is None
+    frag_off = bytearray(base)
+    frag_off[14 + 7] = 0x10  # offset 16
+    assert parse_tcp_flow(bytes(frag_off)) is None
+
+    # UDP (proto 17) and IPv6 never parse
+    udp = bytearray(base)
+    udp[14 + 9] = 17
+    assert parse_tcp_flow(bytes(udp)) is None
+    v6 = base[:12] + b"\x86\xdd" + base[14:]
+    assert parse_tcp_flow(v6) is None
