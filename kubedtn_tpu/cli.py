@@ -172,14 +172,17 @@ def cmd_manager(args) -> int:
     mgr = ControllerManager(store, engine, identity=args.identity,
                             workers=args.workers,
                             leader_election=args.leader_elect,
-                            probe_port=args.probe_port)
+                            probe_port=args.probe_port,
+                            metrics_port=args.metrics_port)
     mgr.start()
     log.info("manager up %s", fields(identity=args.identity,
                                      workers=args.workers,
                                      probe_port=mgr.probe_port,
+                                     metrics_port=mgr.metrics_port,
                                      leader_election=args.leader_elect))
     print(f"kubedtn-tpu manager: probes on :{mgr.probe_port} "
-          f"(healthz/readyz)", flush=True)
+          f"(healthz/readyz), metrics on :{mgr.metrics_port}/metrics",
+          flush=True)
     try:
         while True:
             time.sleep(3600)
@@ -358,6 +361,9 @@ def main(argv=None) -> int:
                     help="concurrent reconcile workers (reference: 32)")
     mp.add_argument("--probe-port", type=int, default=8081,
                     help="healthz/readyz port (reference probe-addr :8081)")
+    mp.add_argument("--metrics-port", type=int, default=8080,
+                    help="controller metrics port (reference "
+                         "metrics-bind-address :8080)")
     mp.add_argument("--leader-elect", action="store_true",
                     help="enable leader election (reference "
                          "--leader-elect)")

@@ -141,6 +141,7 @@ class MetricsServer:
 
     def stop(self) -> None:
         self._srv.shutdown()
+        self._srv.server_close()  # release the listening socket
 
 
 def make_registry(engine=None, sim_counters_fn=None):

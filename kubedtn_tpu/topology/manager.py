@@ -277,6 +277,47 @@ class ManagerStatus:
     checks: dict = field(default_factory=dict)
 
 
+class ManagerCollector:
+    """Prometheus collector for the controller side — the role of
+    controller-runtime's metrics endpoint (reference main.go:82
+    MetricsBindAddress): reconcile totals/errors, leadership gauge,
+    sync state. Register with a prometheus_client CollectorRegistry
+    (metrics.make_registry-style) and serve via metrics.MetricsServer."""
+
+    def __init__(self, manager: "ControllerManager") -> None:
+        self._mgr = manager
+
+    def collect(self):
+        from prometheus_client.core import (CounterMetricFamily,
+                                            GaugeMetricFamily)
+
+        st = self._mgr.status
+        labels = ["identity"]
+        values = [self._mgr.identity]
+
+        c = CounterMetricFamily(
+            "controller_runtime_reconcile_total",
+            "Total number of reconciliations", labels=labels)
+        c.add_metric(values, float(st.reconciles))
+        yield c
+        e = CounterMetricFamily(
+            "controller_runtime_reconcile_errors_total",
+            "Total number of reconciliation errors", labels=labels)
+        e.add_metric(values, float(st.errors))
+        yield e
+        g = GaugeMetricFamily(
+            "leader_election_master_status",
+            "1 when this instance holds the leader lease",
+            labels=labels)
+        g.add_metric(values, 1.0 if st.is_leader else 0.0)
+        yield g
+        s = GaugeMetricFamily(
+            "controller_synced", "1 once the initial resync completed",
+            labels=labels)
+        s.add_metric(values, 1.0 if st.synced else 0.0)
+        yield s
+
+
 class _ProbeHandler(BaseHTTPRequestHandler):
     manager: "ControllerManager" = None  # set per server
 
@@ -313,6 +354,7 @@ class ControllerManager:
                  renew_interval_s: float = 0.5,
                  probe_port: int | None = None,
                  probe_host: str = "0.0.0.0",
+                 metrics_port: int | None = None,
                  poll_interval_s: float = 0.02) -> None:
         self.store = store
         self.engine = engine
@@ -332,10 +374,41 @@ class ControllerManager:
         self._probe_host = probe_host
         self._probe_port_req = probe_port
         self.probe_port: int | None = None
+        self.metrics = None
+        self._metrics_port_req = metrics_port
+        self.metrics_port: int | None = None
+        if metrics_port is not None:
+            self._start_metrics()
         if probe_port is not None:
             # probes answer from construction (503 until started), like a
             # pod whose kubelet probes begin before the process is ready
             self._start_probes()
+
+    def _start_metrics(self) -> None:
+        """Controller metrics endpoint (reference MetricsBindAddress
+        :8080, main.go:82). Same never-raise port policy as the probes:
+        preferred → requested → any free port."""
+        if self.metrics is not None or self._metrics_port_req is None:
+            return
+        from prometheus_client import CollectorRegistry
+
+        from kubedtn_tpu.metrics.metrics import MetricsServer
+
+        registry = CollectorRegistry()
+        registry.register(ManagerCollector(self))
+        preferred = self.metrics_port if self.metrics_port is not None \
+            else self._metrics_port_req
+        for port in dict.fromkeys((preferred, self._metrics_port_req, 0)):
+            try:
+                self.metrics = MetricsServer(registry, port=port)
+                break
+            except OSError:
+                self.log.warning("metrics port %s unavailable; trying next",
+                                 port)
+        else:  # pragma: no cover — port 0 cannot fail to bind
+            return
+        self.metrics.start()
+        self.metrics_port = self.metrics.port
 
     def _start_probes(self) -> None:
         if self._http is not None or self._probe_port_req is None:
@@ -458,7 +531,8 @@ class ControllerManager:
     def start(self) -> None:
         if self._thread is not None:
             return
-        self._start_probes()  # recreate after a stop()
+        self._start_probes()   # recreate after a stop()
+        self._start_metrics()
         self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"manager-{self.identity}")
@@ -476,3 +550,6 @@ class ControllerManager:
             self._http.shutdown()
             self._http.server_close()  # release the listening socket
             self._http = None
+        if self.metrics is not None:
+            self.metrics.stop()
+            self.metrics = None

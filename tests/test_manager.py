@@ -394,3 +394,50 @@ class TestKubeLeaseStore:
         finally:
             a.stop()
             b.stop()
+
+
+def test_manager_metrics_endpoint():
+    """Controller metrics parity (reference MetricsBindAddress :8080):
+    reconcile totals, error counter, leadership gauge, sync gauge."""
+    store, engine = mk_cluster()
+    leases = LeaseStore()
+    mgr = ControllerManager(store, engine, identity="m0", metrics_port=0,
+                            leader_election=True, lease_store=leases,
+                            lease_duration_s=5.0, renew_interval_s=0.05)
+
+    def scrape():
+        url = f"http://127.0.0.1:{mgr.metrics_port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.read().decode()
+
+    body = scrape()
+    assert 'leader_election_master_status{identity="m0"} 0.0' in body
+    assert 'controller_synced{identity="m0"} 0.0' in body
+    mgr.start()
+    try:
+        assert wait_for(lambda: mgr.status.synced)
+        body = scrape()
+        assert 'leader_election_master_status{identity="m0"} 1.0' in body
+        assert 'controller_synced{identity="m0"} 1.0' in body
+        assert "controller_runtime_reconcile_total" in body
+        assert "controller_runtime_reconcile_errors_total" in body
+    finally:
+        mgr.stop()
+
+
+def test_manager_restart_recreates_metrics():
+    """Like the probes, the metrics endpoint survives stop()/start() and
+    its socket is fully released on stop."""
+    store, engine = mk_cluster(0)
+    mgr = ControllerManager(store, engine, metrics_port=0)
+    port = mgr.metrics_port
+    mgr.start()
+    mgr.stop()
+    mgr.start()
+    try:
+        assert mgr.metrics_port == port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            assert r.status == 200
+    finally:
+        mgr.stop()
