@@ -418,3 +418,36 @@ def test_parse_tcp_flow_variants():
     assert parse_tcp_flow(bytes(udp)) is None
     v6 = base[:12] + b"\x86\xdd" + base[14:]
     assert parse_tcp_flow(v6) is None
+
+
+def test_lossy_link_drops_frames_statistically():
+    """Daemon-level impairment e2e: a 50%-loss link drops roughly half
+    the wire frames (fixed seed — deterministic), and the loss shows in
+    both the plane's counter and the per-edge counters."""
+    from kubedtn_tpu.api.types import (Link, LinkProperties, Topology,
+                                       TopologySpec)
+    from kubedtn_tpu.topology import TopologyStore
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=64)
+    t = Topology(name="lossy", spec=TopologySpec(links=[
+        Link(local_intf="eth0", peer_intf="e", uid=1,
+             peer_pod="physical/10.0.0.9",
+             properties=LinkProperties(loss="50"))]))
+    store.create(t)
+    engine.setup_pod("lossy")
+    daemon = Daemon(engine)
+    w = add_wire(daemon, "lossy", 1)
+    dp = WireDataPlane(daemon, seed=5)
+
+    n = 200
+    for i in range(n):
+        w.ingress.append(b"\x02" * 64)
+        dp.tick(now_s=1.0 + i * 0.001)
+    dp.tick(now_s=5.0)
+    delivered = dp.shaped
+    dropped = dp.dropped
+    assert delivered + dropped == n
+    assert 60 <= dropped <= 140, f"loss=50% dropped {dropped}/{n}"
+    loss_count = float(np.asarray(dp.counters.dropped_loss).sum())
+    assert loss_count == dropped
