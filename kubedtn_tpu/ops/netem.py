@@ -369,9 +369,26 @@ def shape_step_nodonate(state: EdgeState, sizes: jax.Array,
 @partial(jax.jit, donate_argnums=0, static_argnums=2)
 def roll_epoch(state: EdgeState, dt_us: jax.Array, floor_us: float = -1e7):
     """Shift step-relative clocks back by `dt_us` at the end of a step so
-    times stay small and f32-exact over unbounded simulated time."""
+    times stay small and f32-exact over unbounded simulated time.
+
+    DONATES `state`; concurrent holders of the same buffers must use
+    roll_epoch_nodonate."""
     return dataclasses.replace(
         state,
         t_last=jnp.maximum(state.t_last - dt_us, floor_us),
         backlog_until=jnp.maximum(state.backlog_until - dt_us, floor_us),
     )
+
+
+_roll_epoch_nd = None
+
+
+def roll_epoch_nodonate(state: EdgeState, dt_us: jax.Array,
+                        floor_us: float = -1e7):
+    """roll_epoch without donation — the input buffers stay valid (for
+    callers whose state is still aliased elsewhere, e.g. the data plane's
+    lock-free snapshot of engine._state)."""
+    global _roll_epoch_nd
+    if _roll_epoch_nd is None:
+        _roll_epoch_nd = jax.jit(roll_epoch.__wrapped__, static_argnums=2)
+    return _roll_epoch_nd(state, dt_us, floor_us)

@@ -53,10 +53,10 @@ from kubedtn_tpu import native
 from kubedtn_tpu.ops import netem
 from kubedtn_tpu.ops.queues import EdgeCounters, init_counters
 
-# The tick shapes with netem.shape_step_nodonate: the stock kernels
-# donate their EdgeState argument, which would invalidate the very
-# buffers engine._state still holds while shaping runs outside the
-# engine lock.
+# The tick shapes with netem.shape_step_nodonate / rolls with
+# netem.roll_epoch_nodonate: the stock kernels donate their EdgeState
+# argument, which would invalidate the very buffers engine._state still
+# holds while shaping runs outside the engine lock.
 
 _ETH_IPV4 = 0x0800
 _PROTO_TCP = 6
@@ -150,6 +150,12 @@ class WireDataPlane:
         # wall clock or a synthetic test clock); token → payload map held
         # Python-side, the wheel orders and releases
         self._origin_s: float | None = None
+        # wall time of the last tick that SHAPED: the elapsed gap rolls
+        # the persistent netem/TBF clocks (t_last, backlog_until) back
+        # before the next batch, so token buckets refill with real time —
+        # without it every frame arrives "at t=0" while t_last marches
+        # forward, and a rate-limited wire double-counts elapsed time
+        self._last_shaped_s: float | None = None
         self._pending: dict[int, tuple[str, int, bytes]] = {}
         try:
             self._wheel: native.TimingWheel | None = native.TimingWheel(
@@ -282,6 +288,19 @@ class WireDataPlane:
                     kept.append((row, k_lens, k_frames))
 
             if kept:
+                # advance the persistent shaping clocks by the wall time
+                # since the last shaped batch (the role sim.py's per-step
+                # roll_epoch plays in virtual-time mode)
+                if self._last_shaped_s is not None:
+                    elapsed_us = max(0.0,
+                                     (now_s - self._last_shaped_s) * 1e6)
+                    if elapsed_us > 0.0:
+                        state = netem.roll_epoch_nodonate(
+                            state, jnp.float32(elapsed_us))
+                # NOTE: committed only after a successful write-back — a
+                # skipped write-back (engine grew mid-shaping) must not
+                # swallow this interval's token refill
+                shaped_at = now_s
                 k = max(len(b[1]) for b in kept)
                 sizes = np.zeros((E, k), np.float32)
                 valid = np.zeros((E, k), bool)
@@ -306,6 +325,7 @@ class WireDataPlane:
                 with engine._lock:
                     cur = engine._state
                     if cur.capacity == state.capacity:
+                        self._last_shaped_s = shaped_at
                         touched = engine._rows_touched
                         if touched:
                             # rows applied/updated/deleted mid-shaping:
