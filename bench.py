@@ -56,8 +56,8 @@ LINKS_PER_PAIR = 2  # 100 * 500 * 2 = 100_000 links
 ITERS = 100
 SHAPE_ITERS = 100
 
-PROBE_ATTEMPTS = 3
-PROBE_TIMEOUT_S = 240
+PROBE_ATTEMPTS = 2
+PROBE_TIMEOUT_S = 150
 PHASE_ATTEMPTS = 2
 
 
@@ -285,13 +285,20 @@ def bench_wire_streaming(extras: dict) -> None:
 
 
 def main() -> None:
+    global ITERS, SHAPE_ITERS
     t_bench = time.perf_counter()
     extras: dict = {}
 
-    if not probe_backend():
+    degraded = not probe_backend()
+    if degraded:
         extras["backend_probe"] = "failed; forcing CPU fallback"
         os.environ["JAX_PLATFORMS"] = "cpu"
         extras["degraded"] = True
+        # a degraded (CPU) run exists to keep the record parseable, not
+        # to produce meaningful throughput — shrink the iteration counts
+        # so the fallback finishes in minutes
+        ITERS = 4
+        SHAPE_ITERS = 4
 
     try:
         import jax
@@ -302,6 +309,17 @@ def main() -> None:
             "extras": extras,
         }))
         sys.exit(1)
+
+    if degraded:
+        # the axon TPU-tunnel platform IGNORES JAX_PLATFORMS; only the
+        # explicit config update actually pins the CPU backend (and keeps
+        # this process from hanging on the dead tunnel). Non-fatal like
+        # the cache config below: the env var is already set as a second
+        # line of defense.
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception as e:
+            log(f"jax_platforms config unavailable: {e!r}")
 
     # persistent compilation cache: repeat driver runs skip the big
     # scatter/kernel compiles entirely

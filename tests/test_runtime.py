@@ -426,7 +426,6 @@ def test_lossy_link_drops_frames_statistically():
     both the plane's counter and the per-edge counters."""
     from kubedtn_tpu.api.types import (Link, LinkProperties, Topology,
                                        TopologySpec)
-    from kubedtn_tpu.topology import TopologyStore
 
     store = TopologyStore()
     engine = SimEngine(store, capacity=64)
@@ -459,7 +458,6 @@ def test_rate_capped_link_paces_frames_e2e():
     matches the configured TBF rate once the initial token burst drains."""
     from kubedtn_tpu.api.types import (Link, LinkProperties, Topology,
                                        TopologySpec)
-    from kubedtn_tpu.topology import TopologyStore
 
     store = TopologyStore()
     engine = SimEngine(store, capacity=64)
@@ -494,12 +492,10 @@ def test_rate_capped_link_paces_frames_e2e():
         tick_i += 1
         now += 0.002
     assert len(arrivals) == n, f"only {len(arrivals)}/{n} delivered"
-    import numpy as _np
-
     # burst = max(rate/250, 5000B) = 5000B -> first ~3 frames ride the
     # initial tokens; steady state is service-paced at 12ms
-    spacing = _np.diff(arrivals[5:])
+    spacing = np.diff(arrivals[5:])
     expect = 1500 * 8 / rate_bps
-    med = float(_np.median(spacing))
+    med = float(np.median(spacing))
     assert abs(med - expect) < 0.0015, \
         f"median spacing {med:.4f}s != ~{expect}s (shaper not pacing)"
