@@ -124,14 +124,17 @@ def props_row(numeric: dict) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=65536)
-def props_row_cached(props) -> np.ndarray:
-    """props_row keyed by a (frozen, hashable) LinkProperties value —
-    the engine's hot path packs the same few property sets for thousands
-    of links. The returned row is shared and marked read-only; batch
-    builders copy it when stacking."""
+def props_row_and_shaped(props) -> tuple[np.ndarray, bool]:
+    """(props_row, shapes-traffic?) keyed by a (frozen, hashable)
+    LinkProperties value — the engine's hot path packs the same few
+    property sets for thousands of links, and asks "does this row shape
+    at all" once per row; both answers are memoized together so neither
+    the pack nor the `.any()` reduction is paid per link. The returned
+    row is shared and marked read-only; batch builders copy it when
+    stacking."""
     row = props_row(props.to_numeric())
     row.flags.writeable = False
-    return row
+    return row, bool(row.any())
 
 
 def burst_bytes(rate_bps: jax.Array) -> jax.Array:

@@ -157,6 +157,9 @@ class SimEngine:
         self._peer: dict[tuple[str, int], tuple[str, int]] = {}
         self._free: list[int] = list(range(capacity - 1, -1, -1))
         self._topology_manager: set[str] = set()  # alive pods (metrics/TopologyManager)
+        # placement answers cached per store placement generation
+        self._placement_cache: dict[str, tuple[str, str]] = {}
+        self._placement_gen: int = -1
         # cross-node peer-daemon dialing (reference common/utils.go:53-62,
         # "passthrough:///<nodeIP>:51111"): src_ip -> client with .Update.
         # Injectable for tests / non-default ports; cached per address.
@@ -177,13 +180,20 @@ class SimEngine:
 
     # -- registries ----------------------------------------------------
 
+    def _pod_id(self, endpoint: str) -> int:
+        """pod_id for callers already holding the engine lock — the
+        per-link hot path, where re-entering the RLock per endpoint
+        measurably slows a 100k-link drain."""
+        pid = self._pod_ids.get(endpoint)
+        if pid is None:
+            pid = self._pod_ids[endpoint] = len(self._pod_ids)
+        return pid
+
     @_locked
     def pod_id(self, endpoint: str) -> int:
         """Stable integer id for any endpoint name (pod key, "localhost",
         "physical/<ip>")."""
-        if endpoint not in self._pod_ids:
-            self._pod_ids[endpoint] = len(self._pod_ids)
-        return self._pod_ids[endpoint]
+        return self._pod_id(endpoint)
 
     def row_of(self, pod_key: str, uid: int) -> int | None:
         return self._rows.get((pod_key, uid))
@@ -221,20 +231,19 @@ class SimEngine:
     # are the source of truth for control flow; the device arrays carry
     # the shaping data plane.
 
-    def _note_shaped(self, row: int, props: np.ndarray) -> None:
-        if props.any():
-            self._shaped_rows.add(row)
-        else:
-            self._shaped_rows.discard(row)
-
     def _enqueue_apply(self, entries) -> None:
-        """entries: (row, uid, src, dst, props_row)."""
-        for row, uid, src, dst, props in entries:
-            self._pending_delete.discard(row)
-            self._pending_update.pop(row, None)
-            self._pending_apply[row] = (uid, src, dst, props)
-            self._note_shaped(row, props)
-            self._rows_touched.add(row)
+        """entries: (row, uid, src, dst, props_row, shaped)."""
+        pa = self._pending_apply
+        pu_pop = self._pending_update.pop
+        pd_discard = self._pending_delete.discard
+        s_add, s_discard = self._shaped_rows.add, self._shaped_rows.discard
+        touched = self._rows_touched.add
+        for row, uid, src, dst, props, shaped in entries:
+            pd_discard(row)
+            pu_pop(row, None)
+            pa[row] = (uid, src, dst, props)
+            (s_add if shaped else s_discard)(row)
+            touched(row)
 
     def _enqueue_delete(self, rows_list: list[int]) -> None:
         for row in rows_list:
@@ -245,16 +254,20 @@ class SimEngine:
             self._rows_touched.add(row)
 
     def _enqueue_update(self, entries) -> None:
-        """entries: (row, props_row). A row with a pending apply merges
-        into it (apply fully overwrites the row anyway)."""
-        for row, props in entries:
-            pending = self._pending_apply.get(row)
+        """entries: (row, props_row, shaped). A row with a pending apply
+        merges into it (apply fully overwrites the row anyway)."""
+        pa, pa_get = self._pending_apply, self._pending_apply.get
+        pu = self._pending_update
+        s_add, s_discard = self._shaped_rows.add, self._shaped_rows.discard
+        touched = self._rows_touched.add
+        for row, props, shaped in entries:
+            pending = pa_get(row)
             if pending is not None:
-                self._pending_apply[row] = (*pending[:3], props)
+                pa[row] = (*pending[:3], props)
             else:
-                self._pending_update[row] = props
-            self._note_shaped(row, props)
-            self._rows_touched.add(row)
+                pu[row] = props
+            (s_add if shaped else s_discard)(row)
+            touched(row)
 
     def is_shaped(self, row: int) -> bool:
         """True when the row's current properties shape traffic (any
@@ -457,12 +470,42 @@ class SimEngine:
         self.del_links(topo, links)
         return True
 
+    def _refresh_placement_cache(self) -> None:
+        """Drop cached placements if any placement may have moved. Checked
+        once per engine operation, not per link — the store lock behind
+        placement_generation is itself measurable at 100k links."""
+        gen = self.store.placement_generation
+        if gen != self._placement_gen:
+            self._placement_cache.clear()
+            self._placement_gen = gen
+
+    def _placement_cached(self, pod_key: str) -> tuple[str, str]:
+        """(src_ip, net_ns) via the generation-validated cache; the caller
+        must have called _refresh_placement_cache() this operation."""
+        hit = self._placement_cache.get(pod_key)
+        if hit is None:
+            ns, _, name = pod_key.partition("/")
+            try:
+                hit = self.store.peek_placement(ns, name)
+            except NotFoundError:
+                hit = ("", "")
+            self._placement_cache[pod_key] = hit
+        return hit
+
+    def _placement(self, pod_key: str) -> tuple[str, str]:
+        """(src_ip, net_ns) for a pod key, cached against the store's
+        placement generation — a 100k-link drain asks hundreds of times
+        per topology and placement only moves on CNI events, so the cache
+        typically survives the whole drain (status copy-backs don't bump
+        the generation)."""
+        self._refresh_placement_cache()
+        return self._placement_cached(pod_key)
+
+    @_locked
     def is_alive(self, pod_key: str) -> bool:
-        ns, _, name = pod_key.partition("/")
-        try:
-            src_ip, net_ns = self.store.peek_placement(ns, name)
-        except NotFoundError:
-            return False
+        # _locked: the placement cache is engine state — every mutator of
+        # it must hold the engine lock like the other registries do.
+        src_ip, net_ns = self._placement(pod_key)
         return bool(src_ip) and bool(net_ns)
 
     def add_links(self, topo: Topology, links: list[Link]) -> bool:
@@ -494,19 +537,22 @@ class SimEngine:
         t0 = time.perf_counter()
         local_key = topo.key
         self._ensure_capacity(2 * len(links))
-        entries: list[tuple[int, int, int, int, np.ndarray]] = []
+        entries: list[tuple[int, int, int, int, np.ndarray, bool]] = []
         remote_calls: list[tuple[str, object]] = []
-        alive_cache: dict[str, bool] = {}
-        src_ip_cache: dict[str, str] = {}
+        # peer-pod name → "<ns>/<name>" key, built once per peer per call
+        # (the f-string per link was itself visible at 100k-link scale)
+        peer_keys: dict[str, str] = {}
+        ns_prefix = topo.namespace + "/"
+        local_pid = self._pod_id(local_key)
+        self._refresh_placement_cache()
         for link in links:
             if link.is_macvlan():
                 # macvlan uplink: realized immediately, NO shaping applied
                 # (reference handler.go:335-345 never touches qdiscs here).
                 row = self._alloc(local_key, link.uid)
                 entries.append((
-                    row, link.uid, self.pod_id(local_key),
-                    self.pod_id(LOCALHOST),
-                    np.zeros((es.NPROP,), np.float32),
+                    row, link.uid, local_pid, self._pod_id(LOCALHOST),
+                    np.zeros((es.NPROP,), np.float32), False,
                 ))
                 continue
             if link.is_physical():
@@ -514,22 +560,19 @@ class SimEngine:
                 # locally (handler.go:348-369); the physical host is always
                 # "alive".
                 row = self._alloc(local_key, link.uid)
-                props = es.props_row_cached(link.properties)
-                entries.append((row, link.uid, self.pod_id(local_key),
-                                self.pod_id(link.peer_pod), props))
+                props, shaped = es.props_row_and_shaped(link.properties)
+                entries.append((row, link.uid, local_pid,
+                                self._pod_id(link.peer_pod), props, shaped))
                 continue
 
-            peer_key = f"{topo.namespace}/{link.peer_pod}"
-            if peer_key not in alive_cache:
-                alive_cache[peer_key] = self.is_alive(peer_key)
-            if not alive_cache[peer_key]:
+            peer_key = peer_keys.get(link.peer_pod)
+            if peer_key is None:
+                peer_key = peer_keys[link.peer_pod] = ns_prefix + link.peer_pod
+            peer_src_ip, peer_net_ns = self._placement_cached(peer_key)
+            if not (peer_src_ip and peer_net_ns):
                 # Peer not up: do nothing — the peer will plumb both ends
                 # when it arrives (handler.go:389-395).
                 continue
-
-            if peer_key not in src_ip_cache:
-                src_ip_cache[peer_key] = self._pod_src_ip(peer_key)
-            peer_src_ip = src_ip_cache[peer_key]
             if peer_src_ip and self.node_ip and peer_src_ip != self.node_ip:
                 # Branch D, cross-node (handler.go:419-453): realize only
                 # the LOCAL egress end (far end = the peer node's VTEP,
@@ -541,10 +584,10 @@ class SimEngine:
                 # earlier failed completion RPC on retry.
                 if (local_key, link.uid) not in self._rows:
                     row = self._alloc(local_key, link.uid)
-                    props = es.props_row_cached(link.properties)
-                    entries.append((row, link.uid, self.pod_id(local_key),
-                                    self.pod_id(f"vtep/{peer_src_ip}"),
-                                    props))
+                    props, shaped = es.props_row_and_shaped(link.properties)
+                    entries.append((row, link.uid, local_pid,
+                                    self._pod_id(f"vtep/{peer_src_ip}"),
+                                    props, shaped))
                 from kubedtn_tpu.wire import proto as pb
 
                 remote_calls.append((peer_src_ip, pb.RemotePod(
@@ -564,26 +607,20 @@ class SimEngine:
 
             # Both alive same-node: this pod plumbs BOTH directions with ITS
             # declared properties (common/veth.go:44-62, common/utils.go:39-68).
-            props = es.props_row_cached(link.properties)
+            props, shaped = es.props_row_and_shaped(link.properties)
+            peer_pid = self._pod_id(peer_key)
             row = self._alloc(local_key, link.uid)
-            entries.append((row, link.uid, self.pod_id(local_key),
-                            self.pod_id(peer_key), props))
+            entries.append((row, link.uid, local_pid, peer_pid, props,
+                            shaped))
             prow = self._alloc(peer_key, link.uid)
-            entries.append((prow, link.uid, self.pod_id(peer_key),
-                            self.pod_id(local_key), props))
+            entries.append((prow, link.uid, peer_pid, local_pid, props,
+                            shaped))
             self._peer[(local_key, link.uid)] = (peer_key, link.uid)
             self._peer[(peer_key, link.uid)] = (local_key, link.uid)
         self._enqueue_apply(entries)
         self.stats.adds += len(entries)
         self.stats.observe("add", (time.perf_counter() - t0) * 1e3)
         return remote_calls
-
-    def _pod_src_ip(self, pod_key: str) -> str:
-        ns, _, name = pod_key.partition("/")
-        try:
-            return self.store.peek_placement(ns, name)[0]
-        except NotFoundError:
-            return ""
 
     @_locked
     def del_links(self, topo: Topology, links: list[Link]) -> bool:
@@ -624,12 +661,12 @@ class SimEngine:
         the LOCAL end's shaping, leaving the peer direction untouched."""
         t0 = time.perf_counter()
         local_key = topo.key
-        entries: list[tuple[int, np.ndarray]] = []
+        entries: list[tuple[int, np.ndarray, bool]] = []
         for link in links:
             row = self._rows.get((local_key, link.uid))
             if row is None:
                 continue
-            entries.append((row, es.props_row_cached(link.properties)))
+            entries.append((row, *es.props_row_and_shaped(link.properties)))
         self._enqueue_update(entries)
         self.stats.updates += len(entries)
         self.stats.observe("update", (time.perf_counter() - t0) * 1e3)
@@ -649,9 +686,9 @@ class SimEngine:
         pod_key = f"{ns or 'default'}/{name}"
         self._ensure_capacity(1)
         row = self._alloc(pod_key, uid)
-        entry = (row, uid, self.pod_id(pod_key),
-                 self.pod_id(f"vtep/{peer_vtep}"),
-                 es.props_row_cached(props))
+        prow, shaped = es.props_row_and_shaped(props)
+        entry = (row, uid, self._pod_id(pod_key),
+                 self._pod_id(f"vtep/{peer_vtep}"), prow, shaped)
         self._enqueue_apply([entry])
         self.stats.observe("remoteUpdate", (time.perf_counter() - t0) * 1e3)
         return True

@@ -50,17 +50,23 @@ def calc_diff(old: list[Link], new: list[Link]):
     """O(n) diff: returns (add, delete, properties_changed).
 
     Same outputs as the reference's CalcDiff (topology_controller.go:288-318)
-    computed via hash join instead of the nested scan.
+    computed via hash join instead of the nested scan. Identities are built
+    once per link per call — at 100k-link drains the repeated tuple packing
+    was itself a profile line.
     """
-    old_by_id = {_identity(l): l for l in old}
-    new_by_id = {_identity(l): l for l in new}
-    add = [l for l in new if _identity(l) not in old_by_id]
-    delete = [l for l in old if _identity(l) not in new_by_id]
-    changed = [
-        l for l in new
-        if _identity(l) in old_by_id
-        and old_by_id[_identity(l)].properties != l.properties
-    ]
+    old_ids = [_identity(l) for l in old]
+    new_ids = [_identity(l) for l in new]
+    old_by_id = dict(zip(old_ids, old))
+    new_seen = set(new_ids)
+    add: list[Link] = []
+    changed: list[Link] = []
+    for ident, link in zip(new_ids, new):
+        prev = old_by_id.get(ident)
+        if prev is None:
+            add.append(link)
+        elif prev.properties != link.properties:
+            changed.append(link)
+    delete = [l for i, l in zip(old_ids, old) if i not in new_seen]
     return add, delete, changed
 
 

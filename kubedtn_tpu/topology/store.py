@@ -57,6 +57,17 @@ class TopologyStore:
         self._objects: dict[str, Topology] = {}
         self._rv = 0
         self._watchers: list[deque[WatchEvent]] = []
+        # Bumped only when some object's placement (status.src_ip/net_ns)
+        # may have changed — object create/delete or a status write that
+        # touches those fields. Lets the engine cache alive/src-ip answers
+        # for an entire reconcile drain (status copy-backs don't move
+        # placement, so the cache survives them).
+        self._placement_gen = 0
+
+    @property
+    def placement_generation(self) -> int:
+        with self._lock:
+            return self._placement_gen
 
     # -- internal ------------------------------------------------------
 
@@ -79,6 +90,7 @@ class TopologyStore:
             obj.resource_version = self._next_rv()
             obj.deletion_requested = False
             self._objects[k] = obj
+            self._placement_gen += 1
             self._emit(WatchEvent("ADDED", obj.clone()))
             return obj.clone()
 
@@ -142,6 +154,9 @@ class TopologyStore:
         UpdateStatus PUT (api/clientset/v1beta1/topology.go:171-184)."""
         with self._lock:
             current = self._check_and_bump(topology)
+            if (current.status.src_ip != topology.status.src_ip
+                    or current.status.net_ns != topology.status.net_ns):
+                self._placement_gen += 1
             obj = current.clone()
             obj.status = topology.status.clone()
             obj.resource_version = self._next_rv()
@@ -169,6 +184,7 @@ class TopologyStore:
         obj = self._objects.get(k)
         if obj is not None and obj.deletion_requested and not obj.finalizers:
             del self._objects[k]
+            self._placement_gen += 1
             self._emit(WatchEvent("DELETED", obj.clone()))
 
     # -- watch ---------------------------------------------------------
