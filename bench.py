@@ -118,18 +118,10 @@ def build():
 def fresh_props(n, seed):
     """Pre-stage n random-but-valid property rows on device."""
     import jax.numpy as jnp
-    import numpy as np
 
-    from kubedtn_tpu.ops import edge_state as es
+    from kubedtn_tpu.models.topologies import random_link_props
 
-    rng = np.random.default_rng(seed)
-    base = np.zeros((n, es.NPROP), np.float32)
-    base[:, es.P_LATENCY_US] = rng.integers(1_000, 100_000, n)
-    base[:, es.P_JITTER_US] = rng.integers(0, 5_000, n)
-    base[:, es.P_LOSS] = rng.uniform(0, 2, n)
-    base[:, es.P_RATE_BPS] = rng.choice(
-        [20e6, 50e6, 100e6, 1e9, 10e9], n)
-    return jnp.asarray(base)
+    return jnp.asarray(random_link_props(n, seed))
 
 
 def bench_link_updates(extras: dict) -> float:
@@ -355,6 +347,23 @@ def main() -> None:
 
     with_retry("wire_streaming", lambda: bench_wire_streaming(extras),
                extras)
+
+    def run_scale_1m():
+        from kubedtn_tpu.scenarios import scale_1m
+
+        r = scale_1m()
+        extras["scale_1m"] = {
+            k: r[k] for k in ("links", "directed_rows", "load_s",
+                              "updates_per_sec", "shape_pkts_per_sec")
+        }
+
+    if not degraded:
+        # 10× the BASELINE top rung — scale headroom evidence; skipped on
+        # the CPU fallback, where 2M-row device ops would dominate the
+        # degraded run's time budget without measuring anything real
+        with_retry("scale_1m", run_scale_1m, extras)
+    else:
+        extras["scale_1m"] = None
 
     extras["bench_wall_s"] = round(time.perf_counter() - t_bench, 1)
     if ups is None:

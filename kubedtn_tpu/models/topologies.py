@@ -377,6 +377,21 @@ FAMILIES = {
 }
 
 
+def random_link_props(n: int, seed: int,
+                      rates=(20e6, 50e6, 100e6, 1e9, 10e9)) -> np.ndarray:
+    """n random-but-valid numeric property rows — the shared benchmark
+    workload (bench.py's headline and the scale_1m rung must draw from
+    the SAME distribution so their updates/sec numbers stay comparable):
+    latency 1-100ms, jitter 0-5ms, loss 0-2%, rate drawn from `rates`."""
+    rng = np.random.default_rng(seed)
+    base = np.zeros((n, es.NPROP), np.float32)
+    base[:, es.P_LATENCY_US] = rng.integers(1_000, 100_000, n)
+    base[:, es.P_JITTER_US] = rng.integers(0, 5_000, n)
+    base[:, es.P_LOSS] = rng.uniform(0, 2, n)
+    base[:, es.P_RATE_BPS] = rng.choice(np.asarray(rates), n)
+    return base
+
+
 def load_edge_list_into_state(el: EdgeList, capacity: int | None = None):
     """Fast path: place a generated topology directly into a fresh
     EdgeState, bypassing the per-link control plane. Returns

@@ -10,6 +10,8 @@ update path measured standalone.
 3. churn_1k        — 1k-node random mesh, 10%/sec UpdateLinks churn
 4. routes_10k      — shortest-path recompute on link up/down events
 5. clos_100k       — 100k-link Clos with loss+jitter and packet queues
+6. reconcile_100k  — reconcile-to-steady through the real control path
+7. scale_1m        — 1M-link Clos: full-fabric updates + shaping on device
 """
 
 from __future__ import annotations
@@ -309,6 +311,87 @@ def reconcile_100k(n_spine: int = 100, n_leaf: int = 500,
     }
 
 
+def scale_1m(n_spine: int = 200, n_leaf: int = 2500,
+             links_per_pair: int = 2, update_iters: int = 10,
+             shape_iters: int = 10):
+    """Rung 7: ONE MILLION links — 10× the BASELINE ladder's top rung.
+
+    Device-side scale evidence: a 1M-link Clos loads into edge state as 2M
+    directed rows (capacity 2^21), then the two data-plane primitives run
+    at full fabric width — a whole-fabric UpdateLinks each iteration and
+    the netem shaping kernel over every active row. For scale context, the
+    reference's userspace wire backend notes a practical ~1K-interfaces-
+    per-node naming ceiling (reference daemon/grpcwire/grpcwire.go:276-283)
+    and its UpdateLinks rebuilds qdiscs one link at a time
+    (handler.go:634-671); this rung exercises 1000× that interface count
+    in single batched device ops.
+    """
+    import functools
+
+    t0 = time.perf_counter()
+    el = T.clos(n_spine, n_leaf, 0,
+                props=LinkProperties(latency="10ms", rate="10Gbit"),
+                links_per_pair=links_per_pair)
+    L = el.n_links
+    state, rows = T.load_edge_list_into_state(el)
+    jax.block_until_ready(state.props)
+    load_s = time.perf_counter() - t0
+
+    uprops = jnp.asarray(T.random_link_props(L, seed=5))
+    urows = jnp.arange(L, dtype=jnp.int32)  # every local end, one batch
+    valid = jnp.ones((L,), dtype=bool)
+
+    @functools.partial(jax.jit, donate_argnums=0, static_argnums=1)
+    def run_updates(st, iters):
+        def body(st, _):
+            return es.update_links.__wrapped__(
+                st, urows, uprops, valid, True), ()
+        st, _ = jax.lax.scan(body, st, jnp.arange(iters))
+        return st
+
+    state = run_updates(state, update_iters)  # compile + warm
+    jax.block_until_ready(state.props)
+    tb = time.perf_counter()
+    state = run_updates(state, update_iters)
+    jax.block_until_ready(state.props)
+    updates_per_s = L * update_iters / (time.perf_counter() - tb)
+
+    from kubedtn_tpu.ops import netem
+
+    E = state.capacity
+    sizes = jnp.full((E,), 1500.0, jnp.float32)
+    t_arr = jnp.zeros((E,), jnp.float32)
+    key = jax.random.key(9)
+
+    @functools.partial(jax.jit, donate_argnums=0, static_argnums=1)
+    def run_shape(st, iters):
+        def body(st, i):
+            st, _res = netem.shape_step.__wrapped__(
+                st, sizes, st.active, t_arr, jax.random.fold_in(key, i))
+            return st, ()
+        st, _ = jax.lax.scan(body, st, jnp.arange(iters))
+        return st
+
+    n_active = int(jnp.sum(state.active))
+    state = run_shape(state, shape_iters)  # compile + warm
+    jax.block_until_ready(state.props)
+    tb = time.perf_counter()
+    state = run_shape(state, shape_iters)
+    jax.block_until_ready(state.props)
+    shape_pkts_per_s = n_active * shape_iters / (time.perf_counter() - tb)
+
+    return {
+        "scenario": "scale_1m",
+        "links": L,
+        "directed_rows": 2 * L,
+        "capacity": E,
+        "load_s": round(load_s, 3),
+        "updates_per_sec": round(updates_per_s, 1),
+        "shape_pkts_per_sec": round(shape_pkts_per_s, 1),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 LADDER = {
     "3node": three_node,
     "fat_tree_64": fat_tree_64,
@@ -316,4 +399,5 @@ LADDER = {
     "routes_10k": routes_10k,
     "clos_100k": clos_100k,
     "reconcile_100k": reconcile_100k,
+    "scale_1m": scale_1m,
 }

@@ -36,3 +36,14 @@ def test_routes_scenario_small_scale():
     r = S.routes_10k(n_nodes=200, n_links=600, events=2, dst_chunk=50)
     assert 0 < r["reachable_frac"] <= 1.0
     assert r["recompute_s_first"] > 0
+
+
+def test_scale_scenario_small_scale():
+    """scale_1m's device pipeline (bulk load → full-fabric contiguous
+    update scan → shaping scan) at 80 links."""
+    r = S.scale_1m(n_spine=4, n_leaf=10, links_per_pair=2,
+                   update_iters=2, shape_iters=2)
+    assert r["links"] == 80
+    assert r["directed_rows"] == 160
+    assert r["updates_per_sec"] > 0
+    assert r["shape_pkts_per_sec"] > 0
