@@ -503,3 +503,52 @@ def test_concurrent_drain_surfaces_worker_exception():
     assert done["outcome"] == "raised:boom"
     # the key requeues so a later (healthy) drain can converge
     assert ("default", "p0") in rec._requeue
+
+
+class TestPlacementGeneration:
+    """The engine caches (src_ip, net_ns) answers against the store's
+    placement generation; these pin the generation's bump/no-bump rules
+    and the cache's cross-drain invalidation."""
+
+    def test_spec_update_and_status_copyback_keep_generation(self):
+        store, engine, _ = cluster(REFERENCE_3NODE)
+        engine.setup_pod("r1")
+        gen = store.placement_generation
+        # spec-only update: no placement movement
+        t = store.get("default", "r1")
+        store.update(t)
+        assert store.placement_generation == gen
+        # status copy-back (links only, same src_ip/net_ns): no bump —
+        # this is what keeps the cache warm across a reconcile drain
+        t = store.get("default", "r1")
+        t.status.links = list(t.spec.links)
+        store.update_status(t)
+        assert store.placement_generation == gen
+
+    def test_placement_write_and_delete_bump_generation(self):
+        store, engine, _ = cluster(REFERENCE_3NODE)
+        gen = store.placement_generation
+        engine.set_alive("r1", "default", "10.0.0.9", "/run/netns/r1")
+        assert store.placement_generation > gen
+        gen = store.placement_generation
+        engine.destroy_pod("r1")  # clears placement (src_ip="")
+        assert store.placement_generation > gen
+
+    def test_cache_invalidated_when_peer_comes_alive(self):
+        store, engine, _ = cluster(REFERENCE_3NODE)
+        rec = Reconciler(store, engine)
+        engine.set_alive("r1", "default", "10.0.0.1", "/run/netns/r1")
+        rec.drain()
+        # r1 alive, peers not: nothing realized; peer absence is cached
+        assert engine.num_active == 0
+        # r2 gains placement -> generation bumps -> the next drain must
+        # NOT reuse the cached "r2 not alive" answer
+        engine.set_alive("r2", "default", "10.0.0.1", "/run/netns/r2")
+        # force a re-reconcile of r1 (its status == spec after drain 1
+        # would no-op; clear status links to re-diff)
+        t = store.get("default", "r1")
+        t.status.links = []
+        store.update_status(t)
+        rec.drain()
+        assert engine.row_of("default/r1", 1) is not None
+        assert engine.row_of("default/r2", 1) is not None
