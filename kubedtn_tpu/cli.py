@@ -133,6 +133,12 @@ def cmd_daemon(args) -> int:
     store = TopologyStore()
     engine = SimEngine(store, node_ip=args.node_ip)
     daemon = Daemon(engine)
+    if getattr(args, "capture", None):
+        from kubedtn_tpu.utils.pcap import CaptureManager
+
+        daemon.capture = CaptureManager()
+        daemon.capture.open(args.capture)
+        log.info("capture on %s", fields(path=args.capture))
     dataplane = WireDataPlane(daemon)
     registry, hist = make_registry(engine,
                                    sim_counters_fn=dataplane.counters_fn)
@@ -149,10 +155,20 @@ def cmd_daemon(args) -> int:
     print(f"kubedtn-tpu daemon: gRPC on :{port}, "
           f"metrics on :{metrics.port}/metrics", flush=True)
     try:
+        # a DaemonSet pod stop is SIGTERM, not Ctrl-C: route it through
+        # the same graceful-shutdown path (capture close, plane stop)
+        import signal as _signal
+
+        def _on_term(*_):
+            raise KeyboardInterrupt
+
+        _signal.signal(_signal.SIGTERM, _on_term)
         server.wait_for_termination()
     except KeyboardInterrupt:
         server.stop(0)
         dataplane.stop()
+        if daemon.capture is not None:
+            daemon.capture.close_all()
         metrics.stop()
     return 0
 
@@ -352,6 +368,9 @@ def main(argv=None) -> int:
     dp.add_argument("--metrics-port", type=int, default=None)
     dp.add_argument("--node-ip",
                     default=os.environ.get("HOST_IP", "10.0.0.1"))
+    dp.add_argument("--capture", default=None, metavar="PCAP",
+                    help="record all wire traffic to this pcap file "
+                         "(tcpdump/wireshark-readable)")
     dp.set_defaults(fn=cmd_daemon)
 
     mp = sub.add_parser("manager",

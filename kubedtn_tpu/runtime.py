@@ -429,6 +429,9 @@ class WireDataPlane:
                         ring_drops[row] = ring_drops.get(row, 0) + 1
             else:
                 wire.egress.append(frame)
+                cap = self.daemon.capture
+                if cap is not None:
+                    cap.record(pod_key, uid, frame, "out")
         if ring_drops:
             # one counter-array copy per release, however many frames fell
             dr = np.asarray(self.counters.dropped_ring).copy()

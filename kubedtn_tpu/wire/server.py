@@ -195,6 +195,10 @@ class Daemon:
         # SendToOnce to the peer daemon, grpcwire.go:452-459): send
         # errors counted, not fatal.
         self.forward_errors = 0
+        # optional pcap tap (utils/pcap.CaptureManager) — the
+        # observability stand-in for the reference's per-wire libpcap
+        # handles (grpcwire.go:398-409); None = zero cost
+        self.capture = None
         try:
             from kubedtn_tpu import native as _native
             self._classify = (_native.classify_batch
@@ -353,8 +357,12 @@ class Daemon:
         (ingress) — the injection surface standing in for pcap capture."""
         if wire.peer_ip:
             wire.egress.append(frame)
+            if self.capture is not None:
+                self.capture.record(wire.pod_key, wire.uid, frame, "out")
         else:
             wire.ingress.append(frame)  # the deque's notify marks it hot
+            if self.capture is not None:
+                self.capture.record(wire.pod_key, wire.uid, frame, "in")
 
     def SendToOnce(self, request, context):
         wire = self.wires.get_by_id(int(request.remot_intf_id))
@@ -384,7 +392,10 @@ class Daemon:
         if wire is None:
             context.abort(grpc.StatusCode.NOT_FOUND,
                           f"no wire {request.remot_intf_id}")
-        wire.ingress.append(bytes(request.frame))
+        frame = bytes(request.frame)
+        wire.ingress.append(frame)
+        if self.capture is not None:
+            self.capture.record(wire.pod_key, wire.uid, frame, "in")
         return pb.BoolResponse(response=True)
 
     # -- sim ingress/egress bridge ------------------------------------
@@ -435,6 +446,8 @@ class Daemon:
                 self.forward_errors += 1
                 return False
         wire.egress.append(frame)
+        if self.capture is not None:
+            self.capture.record(wire.pod_key, wire.uid, frame, "out")
         return True
 
 
