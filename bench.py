@@ -338,9 +338,9 @@ def main() -> None:
 
         r = reconcile_100k()
         extras["reconcile_100k"] = {
-            k: r[k] for k in ("reconcile_s", "churn_s", "grpc_update_s",
-                              "links", "topologies", "device_calls",
-                              "meets_target")
+            k: r[k] for k in ("reconcile_s", "churn_s", "teardown_s",
+                              "grpc_update_s", "links", "topologies",
+                              "device_calls", "meets_target")
         }
 
     with_retry("reconcile_100k", run_reconcile, extras)

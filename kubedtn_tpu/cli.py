@@ -160,6 +160,8 @@ def cmd_daemon(args) -> int:
         import signal as _signal
 
         def _on_term(*_):
+            # a second SIGTERM during cleanup must not abort it
+            _signal.signal(_signal.SIGTERM, _signal.SIG_IGN)
             raise KeyboardInterrupt
 
         _signal.signal(_signal.SIGTERM, _on_term)
@@ -313,6 +315,53 @@ def cmd_crd(args) -> int:
     return 0
 
 
+def cmd_pcap(args) -> int:
+    """Summarize a capture file written by --capture / CaptureManager:
+    per-frame lines (ts offset, length, classified protocol when the
+    native classifier is available) plus totals — the reading half of the
+    reference's per-packet DecodeFrame debug logging (grpcwire.go:465-613).
+    """
+    import itertools
+    from collections import Counter
+
+    from kubedtn_tpu.utils.pcap import read_pcap
+
+    classify_batch = None
+    try:
+        from kubedtn_tpu import native
+
+        if native.have_native():
+            classify_batch = native.classify_batch
+    except Exception:
+        pass
+
+    totals: Counter[str] = Counter()
+    n = 0
+    t_first = None
+    records = read_pcap(args.file)
+    # classify in chunks: one native call per CHUNK frames, not per frame
+    CHUNK = 1024
+    while True:
+        batch = list(itertools.islice(records, CHUNK))
+        if not batch:
+            break
+        if classify_batch is not None:
+            protos = classify_batch([rec.frame for rec in batch])
+        else:
+            protos = ["frame"] * len(batch)
+        for rec, proto in zip(batch, protos):
+            if t_first is None:
+                t_first = rec.ts
+            totals[proto] += 1
+            n += 1
+            if not args.quiet:
+                print(f"{rec.ts - t_first:10.6f}s  {rec.orig_len:5d}B  "
+                      f"{proto}")
+    print(f"{args.file}: {n} frames "
+          + " ".join(f"{k}={v}" for k, v in sorted(totals.items())))
+    return 0
+
+
 def cmd_bench(args) -> int:
     # bench.py lives at the repo root, not in the package: anchor the
     # import so `python -m kubedtn_tpu.cli bench` works from any cwd
@@ -372,6 +421,12 @@ def main(argv=None) -> int:
                     help="record all wire traffic to this pcap file "
                          "(tcpdump/wireshark-readable)")
     dp.set_defaults(fn=cmd_daemon)
+
+    pcp = sub.add_parser("pcap", help="summarize a capture file")
+    pcp.add_argument("file")
+    pcp.add_argument("-q", "--quiet", action="store_true",
+                     help="totals only, no per-frame lines")
+    pcp.set_defaults(fn=cmd_pcap)
 
     mp = sub.add_parser("manager",
                         help="run the topology controller manager "

@@ -198,13 +198,15 @@ def reconcile_100k(n_spine: int = 100, n_leaf: int = 500,
     CR, is diffed by the reconciler, allocated a row by the engine, and
     lands on device via the engine's coalesced flush.
 
-    Three measured phases:
-    - realize_s: 600 CRs / 100k links / 200k directed rows from empty
+    Four measured phases (the whole lifecycle):
+    - reconcile_s: 600 CRs / 100k links / 200k directed rows from empty
       status to fully realized + status copied back;
     - churn_s:   every link's properties replaced through spec updates,
       re-reconciled (the UpdateLinks path end to end);
     - grpc_update_s: one live-daemon Local.UpdateLinks round trip for a
-      `grpc_batch`-link batch over real gRPC (wire-serialization cost).
+      `grpc_batch`-link batch over real gRPC (wire-serialization cost);
+    - teardown_s: every pod destroyed (CNI cmdDel → DestroyPod path,
+      reference handler.go:538-590) back to zero active rows.
     """
     from dataclasses import replace
 
@@ -293,6 +295,16 @@ def reconcile_100k(n_spine: int = 100, n_leaf: int = 500,
     client.close()
     server.stop(0)
 
+    # teardown: every pod destroyed through the real path (CNI cmdDel →
+    # DestroyPod, reference handler.go:538-590) back to an empty fabric
+    t0 = time.perf_counter()
+    for t in store.list():
+        engine.destroy_pod(t.name, t.namespace)
+    engine.flush()
+    jax.block_until_ready(engine.state.props)
+    teardown_s = time.perf_counter() - t0
+    assert engine.num_active == 0, engine.num_active
+
     return {
         "scenario": "reconcile_100k",
         "topologies": n_spine + n_leaf,
@@ -301,6 +313,7 @@ def reconcile_100k(n_spine: int = 100, n_leaf: int = 500,
         "setup_s": round(setup_s, 3),
         "reconcile_s": round(realize_s, 3),
         "churn_s": round(churn_s, 3),
+        "teardown_s": round(teardown_s, 3),
         "grpc_update_s": round(grpc_update_s, 4),
         "grpc_update_links": len(batch),
         "grpc_ok": bool(resp.response),

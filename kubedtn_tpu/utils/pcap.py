@@ -125,8 +125,10 @@ class CaptureManager:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._taps: list[_Tap] = []
+        self._lock = threading.Lock()  # guards tap-set mutation only
+        # copy-on-write: record() reads this tuple lock-free on the
+        # per-frame hot path; open/close_all swap in a new tuple
+        self._taps: tuple[_Tap, ...] = ()
 
     def open(self, path: str, pod_key: str | None = None,
              uid: int | None = None,
@@ -137,14 +139,12 @@ class CaptureManager:
             raise ValueError(f"direction must be in/out/None: {direction!r}")
         w = PcapWriter(path)
         with self._lock:
-            self._taps.append(_Tap(w, pod_key, uid, direction))
+            self._taps = self._taps + (_Tap(w, pod_key, uid, direction),)
         return w
 
     def record(self, pod_key: str, uid: int, frame: bytes,
                direction: str, ts: float | None = None) -> None:
-        with self._lock:
-            taps = list(self._taps)
-        for t in taps:
+        for t in self._taps:
             if t.pod_key is not None and t.pod_key != pod_key:
                 continue
             if t.uid is not None and t.uid != uid:
@@ -155,6 +155,6 @@ class CaptureManager:
 
     def close_all(self) -> None:
         with self._lock:
-            taps, self._taps = self._taps, []
+            taps, self._taps = self._taps, ()
         for t in taps:
             t.writer.close()

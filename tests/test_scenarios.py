@@ -21,6 +21,7 @@ def test_reconcile_scenario_small_scale():
     assert r["links"] == 40
     assert r["directed_rows"] == 80
     assert r["grpc_ok"] is True
+    assert r["teardown_s"] >= 0  # full-lifecycle phase reaches 0 rows
     assert r["spot_check_latency_us"] == 20_000.0
     assert r["meets_target"] is True  # trivially, at this scale
     assert r["device_calls"] <= 6     # coalescing holds at small scale too
