@@ -18,6 +18,9 @@ Kernels (all pure JAX, MXU/VPU friendly):
   control flow, one compile.
 - `next_hop_edges`: per (node, destination) the egress edge row realizing
   the shortest path, extracted with a tie-broken segment-min.
+- `ecmp_next_hop_edges`: the multipath generalization — up to K tied
+  egress rows per (node, destination); the router hashes flows across
+  the group (router.py), like hardware ECMP next-hop groups.
 
 Weights are µs latencies by default (the shaping latency column), so paths
 minimize propagation delay, and unreachable pairs are +inf.
@@ -122,14 +125,25 @@ def all_pairs_dist(state: EdgeState, weights: jax.Array, nodes: jax.Array,
     return out.transpose(1, 0, 2).reshape(n_nodes, n_nodes)
 
 
-@partial(jax.jit, static_argnums=(2, 3))
 def next_hop_edges(state: EdgeState, dist: jax.Array, n_nodes: int,
                    dst_chunk: int | None = None) -> jax.Array:
     """next_edge[u, j]: edge row of u's best egress toward destination j
     (-1 when unreachable or u == j). Ties break to the lowest edge row,
-    reproducible across shardings. Two segment-min passes per destination
-    chunk: best one-step cost, then the smallest edge row achieving it
-    (f32 holds edge rows < 2^24 exactly)."""
+    reproducible across shardings. The single-path (k_paths=1) slice of
+    the ECMP kernel."""
+    return ecmp_next_hop_edges(state, dist, n_nodes, 1, dst_chunk)[:, :, 0]
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4))
+def ecmp_next_hop_edges(state: EdgeState, dist: jax.Array, n_nodes: int,
+                        k_paths: int = 4,
+                        dst_chunk: int | None = None) -> jax.Array:
+    """ECMP next hops: nh[u, j, :] = up to `k_paths` edge rows of u's
+    equal-cost egresses toward j (-1 padded), lowest rows first — the
+    multipath generalization of next_hop_edges. The router hashes flows
+    across the valid entries (router.py step 4b), the way hardware ECMP
+    hashes onto a next-hop group. k_paths passes of tie-broken segment-min
+    with exclusion; k_paths is small and static."""
     E = state.capacity
     weights = edge_weights_latency(state)
     src = jnp.where(state.active, state.src, n_nodes)
@@ -146,11 +160,16 @@ def next_hop_edges(state: EdgeState, dist: jax.Array, n_nodes: int,
         cand = weights[:, None] + d_chunk[dstv]            # [E, chunk]
         best = jax.ops.segment_min(cand, src,
                                    num_segments=n_nodes + 1)[:n_nodes]
-        is_best = cand <= best[state.src] + 1e-3
-        idx = jnp.where(is_best, rows, jnp.inf)
-        nh = jax.ops.segment_min(idx, src,
-                                 num_segments=n_nodes + 1)[:n_nodes]
-        return jnp.where(jnp.isfinite(nh), nh, -1.0).astype(jnp.int32)
+        avail = cand <= best[state.src] + 1e-3             # tied best edges
+        picks = []
+        for _ in range(k_paths):
+            idx = jnp.where(avail, rows, jnp.inf)
+            nh = jax.ops.segment_min(idx, src,
+                                     num_segments=n_nodes + 1)[:n_nodes]
+            picks.append(nh)
+            avail = avail & (rows != nh[state.src])        # exclude chosen
+        nh_k = jnp.stack(picks, axis=-1)                   # [n, chunk, K]
+        return jnp.where(jnp.isfinite(nh_k), nh_k, -1.0).astype(jnp.int32)
 
     if n_chunks == 1:
         nh = chunk_fn(dist)
@@ -161,11 +180,21 @@ def next_hop_edges(state: EdgeState, dist: jax.Array, n_nodes: int,
             return None, chunk_fn(c)
 
         _, out = jax.lax.scan(body, None, chunks)
-        nh = out.transpose(1, 0, 2).reshape(n_nodes, n_nodes)
+        nh = out.transpose(1, 0, 2, 3).reshape(n_nodes, n_nodes, k_paths)
 
     # only keep hops for reachable, non-self destinations
     ok = jnp.isfinite(dist) & (dist > 0.0)
-    return jnp.where(ok, nh, -1)
+    return jnp.where(ok[:, :, None], nh, -1)
+
+
+def recompute_routes_ecmp(state: EdgeState, n_nodes: int, k_paths: int = 4,
+                          max_hops: int = 16,
+                          dst_chunk: int | None = None):
+    """recompute_routes with an ECMP table: (dist, nh[n, n, k_paths])."""
+    w = edge_weights_latency(state)
+    dist = all_pairs_dist(state, w, None, n_nodes, max_hops, dst_chunk)
+    nh = ecmp_next_hop_edges(state, dist, n_nodes, k_paths, dst_chunk)
+    return dist, nh
 
 
 def recompute_routes(state: EdgeState, n_nodes: int, max_hops: int = 16,

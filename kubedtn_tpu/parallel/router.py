@@ -249,6 +249,9 @@ def make_sharded_router_step(mesh, n_nodes: int, k_slots: int = 4,
 def shard_router_state(rs: RouterState, mesh) -> RouterState:
     """Place a host-built RouterState onto the mesh with the step's
     shardings (edge-dim leaves split, tables replicated)."""
+    assert rs.next_edge.ndim == 2, (
+        "sharded router forwards single-path tables; build ECMP groups "
+        "with recompute_routes_ecmp for the local router only")
     specs = _edge_specs(rs, mesh.devices.size)
 
     def put(x, spec):

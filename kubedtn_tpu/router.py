@@ -34,7 +34,10 @@ class RouterState:
     """Forwarding-plane state carried between steps."""
 
     sim: SimState
-    next_edge: jax.Array       # i32[n, n] routing table (edge rows)
+    # i32[n, n] single-path routing table (edge rows), or i32[n, n, K]
+    # ECMP next-hop groups from recompute_routes_ecmp (-1 padded): flows
+    # hash across the group per (ingress edge, destination)
+    next_edge: jax.Array
     pend_size: jax.Array       # f32[E, Kf] packets awaiting re-injection
     pend_dst: jax.Array        # i32[E, Kf] their final destinations
     pend_corr: jax.Array       # bool[E, Kf]
@@ -170,7 +173,21 @@ def router_step(rs: RouterState, spec: TrafficSpec, flow_dst: jax.Array,
     flat_live = in_transit.reshape(-1)
     safe_here = jnp.where(flat_live, flat_here, 0)
     safe_fd = jnp.where(flat_live, jnp.maximum(flat_fd, 0), 0)
-    nxt = rs.next_edge[safe_here, safe_fd]
+    if rs.next_edge.ndim == 3:
+        # ECMP: hash (ingress edge, destination) onto the next-hop group —
+        # per-ingress path stickiness, the way hardware ECMP hashes header
+        # fields onto a group (table built by recompute_routes_ecmp)
+        group = rs.next_edge[safe_here, safe_fd]           # [M, K]
+        cnt = (group >= 0).sum(axis=-1)
+        ing = jnp.broadcast_to(
+            jnp.arange(E, dtype=jnp.uint32)[:, None], due.shape).reshape(-1)
+        h = (ing * jnp.uint32(2654435761)
+             + safe_fd.astype(jnp.uint32) * jnp.uint32(40503))
+        k_idx = (h % jnp.maximum(cnt, 1).astype(jnp.uint32)).astype(jnp.int32)
+        nxt = jnp.take_along_axis(group, k_idx[:, None], axis=-1)[:, 0]
+        nxt = jnp.where(cnt > 0, nxt, -1)
+    else:
+        nxt = rs.next_edge[safe_here, safe_fd]
     no_route = flat_live & (nxt < 0)
     target = jnp.where(flat_live & (nxt >= 0), nxt, E)
     p_sz, p_dst, p_co, p_ok, fwd_drop = _group_into_lanes(

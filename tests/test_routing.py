@@ -193,3 +193,72 @@ class TestMultiHopForwarding:
         assert np.asarray(rs.node_rx_packets)[leaf3] > 0
         assert float(rs.no_route_dropped) == 0
         assert float(rs.fwd_dropped) == 0
+
+
+class TestECMP:
+    def _diamond(self):
+        """a(0) -> b(1)/c(2) -> d(3), equal 1ms cost both ways."""
+        el = T._mk(["a", "b", "c", "d"],
+                   [(0, 1), (0, 2), (1, 3), (2, 3)],
+                   LinkProperties(latency="1ms"))
+        return el, build(el)
+
+    def test_group_has_both_paths(self):
+        el, s = self._diamond()
+        dist, nh = R.recompute_routes_ecmp(s, 4, k_paths=4, max_hops=8)
+        g = np.asarray(nh)[0, 3]  # a's group toward d
+        valid = g[g >= 0]
+        assert len(valid) == 2
+        # the two tied egresses are a->b (row 0) and a->c (row 1)
+        assert set(valid.tolist()) == {0, 1}
+        # unreachable/self entries are fully -1
+        assert (np.asarray(nh)[0, 0] == -1).all()
+
+    def test_k1_matches_single_path(self):
+        el, s = self._diamond()
+        dist, nh1 = R.recompute_routes(s, 4, max_hops=8)
+        _, nhk = R.recompute_routes_ecmp(s, 4, k_paths=1, max_hops=8)
+        np.testing.assert_array_equal(np.asarray(nh1),
+                                      np.asarray(nhk)[:, :, 0])
+
+    def test_flows_split_across_paths(self):
+        """Two ingress feeders into the diamond: ECMP hashing on
+        (ingress edge, dst) spreads them over both equal-cost paths."""
+        el = T._mk(
+            ["s1", "s2", "a", "b", "c", "d"],
+            # feeders s1->a, s2->a, then the diamond a->b/c->d
+            [(0, 2), (1, 2), (2, 3), (2, 4), (3, 5), (4, 5)],
+            LinkProperties(latency="1ms"))
+        s = build(el)
+        n = el.n_nodes
+        dist, nh = R.recompute_routes_ecmp(s, n, k_paths=4, max_hops=8)
+        rs = RT.init_router(s, nh, n)
+        cap = s.capacity
+        import dataclasses as dc
+        from kubedtn_tpu.models.traffic import MODE_CBR
+        spec = cbr_everywhere(cap, 0, 0.0)
+        # CBR on both feeder edges (s1->a row 0, s2->a row 1), dst d(5)
+        spec = dc.replace(
+            spec,
+            mode=spec.mode.at[jnp.array([0, 1])].set(MODE_CBR),
+            rate_bps=spec.rate_bps.at[jnp.array([0, 1])].set(12_000_000.0),
+        )
+        flow_dst = jnp.full((cap,), -1, jnp.int32)
+        flow_dst = flow_dst.at[jnp.array([0, 1])].set(5)
+        rs = RT.run_routed(rs, spec, flow_dst, steps=60, dt_us=1000.0)
+        c = rs.sim.counters
+        tx = np.asarray(c.tx_packets)
+        # both diamond arms carried traffic (rows 2: a->b, 3: a->c)
+        assert np.asarray(rs.node_rx_packets)[5] > 0
+        assert float(rs.no_route_dropped) == 0
+        arm_ab, arm_ac = tx[2], tx[3]
+        assert arm_ab > 0 and arm_ac > 0, (arm_ab, arm_ac)
+
+    def test_sharded_router_rejects_ecmp(self):
+        el, s = self._diamond()
+        _, nh = R.recompute_routes_ecmp(s, 4, k_paths=2, max_hops=8)
+        rs = RT.init_router(s, nh, 4)
+        from kubedtn_tpu.parallel.mesh import make_mesh
+        from kubedtn_tpu.parallel.router import shard_router_state
+        with pytest.raises(AssertionError, match="single-path"):
+            shard_router_state(rs, make_mesh(8))
