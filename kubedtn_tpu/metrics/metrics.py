@@ -25,7 +25,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 from prometheus_client import CollectorRegistry, Histogram, generate_latest
-from prometheus_client.core import GaugeMetricFamily
+from prometheus_client.core import (CounterMetricFamily,
+                                     GaugeMetricFamily)
 
 # Reference bucket edges (latency_histograms.go:15).
 BUCKETS = (0, 1, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000)
@@ -50,11 +51,28 @@ class LatencyHistograms:
 
 
 class InterfaceStatsCollector:
-    """interface_* gauges from the engine's realized links + sim counters."""
+    """interface_* gauges from the engine's realized links + sim counters.
 
-    def __init__(self, engine, sim_counters_fn=None) -> None:
+    Scale guard: per-interface series are exported for up to
+    `max_interfaces` realized link ends (the reference's practical
+    ceiling is ~1K interfaces per node, grpcwire.go:276-283; the default
+    here is 10×). Beyond that the per-interface tail is truncated —
+    `kubedtn_interface_series_truncated` reports how many — because a
+    100k-interface scrape is a multi-second, tens-of-MB exposition no
+    Prometheus deployment wants. Node-level totals
+    (`kubedtn_node_<counter>_total`) are always exported from one
+    vectorized reduction, so aggregate visibility never truncates.
+    """
+
+    COUNTER_KEYS = ("tx_packets", "tx_bytes", "rx_packets", "rx_bytes",
+                    "dropped_loss", "dropped_queue", "dropped_ring",
+                    "rx_corrupted")
+
+    def __init__(self, engine, sim_counters_fn=None,
+                 max_interfaces: int = 10_000) -> None:
         self._engine = engine
         self._sim_counters_fn = sim_counters_fn
+        self._max_interfaces = max_interfaces
 
     def collect(self):
         labels = ["interface", "pod", "namespace"]
@@ -72,36 +90,62 @@ class InterfaceStatsCollector:
             ]
         }
         counters = self._sim_counters_fn() if self._sim_counters_fn else None
-        if counters is not None:
-            c = {k: np.asarray(getattr(counters, k)) for k in (
-                "tx_packets", "tx_bytes", "rx_packets", "rx_bytes",
-                "dropped_loss", "dropped_queue", "dropped_ring",
-                "rx_corrupted")}
-        # Locked snapshot: gRPC workers mutate the registries concurrently.
+        out = list(fams.values())
+        if counters is None:
+            # no counters, no per-interface or node series: don't pay the
+            # snapshot under the engine lock for nothing
+            return out
+        # one host transfer per array, then plain-list element access
+        # (numpy scalar indexing per sample dominated large scrapes)
+        arrs = {k: np.asarray(getattr(counters, k))
+                for k in self.COUNTER_KEYS}
+        c = {k: a.tolist() for k, a in arrs.items()}
+        nrows = len(c["tx_packets"])
+        # ONE locked engine read: snapshot + total + active rows, so the
+        # truncation count and node totals are consistent with the
+        # snapshot they accompany.
+        snapshot, total_active, active_rows = \
+            self._engine.metrics_snapshot(limit=self._max_interfaces)
+        truncated = max(0, total_active - len(snapshot))
+        # node totals over ACTIVE rows only: freed rows keep their
+        # cumulative counters until reuse (delete clears uid/active/props
+        # only), and a row realized after growth may not have a counter
+        # slot until the next tick re-inits the arrays
+        active_rows = active_rows[active_rows < nrows]
+        for k, a in arrs.items():
+            g = CounterMetricFamily(
+                f"kubedtn_node_{k}",
+                f"Node-wide sum of per-edge {k} over active links "
+                "(never truncated)")
+            g.add_metric([], float(a[active_rows].sum()))
+            out.append(g)
         # Interface name from the spec is not tracked per row, so expose
         # uid-derived names the way the CRD samples do (eth<n> ordering is
         # a spec-level concern).
-        for pod_key, uid, row, rev in self._engine.realized_snapshot():
+        for pod_key, uid, row, rev in snapshot:
+            if row >= nrows:
+                continue  # realized after growth, counters not yet sized
             ns, _, pod = pod_key.partition("/")
-            iface = f"uid{uid}"
-            lab = [iface, pod, ns]
-            if counters is None:
-                continue
-            # tx = this row's egress; rx = reverse row's deliveries into us
-            fams["tx_packets"].add_metric(lab, float(c["tx_packets"][row]))
-            fams["tx_bytes"].add_metric(lab, float(c["tx_bytes"][row]))
+            lab = [f"uid{uid}", pod, ns]
+            # tx = this row's egress; rx = reverse row's deliveries
+            fams["tx_packets"].add_metric(lab, c["tx_packets"][row])
+            fams["tx_bytes"].add_metric(lab, c["tx_bytes"][row])
             fams["tx_dropped"].add_metric(
-                lab, float(c["dropped_loss"][row] + c["dropped_queue"][row]
-                           + c["dropped_ring"][row]))
+                lab, c["dropped_loss"][row] + c["dropped_queue"][row]
+                + c["dropped_ring"][row])
             fams["tx_errors"].add_metric(lab, 0.0)
-            if rev is not None:
-                fams["rx_packets"].add_metric(
-                    lab, float(c["rx_packets"][rev]))
-                fams["rx_bytes"].add_metric(lab, float(c["rx_bytes"][rev]))
-                fams["rx_errors"].add_metric(
-                    lab, float(c["rx_corrupted"][rev]))
+            if rev is not None and rev < nrows:
+                fams["rx_packets"].add_metric(lab, c["rx_packets"][rev])
+                fams["rx_bytes"].add_metric(lab, c["rx_bytes"][rev])
+                fams["rx_errors"].add_metric(lab, c["rx_corrupted"][rev])
                 fams["rx_dropped"].add_metric(lab, 0.0)
-        return list(fams.values())
+        trunc = GaugeMetricFamily(
+            "kubedtn_interface_series_truncated",
+            "Realized link ends beyond the per-interface series cap "
+            "(0 = full per-interface coverage)")
+        trunc.add_metric([], float(truncated))
+        out.append(trunc)
+        return out
 
 
 class MetricsServer:
@@ -144,10 +188,12 @@ class MetricsServer:
         self._srv.server_close()  # release the listening socket
 
 
-def make_registry(engine=None, sim_counters_fn=None):
+def make_registry(engine=None, sim_counters_fn=None,
+                  max_interfaces: int = 10_000):
     """Registry with the parity collectors installed."""
     registry = CollectorRegistry()
     hist = LatencyHistograms(registry)
     if engine is not None:
-        registry.register(InterfaceStatsCollector(engine, sim_counters_fn))
+        registry.register(InterfaceStatsCollector(
+            engine, sim_counters_fn, max_interfaces=max_interfaces))
     return registry, hist

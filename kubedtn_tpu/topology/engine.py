@@ -705,12 +705,31 @@ class SimEngine:
     # -- queries -------------------------------------------------------
 
     @_locked
-    def realized_snapshot(self) -> list[tuple[str, int, int, int | None]]:
-        """(pod_key, uid, row, reverse_row) for every realized link end,
-        taken under the engine lock — the safe read for concurrent metrics
-        scrapes (a gRPC worker may be mutating the registries)."""
+    def metrics_snapshot(self, limit: int | None = None):
+        """(realized_snapshot(limit), total_active, active_rows_np) in ONE
+        locked read — the scrape's truncation count and node totals must
+        be consistent with the snapshot they accompany."""
+        snap = self.realized_snapshot(limit)
+        rows = np.fromiter(self._rows.values(), np.int64, len(self._rows))
+        return snap, len(self._rows), rows
+
+    @_locked
+    def realized_snapshot(self, limit: int | None = None
+                          ) -> list[tuple[str, int, int, int | None]]:
+        """(pod_key, uid, row, reverse_row) for realized link ends in
+        sorted-key order, taken under the engine lock — the safe read for
+        concurrent metrics scrapes (a gRPC worker may be mutating the
+        registries). With `limit`, only the first `limit` ends are built
+        via a heap (O(n log limit)) so a capped 100k-row scrape doesn't
+        hold the lock for a full sort."""
+        if limit is None or limit >= len(self._rows):
+            items = sorted(self._rows.items())
+        else:
+            import heapq
+
+            items = heapq.nsmallest(limit, self._rows.items())
         out = []
-        for (pod_key, uid), row in sorted(self._rows.items()):
+        for (pod_key, uid), row in items:
             peer = self._peer.get((pod_key, uid))
             rev = self._rows.get(peer) if peer is not None else None
             out.append((pod_key, uid, row, rev))

@@ -78,3 +78,53 @@ def test_http_exposition():
             assert e.code == 404
     finally:
         srv.stop()
+
+
+def test_node_aggregates_and_series_cap():
+    """Node totals are always exported; per-interface series truncate at
+    max_interfaces with the truncation count reported (the 100k-interface
+    scale guard — a full exposition would be tens of MB)."""
+    engine, sim = build_cluster_with_traffic()
+    # capped at 2 of the 6 realized ends
+    registry, _ = make_registry(engine, lambda: sim.counters,
+                                max_interfaces=2)
+    text = generate_latest(registry).decode()
+    assert "kubedtn_node_tx_packets_total" in text
+    assert "kubedtn_node_rx_bytes_total" in text
+    tx_total = [l for l in text.splitlines()
+                if l.startswith("kubedtn_node_tx_packets_total")][0]
+    assert float(tx_total.rsplit(" ", 1)[1]) > 0
+    lines = [l for l in text.splitlines()
+             if l.startswith("interface_tx_packets{")]
+    assert len(lines) == 2  # capped
+    trunc = [l for l in text.splitlines()
+             if l.startswith("kubedtn_interface_series_truncated")][0]
+    assert float(trunc.rsplit(" ", 1)[1]) == 4.0
+    # uncapped: all ends present, truncation gauge zero
+    registry2, _ = make_registry(engine, lambda: sim.counters)
+    text2 = generate_latest(registry2).decode()
+    lines2 = [l for l in text2.splitlines()
+              if l.startswith("interface_tx_packets{")]
+    assert len(lines2) == 6
+    trunc2 = [l for l in text2.splitlines()
+              if l.startswith("kubedtn_interface_series_truncated")][0]
+    assert float(trunc2.rsplit(" ", 1)[1]) == 0.0
+
+
+def test_node_totals_exclude_deleted_links():
+    """Freed rows keep their cumulative counters until reuse; node totals
+    must sum ACTIVE rows only, so deleting a pod's links removes its
+    traffic from the node aggregate."""
+    engine, sim = build_cluster_with_traffic()
+    registry, _ = make_registry(engine, lambda: sim.counters)
+
+    def node_tx(text):
+        line = [l for l in text.splitlines()
+                if l.startswith("kubedtn_node_tx_packets_total")][0]
+        return float(line.rsplit(" ", 1)[1])
+
+    before = node_tx(generate_latest(registry).decode())
+    assert before > 0
+    engine.destroy_pod("r1")  # removes r1's link ends (rows keep counters)
+    after = node_tx(generate_latest(registry).decode())
+    assert after < before
