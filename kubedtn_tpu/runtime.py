@@ -182,6 +182,9 @@ class WireDataPlane:
         self.dropped = 0
         self.bypassed = 0      # frames that skipped shaping entirely
         self.tick_errors = 0   # unexpected tick failures (thread survives)
+        self.last_now_s: float | None = None  # clock of the latest tick
+        self._clock_ext = False  # latest tick ran on a caller-supplied clock
+        self._ff_active = False  # fast_forward loop in progress
 
     # -- bypass --------------------------------------------------------
 
@@ -244,11 +247,53 @@ class WireDataPlane:
         with self._tick_lock:
             return self._tick_inner(now_s)
 
+    def fast_forward(self, sim_seconds: float,
+                     dt_s: float | None = None) -> dict:
+        """Advance the plane by `sim_seconds` of VIRTUAL time without
+        sleeping — hours of emulated link latency replay in wall-clock
+        seconds, something the reference (bound to kernel qdisc clocks)
+        cannot do. Ticks a synthetic clock forward in `dt_s` steps
+        (default: the plane's period) from the last tick's clock; frame
+        releases land on the first tick at/after their deadline, so
+        delivery timestamps are quantized to dt_s. Must not run while
+        the real-time runner is active (their clocks would disagree).
+        """
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError(
+                "fast_forward with the real-time runner active would mix "
+                "the monotonic and synthetic clocks; stop() it first")
+        dt = dt_s if dt_s is not None else self.dt_us / 1e6
+        if dt <= 0:
+            raise ValueError(f"dt_s must be positive: {dt}")
+        t = self.last_now_s if self.last_now_s is not None else 0.0
+        end = t + sim_seconds
+        t0_ticks, t0_shaped = self.ticks, self.shaped
+        wall0 = time.monotonic()
+        self._ff_active = True  # start() refuses while the loop runs
+        try:
+            while t < end:
+                t = min(t + dt, end)
+                self.tick(now_s=t)
+        finally:
+            self._ff_active = False
+        return {
+            "sim_seconds": sim_seconds,
+            "ticks": self.ticks - t0_ticks,
+            "shaped": self.shaped - t0_shaped,
+            "virtual_clock_s": t,
+            "wall_s": round(time.monotonic() - wall0, 3),
+        }
+
     def _tick_inner(self, now_s: float | None) -> int:
+        # an explicit clock marks the plane as running on synthetic time
+        # (tests, fast_forward); start() rebases before mixing in the
+        # monotonic clock
+        self._clock_ext = now_s is not None
         if now_s is None:
             now_s = time.monotonic()
         if self._origin_s is None:
             self._origin_s = now_s
+        self.last_now_s = now_s
         batches = self.daemon.drain_ingress(max_per_wire=self.max_slots)
         shaped = 0
         if batches:
@@ -476,6 +521,26 @@ class WireDataPlane:
     def start(self) -> None:
         if self._thread is not None:
             return
+        if self._ff_active:
+            raise RuntimeError("fast_forward in progress; start() after it "
+                               "returns")
+        # Continuity when the plane last ran on a synthetic clock
+        # (fast_forward / deterministic ticks): rebase the virtual epoch
+        # onto the monotonic clock so pending releases keep their
+        # REMAINING latency and token buckets don't see a decades-long
+        # "elapsed" refill on the first real tick.
+        if self._clock_ext and self.last_now_s is not None:
+            delta = time.monotonic() - self.last_now_s
+            if self._origin_s is not None:
+                self._origin_s += delta
+            if self._last_shaped_s is not None:
+                self._last_shaped_s += delta
+            if self._heap:  # non-wheel fallback holds absolute deadlines
+                self._heap = [(r + delta, seq, pk, uid, f)
+                              for (r, seq, pk, uid, f) in self._heap]
+                heapq.heapify(self._heap)
+            self.last_now_s += delta
+            self._clock_ext = False
         self._stop.clear()
 
         def loop():
@@ -488,7 +553,9 @@ class WireDataPlane:
                 t0 = time.monotonic()
                 self._wake.clear()  # signals during the tick re-arm it
                 try:
-                    self.tick(t0)
+                    # no explicit clock: the tick reads monotonic itself
+                    # and stays distinguishable from synthetic-clock runs
+                    self.tick()
                     last_error = None
                 except Exception as e:
                     # a tick must never kill the data plane — but a

@@ -499,3 +499,77 @@ def test_rate_capped_link_paces_frames_e2e():
     med = float(np.median(spacing))
     assert abs(med - expect) < 0.0015, \
         f"median spacing {med:.4f}s != ~{expect}s (shaper not pacing)"
+
+
+def _half_second_daemon():
+    """Two pods joined by a 500ms-latency link, wires attached."""
+    from dataclasses import replace as _rp
+
+    from kubedtn_tpu.api.types import LinkProperties
+
+    daemon, engine = make_daemon(LATENCY)  # r1<->r2 uid 1
+    topo = engine.get_pod("r1")
+    topo.spec.links = [_rp(l, properties=LinkProperties(latency="500ms"))
+                       for l in topo.spec.links if l.uid == 1]
+    engine.update_links(topo, topo.spec.links)
+    wa = add_wire(daemon, "r1", 1)
+    wb = add_wire(daemon, "r2", 1)
+    return daemon, wa, wb
+
+
+def test_fast_forward_virtual_time():
+    """A 500ms-latency link delivers in milliseconds of wall time under
+    fast_forward — virtual-time replay the real-time reference can't do."""
+    import time as _time
+
+    daemon, wa, wb = _half_second_daemon()
+    dp = WireDataPlane(daemon)
+    frame = b"\xbb" * 100
+    daemon._frame_in(wa, frame)
+    wall0 = _time.monotonic()
+    out = dp.fast_forward(2.0, dt_s=0.01)
+    wall = _time.monotonic() - wall0
+    assert list(wb.egress) == [frame]
+    assert out["shaped"] == 1
+    assert out["ticks"] == 200
+    assert out["virtual_clock_s"] >= 2.0
+    assert wall < out["sim_seconds"], (wall, out)  # faster than real time
+
+    # a second fast_forward continues from the advanced virtual clock
+    daemon._frame_in(wa, b"\xcc" * 60)
+    dp.fast_forward(1.0, dt_s=0.01)
+    assert len(wb.egress) == 2
+
+
+def test_fast_forward_rejects_live_runner():
+    daemon, _, _ = _half_second_daemon()
+    dp = WireDataPlane(daemon)
+    dp.start()
+    try:
+        with pytest.raises(RuntimeError, match="real-time runner"):
+            dp.fast_forward(0.1)
+    finally:
+        dp.stop()
+
+
+def test_fast_forward_then_realtime_keeps_remaining_latency():
+    """Pending virtual-time releases survive a switch to the real-time
+    runner with their REMAINING latency, not an instant release (the
+    epoch is rebased onto the monotonic clock in start())."""
+    import time as _time
+
+    daemon, wa, wb = _half_second_daemon()
+    dp = WireDataPlane(daemon, dt_us=5_000.0)
+    daemon._frame_in(wa, b"\xdd" * 90)
+    out = dp.fast_forward(0.2, dt_s=0.01)  # 300ms of latency remains
+    assert out["shaped"] == 1 and len(wb.egress) == 0
+    dp.start()
+    try:
+        _time.sleep(0.1)
+        assert len(wb.egress) == 0, "released early after clock switch"
+        deadline = _time.monotonic() + 2.0
+        while not wb.egress and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        assert len(wb.egress) == 1, "never released after clock switch"
+    finally:
+        dp.stop()
