@@ -208,8 +208,6 @@ def reconcile_100k(n_spine: int = 100, n_leaf: int = 500,
     - teardown_s: every pod destroyed (CNI cmdDel → DestroyPod path,
       reference handler.go:538-590) back to zero active rows.
     """
-    from dataclasses import replace
-
     from kubedtn_tpu.api.types import Link, Topology, TopologySpec
 
     t0 = time.perf_counter()
@@ -260,7 +258,7 @@ def reconcile_100k(n_spine: int = 100, n_leaf: int = 500,
     new_props = LinkProperties(latency="20ms", jitter="1ms", rate="1Gbit")
     t0 = time.perf_counter()
     for t in store.list():
-        t.spec.links = [replace(l, properties=new_props) for l in t.spec.links]
+        t.spec.links = [l.with_properties(new_props) for l in t.spec.links]
         store.update(t)
     rec.drain(workers=workers)
     jax.block_until_ready(engine.state.props)

@@ -71,9 +71,12 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_multihost_mesh(tmp_path):
+def _run_two_workers(script_text, tmp_path, timeout, hang_msg):
+    """Launch the worker script as two coordinated processes; return both
+    JSON results. Kills the sibling on any failure so a crashed worker
+    never leaves the other blocking on the dead coordinator."""
     script = tmp_path / "worker.py"
-    script.write_text(WORKER)
+    script.write_text(script_text)
     coord = f"127.0.0.1:{_free_port()}"
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
@@ -85,15 +88,23 @@ def test_two_process_multihost_mesh(tmp_path):
         for pid in (0, 1)
     ]
     outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=180)
-        except subprocess.TimeoutExpired:
-            for q in procs:
+    try:
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                raise AssertionError(hang_msg)
+            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for q in procs:
+            if q.poll() is None:
                 q.kill()
-            raise AssertionError("multihost worker hung")
-        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
+    return outs
+
+
+def test_two_process_multihost_mesh(tmp_path):
+    outs = _run_two_workers(WORKER, tmp_path, 180, "multihost worker hung")
 
     for pid, o in enumerate(sorted(outs, key=lambda o: o["pid"])):
         assert o["pid"] == pid
@@ -186,28 +197,8 @@ def test_two_process_sharded_step(tmp_path):
     """The FULL sharded sim step (batched updates -> shaping -> psum'd
     node stats) jitted across two OS processes' device meshes — the DCN
     path of SURVEY §5.8, not just an array reduce."""
-    script = tmp_path / "worker_step.py"
-    script.write_text(WORKER_STEP)
-    coord = f"127.0.0.1:{_free_port()}"
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(pid), coord, REPO],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env)
-        for pid in (0, 1)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise AssertionError("sharded-step worker hung")
-        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
+    outs = _run_two_workers(WORKER_STEP, tmp_path, 240,
+                            "sharded-step worker hung")
 
     a, b = sorted(outs, key=lambda o: o["pid"])
     assert a["devices"] == b["devices"] == 4

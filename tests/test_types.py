@@ -130,3 +130,18 @@ def test_load_reference_sample_if_present():
     assert all(len(t.spec.links) == 2 for t in topos)
     uids = {l.uid for t in topos for l in t.spec.links}
     assert uids == {1, 2, 3}
+
+
+def test_link_with_properties_matches_replace():
+    import dataclasses
+
+    l = Link(local_intf="eth1", peer_intf="eth2", peer_pod="q", uid=9,
+             local_ip="10.0.0.1/24", properties=LinkProperties(latency="5ms"))
+    p = LinkProperties(rate="1Gbit")
+    fast = l.with_properties(p)
+    slow = dataclasses.replace(l, properties=p)
+    assert fast == slow
+    assert fast.properties is p
+    assert fast.uid == 9 and fast.local_ip == "10.0.0.1/24"
+    assert l.properties.latency == "5ms"  # original untouched
+    assert hash(fast) == hash(slow)
