@@ -57,18 +57,23 @@ def cmd_apply(args) -> int:
     return 0
 
 
-def cmd_ping(args) -> int:
+def _engine_from_yaml(path):
     from kubedtn_tpu.api.types import load_yaml
     from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
 
     store = TopologyStore()
     engine = SimEngine(store)
-    topos = load_yaml(args.file)
+    topos = load_yaml(path)
     for t in topos:
         store.create(t)
     for t in topos:
         engine.setup_pod(t.name, t.namespace)
     Reconciler(store, engine).drain()
+    return engine, topos
+
+
+def cmd_ping(args) -> int:
+    engine, topos = _engine_from_yaml(args.file)
     uid = args.uid
     if uid is None:
         for t in topos:
@@ -81,6 +86,15 @@ def cmd_ping(args) -> int:
         print(f"no link between {args.a} and {args.b}", file=sys.stderr)
         return 1
     out = engine.ping(args.a, args.b, uid)
+    print(json.dumps(_json_safe(out)))
+    return 0 if out["reachable"] else 1
+
+
+def cmd_trace(args) -> int:
+    """Multi-hop path query across the whole fabric (ping's traceroute
+    sibling)."""
+    engine, _ = _engine_from_yaml(args.file)
+    out = engine.trace(args.a, args.b, max_hops=args.max_hops)
     print(json.dumps(_json_safe(out)))
     return 0 if out["reachable"] else 1
 
@@ -403,6 +417,14 @@ def main(argv=None) -> int:
     pp.add_argument("--uid", type=int, default=None)
     pp.add_argument("--file", required=True)
     pp.set_defaults(fn=cmd_ping)
+
+    tp = sub.add_parser("trace",
+                        help="traceroute-equivalent multi-hop path query")
+    tp.add_argument("a")
+    tp.add_argument("b")
+    tp.add_argument("--file", required=True)
+    tp.add_argument("--max-hops", type=int, default=16)
+    tp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("scenario", help="run a BASELINE ladder scenario")
     sp.add_argument("name")

@@ -780,3 +780,55 @@ class SimEngine:
             "fwd_us": d_ab,
             "rev_us": d_ba,
         }
+
+    def trace(self, a: str, b: str, ns: str = "default",
+              max_hops: int = 16) -> dict:
+        """Traceroute-equivalent: walk the device-computed shortest path
+        from pod a to pod b hop by hop, reporting each traversed link's
+        uid and configured latency plus the path total. Multi-hop — where
+        ping probes ONE direct link, trace routes across the whole fabric
+        (the role `traceroute` plays next to `ping` in the reference's
+        manual test workflow)."""
+        from kubedtn_tpu.ops import routing as R
+
+        akey, bkey = f"{ns}/{a}", f"{ns}/{b}"
+        with self._lock:
+            # ids and state under ONE lock hold: a pod registered between
+            # the two reads would put node ids >= n_nodes into the edge
+            # arrays, which the routing gathers silently clamp
+            ids = dict(self._pod_ids)
+            state = self.state  # flushes pending control-plane ops
+        if akey not in ids or bkey not in ids:
+            return {"reachable": False, "hops": [],
+                    "error": "unknown pod(s)"}
+        n_nodes = max(ids.values()) + 1
+        dist, nh = R.recompute_routes(state, n_nodes, max_hops=max_hops)
+        nh_np = np.asarray(nh)
+        dstv = np.asarray(state.dst)
+        uid_np = np.asarray(state.uid)
+        lat = np.asarray(state.props[:, es.P_LATENCY_US])
+        names = {v: k for k, v in ids.items()}
+        cur, goal = ids[akey], ids[bkey]
+        reachable = bool(np.isfinite(np.asarray(dist[cur, goal])))
+        hops = []
+        total = 0.0
+        if reachable:
+            # a reachable shortest path has < n_nodes edges; the bound
+            # guards the walk against float-tie pathologies in nh
+            for _ in range(n_nodes):
+                if cur == goal:
+                    break
+                edge = int(nh_np[cur, goal])
+                assert edge >= 0, "finite dist but no next hop"
+                nxt = int(dstv[edge])
+                total += float(lat[edge])
+                hops.append({
+                    "from": names.get(cur, str(cur)),
+                    "to": names.get(nxt, str(nxt)),
+                    "uid": int(uid_np[edge]),
+                    "latency_us": float(lat[edge]),
+                })
+                cur = nxt
+            assert cur == goal, "next-hop walk diverged from dist"
+        return {"reachable": reachable, "hops": hops,
+                "total_latency_us": total}

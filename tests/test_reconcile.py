@@ -552,3 +552,89 @@ class TestPlacementGeneration:
         rec.drain()
         assert engine.row_of("default/r1", 1) is not None
         assert engine.row_of("default/r2", 1) is not None
+
+
+class TestTrace:
+    def test_multihop_line(self):
+        from kubedtn_tpu.api.types import Link, LinkProperties, Topology, \
+            TopologySpec
+
+        store = TopologyStore()
+        engine = SimEngine(store, capacity=64)
+        props = LinkProperties(latency="5ms")
+        names = ["n0", "n1", "n2", "n3"]
+        specs = {n: [] for n in names}
+        for uid, (a, b) in enumerate(zip(names, names[1:]), start=1):
+            specs[a].append(Link(local_intf=f"e{uid}a", peer_intf=f"e{uid}b",
+                                 peer_pod=b, uid=uid, properties=props))
+            specs[b].append(Link(local_intf=f"e{uid}b", peer_intf=f"e{uid}a",
+                                 peer_pod=a, uid=uid, properties=props))
+        for n in names:
+            store.create(Topology(name=n, spec=TopologySpec(links=specs[n])))
+        for n in names:
+            engine.setup_pod(n)
+        Reconciler(store, engine).drain()
+
+        out = engine.trace("n0", "n3")
+        assert out["reachable"] is True
+        assert [h["to"] for h in out["hops"]] == [
+            "default/n1", "default/n2", "default/n3"]
+        assert [h["uid"] for h in out["hops"]] == [1, 2, 3]
+        assert out["total_latency_us"] == 15_000.0
+
+        # reverse direction works and unknown pods don't
+        back = engine.trace("n3", "n0")
+        assert back["reachable"] and len(back["hops"]) == 3
+        assert engine.trace("n0", "ghost")["reachable"] is False
+
+    def test_unreachable_after_cut(self):
+        from kubedtn_tpu.api.types import Link, LinkProperties, Topology, \
+            TopologySpec
+
+        store = TopologyStore()
+        engine = SimEngine(store, capacity=64)
+        props = LinkProperties(latency="1ms")
+        specs = {"x": [Link(local_intf="e1a", peer_intf="e1b", peer_pod="y",
+                            uid=1, properties=props)],
+                 "y": [Link(local_intf="e1b", peer_intf="e1a", peer_pod="x",
+                            uid=1, properties=props)]}
+        for n in ("x", "y"):
+            store.create(Topology(name=n, spec=TopologySpec(links=specs[n])))
+            engine.setup_pod(n)
+        rec = Reconciler(store, engine)
+        rec.drain()
+        assert engine.trace("x", "y")["reachable"] is True
+        # cut: drop the link from x's spec
+        t = store.get("default", "x")
+        t.spec.links = []
+        store.update(t)
+        rec.drain()
+        out = engine.trace("x", "y")
+        assert out["reachable"] is False and out["hops"] == []
+
+    def test_path_of_exactly_max_hops_is_reachable(self):
+        """Regression: a path of exactly max_hops edges must report
+        reachable (reachability comes from the dist matrix, not from
+        exhausting the walk loop)."""
+        from kubedtn_tpu.api.types import Link, LinkProperties, Topology, \
+            TopologySpec
+
+        n = 17  # 16 hops end to end
+        store = TopologyStore()
+        engine = SimEngine(store, capacity=128)
+        names = [f"m{i}" for i in range(n)]
+        specs = {p: [] for p in names}
+        for uid, (a, b) in enumerate(zip(names, names[1:]), start=1):
+            props = LinkProperties(latency="1ms")
+            specs[a].append(Link(local_intf=f"e{uid}a", peer_intf=f"e{uid}b",
+                                 peer_pod=b, uid=uid, properties=props))
+            specs[b].append(Link(local_intf=f"e{uid}b", peer_intf=f"e{uid}a",
+                                 peer_pod=a, uid=uid, properties=props))
+        for p in names:
+            store.create(Topology(name=p, spec=TopologySpec(links=specs[p])))
+            engine.setup_pod(p)
+        Reconciler(store, engine).drain()
+        out = engine.trace("m0", "m16", max_hops=16)
+        assert out["reachable"] is True
+        assert len(out["hops"]) == 16
+        assert out["total_latency_us"] == 16_000.0
