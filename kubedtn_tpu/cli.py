@@ -103,6 +103,10 @@ def cmd_scenario(args) -> int:
     from kubedtn_tpu.scenarios import LADDER
 
     if args.name == "all":
+        if args.param:
+            print("-p overrides are per-scenario; not supported with "
+                  "'all'", file=sys.stderr)
+            return 1
         for name, fn in LADDER.items():
             print(json.dumps(_json_safe(fn())))
         return 0
@@ -110,7 +114,17 @@ def cmd_scenario(args) -> int:
         print(f"unknown scenario {args.name}; "
               f"choices: {', '.join(LADDER)} or all", file=sys.stderr)
         return 1
-    print(json.dumps(_json_safe(LADDER[args.name]())))
+    fn = LADDER[args.name]
+    import inspect
+
+    try:
+        kwargs = _coerce_params(fn, args.param)
+        out = fn(**kwargs)
+    except (TypeError, ValueError, AssertionError) as e:
+        print(f"scenario {args.name}: {e}\nsignature: "
+              f"{args.name}{inspect.signature(fn)}", file=sys.stderr)
+        return 1
+    print(json.dumps(_json_safe(out)))
     return 0
 
 
@@ -256,6 +270,47 @@ def cmd_physical_join(args) -> int:
     return 0 if resp.response else 1
 
 
+def _coerce_params(fn, params):
+    """-p k=v strings → kwargs coerced by fn's signature: annotation
+    first (tuple dims as 4x4x2, str passthrough), then the default
+    value's type, then int/float/str guessing. One convention shared by
+    `gen` and `scenario`. Raises ValueError on unknown names."""
+    import inspect
+
+    sig = inspect.signature(fn)
+    kwargs = {}
+    for kv in params or []:
+        k, _, v = kv.partition("=")
+        if k not in sig.parameters:
+            raise ValueError(
+                f"no parameter {k!r}; choices: {', '.join(sig.parameters)}")
+        ann = str(sig.parameters[k].annotation)
+        default = sig.parameters[k].default
+        if "tuple" in ann or "list" in ann:  # torus dims as 4x4x2
+            kwargs[k] = tuple(int(x) for x in v.split("x"))
+        elif "bool" in ann or isinstance(default, bool):
+            kwargs[k] = v.lower() in ("1", "true", "yes")
+        elif "str" in ann:
+            kwargs[k] = v
+        elif "float" in ann:
+            kwargs[k] = float(v)
+        elif "int" in ann:
+            kwargs[k] = int(v)
+        elif isinstance(default, int):
+            kwargs[k] = int(v)
+        elif isinstance(default, float):
+            kwargs[k] = float(v)
+        else:
+            try:
+                kwargs[k] = int(v)
+            except ValueError:
+                try:
+                    kwargs[k] = float(v)
+                except ValueError:
+                    kwargs[k] = v
+    return kwargs
+
+
 def cmd_gen(args) -> int:
     """Generate a topology-model family as Topology CR YAML (stdout or
     file) — the generated-scenario counterpart of the reference's
@@ -272,35 +327,8 @@ def cmd_gen(args) -> int:
     import inspect
 
     sig = inspect.signature(fam)
-
-    def convert(name: str, v: str):
-        """Coerce a -p value by the generator's own annotation, so
-        string-typed params (rate="100Mbit") survive and numeric ones
-        parse — no per-family special cases in the CLI."""
-        ann = ""
-        if name in sig.parameters:
-            ann = str(sig.parameters[name].annotation)
-        if "tuple" in ann or "list" in ann:  # torus dims as 4x4x2
-            return tuple(int(x) for x in v.split("x"))
-        if "str" in ann:
-            return v
-        if "float" in ann:
-            return float(v)
-        if "int" in ann:
-            return int(v)
-        try:
-            return int(v)
-        except ValueError:
-            try:
-                return float(v)
-            except ValueError:
-                return v
-
     try:
-        kwargs = {}
-        for kv in args.param or []:
-            k, _, v = kv.partition("=")
-            kwargs[k] = convert(k, v)
+        kwargs = _coerce_params(fam, args.param)
         el = fam(**kwargs)
     except (TypeError, ValueError, AssertionError) as e:
         print(f"gen {args.family}: {e}\nsignature: "
@@ -428,6 +456,8 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("scenario", help="run a BASELINE ladder scenario")
     sp.add_argument("name")
+    sp.add_argument("-p", "--param", action="append", metavar="k=v",
+                    help="scenario kwargs, e.g. -p n_spine=20 -p workers=8")
     sp.set_defaults(fn=cmd_scenario)
 
     # Env-var defaults keep the reference daemon's config surface
