@@ -12,6 +12,7 @@ update path measured standalone.
 5. clos_100k       — 100k-link Clos with loss+jitter and packet queues
 6. reconcile_100k  — reconcile-to-steady through the real control path
 7. scale_1m        — 1M-link Clos: full-fabric updates + shaping on device
+8. chaos_flaps     — link flaps under routed traffic, reconvergence
 """
 
 from __future__ import annotations
@@ -403,6 +404,120 @@ def scale_1m(n_spine: int = 200, n_leaf: int = 2500,
     }
 
 
+def chaos_flaps(n_nodes: int = 500, n_links: int = 1500, events: int = 4,
+                flaps_per_event: int = 10, steps_per_event: int = 30,
+                dt_us: float = 1000.0, rate_bps: float = 2e6,
+                seed: int = 3):
+    """Rung 8: fault-injection chaos — random link flaps under live
+    routed traffic.
+
+    The emulated faults ARE the reference's product (loss/reorder/... as
+    link properties); this rung injects the failure mode those properties
+    can't express — whole links dying — and measures the recovery story:
+    per event, `flaps_per_event` random links go down (both directions),
+    routes reconverge as ONE batched device recompute (the BGP-withdraw
+    storm analogue), routed CBR traffic keeps flowing, then the links
+    come back and routes reconverge again. Reports per-event recompute
+    times, delivery continuity, and packets lost to dead routes.
+    """
+    import dataclasses as dc
+
+    t0 = time.perf_counter()
+    el = T.random_mesh(n_nodes, n_links, seed=seed,
+                       props=LinkProperties(latency="1ms"))
+    state, rows = T.load_edge_list_into_state(el)
+    n_dir = len(rows)
+    _, nh = R.recompute_routes(state, n_nodes, max_hops=12)
+
+    from kubedtn_tpu import router as RT
+
+    # slot budget sized to the offered load (generate() clamps emissions
+    # to k_slots per step: too few would silently cap rate_bps)
+    k_slots = min(8, max(2, int(np.ceil(rate_bps * dt_us / 8e6 / 1500.0))
+                         + 1))
+    k_fwd = 8
+    rs = RT.init_router(state, nh, n_nodes, q=16, k_fwd=k_fwd)
+    spec = cbr_everywhere(state.capacity, n_dir, rate_bps=rate_bps)
+    # every edge-sourced flow targets a random far node: multi-hop load
+    rng = np.random.default_rng(seed + 1)
+    fdst = np.full((state.capacity,), -1, np.int32)
+    fdst[:n_dir] = rng.integers(0, n_nodes, n_dir)
+    flow_dst = jnp.asarray(fdst)
+
+    # original (uid, src, dst, props) of each directed row, for restore
+    src0, dst0, uid0, props0 = el.directed()
+
+    phase = [0]
+
+    def run(rs, steps):
+        before_rx = float(np.asarray(rs.node_rx_packets).sum())
+        before_tx = float(np.asarray(rs.sim.counters.tx_packets).sum())
+        before_nr = float(rs.no_route_dropped)
+        phase[0] += 1
+        # run_routed's module-level scan caches compilation across phases
+        rs = RT.run_routed(rs, spec, flow_dst, steps=steps, dt_us=dt_us,
+                           k_slots=k_slots, k_fwd=k_fwd,
+                           seed=seed * 1000 + phase[0])
+        jax.block_until_ready(rs.node_rx_packets)
+        return rs, {
+            "tx": float(np.asarray(rs.sim.counters.tx_packets).sum())
+            - before_tx,
+            "rx": float(np.asarray(rs.node_rx_packets).sum()) - before_rx,
+            "no_route": float(rs.no_route_dropped) - before_nr,
+        }
+
+    rs, baseline = run(rs, steps_per_event)
+    event_rows = []
+    for ev in range(events):
+        flap = rng.choice(el.n_links, flaps_per_event, replace=False)
+        both = np.concatenate([flap, flap + el.n_links]).astype(np.int32)
+        edges = es.delete_links(rs.sim.edges, jnp.asarray(both),
+                                jnp.ones(len(both), bool))
+        tb = time.perf_counter()
+        _, nh = R.recompute_routes(edges, n_nodes, max_hops=12)
+        jax.block_until_ready(nh)
+        down_recompute_s = time.perf_counter() - tb
+        rs = dc.replace(rs, sim=dc.replace(rs.sim, edges=edges),
+                        next_edge=nh)
+        rs, down = run(rs, steps_per_event)
+
+        # restore: re-apply the original rows, reconverge
+        edges = es.apply_links(
+            rs.sim.edges, jnp.asarray(both), jnp.asarray(uid0[both]),
+            jnp.asarray(src0[both]), jnp.asarray(dst0[both]),
+            jnp.asarray(props0[both]), jnp.ones(len(both), bool))
+        tb = time.perf_counter()
+        _, nh = R.recompute_routes(edges, n_nodes, max_hops=12)
+        jax.block_until_ready(nh)
+        up_recompute_s = time.perf_counter() - tb
+        rs = dc.replace(rs, sim=dc.replace(rs.sim, edges=edges),
+                        next_edge=nh)
+        rs, up = run(rs, steps_per_event)
+        event_rows.append({
+            "flapped_links": int(len(flap)),
+            "down_recompute_s": round(down_recompute_s, 4),
+            "up_recompute_s": round(up_recompute_s, 4),
+            "rx_during_outage": down["rx"],
+            "no_route_during_outage": down["no_route"],
+            "rx_after_restore": up["rx"],
+        })
+
+    return {
+        "scenario": "chaos_flaps",
+        "nodes": n_nodes,
+        "links": n_links,
+        "events": events,
+        "baseline_rx": baseline["rx"],
+        "baseline_no_route": baseline["no_route"],
+        "event_results": event_rows,
+        "recompute_s_median": round(float(np.median(
+            [e["down_recompute_s"] for e in event_rows])), 4),
+        "traffic_survived_every_outage": all(
+            e["rx_during_outage"] > 0 for e in event_rows),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 LADDER = {
     "3node": three_node,
     "fat_tree_64": fat_tree_64,
@@ -411,4 +526,5 @@ LADDER = {
     "clos_100k": clos_100k,
     "reconcile_100k": reconcile_100k,
     "scale_1m": scale_1m,
+    "chaos_flaps": chaos_flaps,
 }

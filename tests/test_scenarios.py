@@ -48,3 +48,16 @@ def test_scale_scenario_small_scale():
     assert r["directed_rows"] == 160
     assert r["updates_per_sec"] > 0
     assert r["shape_pkts_per_sec"] > 0
+
+
+def test_chaos_scenario_small_scale():
+    """chaos_flaps: link flaps under routed traffic — routes reconverge
+    and traffic keeps flowing through every outage."""
+    r = S.chaos_flaps(n_nodes=40, n_links=140, events=2,
+                      flaps_per_event=4, steps_per_event=10)
+    assert r["events"] == 2 and len(r["event_results"]) == 2
+    assert r["baseline_rx"] > 0
+    assert r["traffic_survived_every_outage"] is True
+    for ev in r["event_results"]:
+        assert ev["down_recompute_s"] >= 0
+        assert ev["rx_after_restore"] > 0
