@@ -107,9 +107,27 @@ def _arrays_to_npz(path: str, obj) -> None:
 
 
 def save(path: str, store: TopologyStore, engine: SimEngine,
-         sim=None) -> None:
-    """Write a checkpoint directory (created if needed)."""
+         sim=None, dataplane=None) -> None:
+    """Write a checkpoint directory (created if needed). With
+    `dataplane`, in-flight delay-line frames are persisted too
+    (save_pending) so a restarted daemon completes their remaining
+    delays."""
     os.makedirs(path, exist_ok=True)
+    if dataplane is not None:
+        if getattr(dataplane, "running", False):
+            # a live runner can release exported frames (duplicate on
+            # restore) or shape new ones after the export (lost): the
+            # checkpoint must be a consistent point-in-time cut
+            raise RuntimeError(
+                "stop() the data plane before checkpointing its pending "
+                "frames")
+        save_pending(path, dataplane)
+    else:
+        # a reused checkpoint directory must not keep an earlier save's
+        # pending file: restoring it would re-deliver long-gone frames
+        stale = os.path.join(path, "pending_frames.npz")
+        if os.path.exists(stale):
+            os.remove(stale)
     manifest = {
         "format_version": FORMAT_VERSION,
         "node_ip": engine.node_ip,
@@ -169,6 +187,51 @@ def load(path: str) -> tuple[TopologyStore, SimEngine]:
     engine._free = [int(x) for x in eng["free"]]
     engine._topology_manager = set(eng["alive"])
     return store, engine
+
+
+def save_pending(path: str, dataplane) -> int:
+    """Persist the data plane's in-flight frames (pickle-free npz) —
+    the delay-line analogue of kernel qdisc queues surviving a daemon
+    restart in the reference. Returns the frame count."""
+    entries = dataplane.export_pending()
+    blob = b"".join(frame for _, _, frame, _ in entries)
+    offs, lens, pos = [], [], 0
+    for _, _, frame, _ in entries:
+        offs.append(pos)
+        lens.append(len(frame))
+        pos += len(frame)
+    np.savez_compressed(
+        os.path.join(path, "pending_frames.npz"),
+        pod_keys=np.frombuffer(
+            "\n".join(pk for pk, _, _, _ in entries).encode(), np.uint8),
+        uids=np.array([u for _, u, _, _ in entries], np.int64),
+        remaining_us=np.array([r for _, _, _, r in entries], np.float64),
+        offsets=np.array(offs, np.int64),
+        lengths=np.array(lens, np.int64),
+        blob=np.frombuffer(blob, np.uint8),
+    )
+    return len(entries)
+
+
+def load_pending(path: str, dataplane, now_s: float | None = None) -> int:
+    """Re-schedule checkpointed in-flight frames with their remaining
+    delays. Returns the restored count (0 when the checkpoint carried
+    no pending file)."""
+    p = os.path.join(path, "pending_frames.npz")
+    if not os.path.exists(p):
+        return 0
+    with np.load(p) as z:
+        keys = bytes(z["pod_keys"]).decode().split("\n") if len(
+            z["pod_keys"]) else []
+        blob = bytes(z["blob"])
+        entries = [
+            (keys[i], int(z["uids"][i]),
+             blob[int(z["offsets"][i]):int(z["offsets"][i])
+                  + int(z["lengths"][i])],
+             float(z["remaining_us"][i]))
+            for i in range(len(z["uids"]))
+        ]
+    return dataplane.restore_pending(entries, now_s=now_s)
 
 
 def load_sim(path: str, engine: SimEngine):

@@ -156,7 +156,9 @@ class WireDataPlane:
         # without it every frame arrives "at t=0" while t_last marches
         # forward, and a rate-limited wire double-counts elapsed time
         self._last_shaped_s: float | None = None
-        self._pending: dict[int, tuple[str, int, bytes]] = {}
+        # token → (pod_key, uid, frame, wheel_deadline_us); the deadline
+        # mirrors the native wheel so pending frames are exportable
+        self._pending: dict[int, tuple[str, int, bytes, float]] = {}
         try:
             self._wheel: native.TimingWheel | None = native.TimingWheel(
                 tick_us=1000)
@@ -226,6 +228,11 @@ class WireDataPlane:
         return False
 
     @property
+    def running(self) -> bool:
+        """True while the real-time runner thread is alive."""
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
     def ring_dropped(self) -> int:
         """Frames lost to remote-stage ring overflow (bounded-memory
         backpressure, like pcap buffer drops in the reference)."""
@@ -283,6 +290,59 @@ class WireDataPlane:
             "virtual_clock_s": t,
             "wall_s": round(time.monotonic() - wall0, 3),
         }
+
+    # -- pending-frame persistence ------------------------------------
+    #
+    # In the reference, in-flight packets live in kernel qdisc queues and
+    # SURVIVE a daemon restart; here the delay line is process state, so
+    # these two methods make it checkpointable with the same guarantee:
+    # a restored frame completes its REMAINING delay, not a fresh one.
+
+    def export_pending(self) -> list[tuple[str, int, bytes, float]]:
+        """(pod_key, uid, frame, remaining_delay_us) for every frame
+        still held in the delay line."""
+        with self._tick_lock:
+            out: list[tuple[str, int, bytes, float]] = []
+            if self._wheel is not None:
+                base = self.last_now_s
+                origin = self._origin_s
+                wheel_now = (0.0 if base is None or origin is None
+                             else (base - origin) * 1e6)
+                for pk, uid, frame, deadline in self._pending.values():
+                    out.append((pk, uid, frame,
+                                max(0.0, deadline - wheel_now)))
+            else:
+                base = self.last_now_s or 0.0
+                for rel, _seq, pk, uid, frame in self._heap:
+                    out.append((pk, uid, frame,
+                                max(0.0, (rel - base) * 1e6)))
+            return out
+
+    def restore_pending(self, entries, now_s: float | None = None) -> int:
+        """Schedule exported frames to release after their remaining
+        delays, counted from `now_s` (default: the monotonic clock —
+        pass an explicit clock when driving deterministic ticks)."""
+        with self._tick_lock:
+            explicit = now_s is not None
+            if now_s is None:
+                now_s = time.monotonic()
+            if self._origin_s is None:
+                self._origin_s = now_s
+                self.last_now_s = now_s
+                self._clock_ext = explicit
+            for pk, uid, frame, rem_us in entries:
+                self._seq += 1
+                if self._wheel is not None:
+                    deadline = (now_s - self._origin_s) * 1e6 + rem_us
+                    self._pending[self._seq] = (pk, uid, bytes(frame),
+                                                deadline)
+                    self._wheel.schedule(deadline, self._seq)
+                else:
+                    heapq.heappush(
+                        self._heap,
+                        (now_s + rem_us / 1e6, self._seq, pk, uid,
+                         bytes(frame)))
+            return len(entries)
 
     def _tick_inner(self, now_s: float | None) -> int:
         # an explicit clock marks the plane as running on synthetic time
@@ -405,10 +465,13 @@ class WireDataPlane:
                         if target is not None:
                             self._seq += 1
                             if self._wheel is not None:
-                                self._pending[self._seq] = (*target, frame)
-                                self._wheel.schedule(
-                                    (now_s + delay_s - self._origin_s) * 1e6,
-                                    self._seq)
+                                deadline_us = (now_s + delay_s
+                                               - self._origin_s) * 1e6
+                                # deadline mirrored host-side so pending
+                                # frames are exportable (checkpointing)
+                                self._pending[self._seq] = (*target, frame,
+                                                            deadline_us)
+                                self._wheel.schedule(deadline_us, self._seq)
                             else:
                                 heapq.heappush(
                                     self._heap,
@@ -451,7 +514,7 @@ class WireDataPlane:
         due: list[tuple[str, int, bytes]] = []
         if self._wheel is not None:
             for token in self._wheel.advance((now_s - self._origin_s) * 1e6):
-                due.append(self._pending.pop(token))
+                due.append(self._pending.pop(token)[:3])
         else:
             while self._heap and self._heap[0][0] <= now_s:
                 _, _, pod_key, uid, frame = heapq.heappop(self._heap)

@@ -9,6 +9,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kubedtn_tpu import checkpoint
 from kubedtn_tpu.api.types import Link, LinkProperties, load_yaml
@@ -225,3 +226,88 @@ def test_daemon_restart_resumes_shaping_e2e(tmp_path):
         assert list(w2.egress) == [frame]
     finally:
         server2.stop(0)
+
+
+def test_pending_frames_survive_daemon_restart(tmp_path):
+    """In the reference, in-flight packets live in kernel qdisc queues
+    and survive a daemon restart; here the delay line checkpoints: a
+    restored frame completes its REMAINING delay, not a fresh one."""
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    def build_cluster():
+        store = TopologyStore()
+        engine = SimEngine(store, capacity=64)
+        props = LinkProperties(latency="500ms")
+        from kubedtn_tpu.api.types import Topology, TopologySpec
+        for name, peer in (("a", "b"), ("b", "a")):
+            t = Topology(name=name, spec=TopologySpec(links=[
+                Link(local_intf="eth1", peer_intf="eth1", peer_pod=peer,
+                     uid=1, properties=props)]))
+            t.status.src_ip, t.status.net_ns = "10.0.0.1", f"/ns/{name}"
+            t.status.links = []
+            store.create(t)
+        Reconciler(store, engine).drain()
+        daemon = Daemon(engine)
+        wa = daemon._add_wire(pb.WireDef(local_pod_name="a",
+                                         kube_ns="default", link_uid=1,
+                                         intf_name_in_pod="eth1"))
+        wb = daemon._add_wire(pb.WireDef(local_pod_name="b",
+                                         kube_ns="default", link_uid=1,
+                                         intf_name_in_pod="eth1"))
+        return store, engine, daemon, wa, wb
+
+    store, engine, daemon, wa, wb = build_cluster()
+    plane = WireDataPlane(daemon, dt_us=10_000.0)
+    frame = b"\xee" * 77
+    daemon._frame_in(wa, frame)
+    plane.tick(now_s=0.0)       # shaped: 500ms of delay scheduled
+    plane.tick(now_s=0.2)       # 200ms elapsed, 300ms remain
+    assert len(wb.egress) == 0
+
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, store, engine, dataplane=plane)
+    exported = plane.export_pending()
+    assert len(exported) == 1
+    assert exported[0][3] == pytest.approx(300_000.0, abs=11_000)
+
+    # "restart": brand-new daemon + plane, restore from disk
+    store2, engine2, daemon2, wa2, wb2 = build_cluster()
+    plane2 = WireDataPlane(daemon2, dt_us=10_000.0)
+    n = checkpoint.load_pending(path, plane2, now_s=100.0)
+    assert n == 1
+    # 200ms later: still held (remaining was ~300ms)
+    plane2.tick(now_s=100.2)
+    assert len(wb2.egress) == 0
+    # past the remaining delay: delivered
+    plane2.tick(now_s=100.35)
+    assert list(wb2.egress) == [frame]
+
+
+def test_pending_checkpoint_guards(tmp_path):
+    """save() refuses a live runner (non-atomic cut) and a dataplane-less
+    re-save removes a stale pending file instead of resurrecting it."""
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon)
+    path = str(tmp_path / "ckpt")
+
+    plane.start()
+    try:
+        with pytest.raises(RuntimeError, match="stop"):
+            checkpoint.save(path, store, engine, dataplane=plane)
+    finally:
+        plane.stop()
+
+    checkpoint.save(path, store, engine, dataplane=plane)
+    import os
+    assert os.path.exists(os.path.join(path, "pending_frames.npz"))
+    checkpoint.save(path, store, engine)  # no dataplane: stale file goes
+    assert not os.path.exists(os.path.join(path, "pending_frames.npz"))
+    plane2 = WireDataPlane(Daemon(engine))
+    assert checkpoint.load_pending(path, plane2) == 0
