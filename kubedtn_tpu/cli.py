@@ -158,8 +158,20 @@ def cmd_daemon(args) -> int:
     if args.metrics_port is None:
         args.metrics_port = _env_port("HTTP_ADDR", 51112)
 
-    store = TopologyStore()
-    engine = SimEngine(store, node_ip=args.node_ip)
+    ckpt_dir = getattr(args, "checkpoint_dir", None)
+    if ckpt_dir:
+        from kubedtn_tpu import checkpoint
+    if ckpt_dir and os.path.exists(os.path.join(ckpt_dir, "manifest.json")):
+        # warm restart: topologies, realized links, and (below) the
+        # delay line's in-flight frames all come back
+        store, engine = checkpoint.load(ckpt_dir)
+        engine.node_ip = args.node_ip
+        log.info("restored from checkpoint %s", fields(
+            path=ckpt_dir, topologies=len(store.list()),
+            links=engine.num_active))
+    else:
+        store = TopologyStore()
+        engine = SimEngine(store, node_ip=args.node_ip)
     daemon = Daemon(engine)
     if getattr(args, "capture", None):
         from kubedtn_tpu.utils.pcap import CaptureManager
@@ -168,6 +180,15 @@ def cmd_daemon(args) -> int:
         daemon.capture.open(args.capture)
         log.info("capture on %s", fields(path=args.capture))
     dataplane = WireDataPlane(daemon)
+    if ckpt_dir:
+        n_pending = checkpoint.load_pending(ckpt_dir, dataplane)
+        if n_pending:
+            log.info("restored in-flight frames %s", fields(n=n_pending))
+        # consume the pending file once restored: a crash before the next
+        # graceful checkpoint must NOT re-deliver these frames again
+        stale = os.path.join(ckpt_dir, "pending_frames.npz")
+        if os.path.exists(stale):
+            os.remove(stale)
     registry, hist = make_registry(engine,
                                    sim_counters_fn=dataplane.counters_fn)
     engine.stats.observer = hist
@@ -177,26 +198,38 @@ def cmd_daemon(args) -> int:
     metrics.start()
     server.start()
     dataplane.start()
-    log.info("daemon up %s", fields(grpc_port=port,
-                                    metrics_port=metrics.port,
-                                    node_ip=args.node_ip))
-    print(f"kubedtn-tpu daemon: gRPC on :{port}, "
-          f"metrics on :{metrics.port}/metrics", flush=True)
+    import signal as _signal
+
+    def _on_term(*_):
+        # a second SIGTERM during cleanup must not abort it
+        _signal.signal(_signal.SIGTERM, _signal.SIG_IGN)
+        raise KeyboardInterrupt
+
     try:
         # a DaemonSet pod stop is SIGTERM, not Ctrl-C: route it through
-        # the same graceful-shutdown path (capture close, plane stop)
-        import signal as _signal
-
-        def _on_term(*_):
-            # a second SIGTERM during cleanup must not abort it
-            _signal.signal(_signal.SIGTERM, _signal.SIG_IGN)
-            raise KeyboardInterrupt
-
+        # the same graceful-shutdown path (checkpoint, capture close,
+        # plane stop). Registered inside the try and BEFORE the ready
+        # line, so a supervisor reacting to that line can never land a
+        # TERM that escapes the cleanup below.
         _signal.signal(_signal.SIGTERM, _on_term)
+        log.info("daemon up %s", fields(grpc_port=port,
+                                        metrics_port=metrics.port,
+                                        node_ip=args.node_ip))
+        print(f"kubedtn-tpu daemon: gRPC on :{port}, "
+              f"metrics on :{metrics.port}/metrics", flush=True)
         server.wait_for_termination()
     except KeyboardInterrupt:
         server.stop(0)
         dataplane.stop()
+        if ckpt_dir:
+            try:
+                checkpoint.save(ckpt_dir, store, engine,
+                                dataplane=dataplane)
+                log.info("checkpoint written %s", fields(path=ckpt_dir))
+            except Exception:
+                # a full disk must not abort the remaining cleanup
+                log.exception("checkpoint save failed %s",
+                              fields(path=ckpt_dir))
         if daemon.capture is not None:
             daemon.capture.close_all()
         metrics.stop()
@@ -472,6 +505,10 @@ def main(argv=None) -> int:
     dp.add_argument("--capture", default=None, metavar="PCAP",
                     help="record all wire traffic to this pcap file "
                          "(tcpdump/wireshark-readable)")
+    dp.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                    help="restore state from DIR on boot (if present) and "
+                         "checkpoint to it on shutdown, incl. in-flight "
+                         "delay-line frames")
     dp.set_defaults(fn=cmd_daemon)
 
     pcp = sub.add_parser("pcap", help="summarize a capture file")

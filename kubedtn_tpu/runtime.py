@@ -171,6 +171,12 @@ class WireDataPlane:
         except native.NativeUnavailable:
             self._flowtable = None
         self._remote = _RemoteStage()
+        # released frames whose wire isn't registered YET (a restarted
+        # daemon releases restored frames before pods re-attach their
+        # wires): retried each release until the grace expires
+        self._orphans: deque[tuple[float, str, int, bytes]] = deque()
+        self.orphan_grace_s = 30.0
+        self.undeliverable = 0  # orphans whose wire never came back
         self._stop = threading.Event()
         # set by the daemon whenever ingress queues: the runner wakes and
         # ticks immediately instead of sleeping out the period
@@ -519,11 +525,26 @@ class WireDataPlane:
             while self._heap and self._heap[0][0] <= now_s:
                 _, _, pod_key, uid, frame = heapq.heappop(self._heap)
                 due.append((pod_key, uid, frame))
+        if self._orphans:
+            # wires that appeared since last release get their waiting
+            # frames; expired waits are counted, never silently dropped
+            keep: deque[tuple[float, str, int, bytes]] = deque()
+            while self._orphans:
+                expire, pk, uid, frame = self._orphans.popleft()
+                if self.daemon.wires.get_by_key(pk, uid) is not None:
+                    due.append((pk, uid, frame))
+                elif now_s < expire:
+                    keep.append((expire, pk, uid, frame))
+                else:
+                    self.undeliverable += 1
+            self._orphans = keep
         staged = False
         ring_drops: dict[int, int] = {}
         for pod_key, uid, frame in due:
             wire = self.daemon.wires.get_by_key(pod_key, uid)
             if wire is None:
+                self._orphans.append(
+                    (now_s + self.orphan_grace_s, pod_key, uid, frame))
                 continue
             if wire.peer_ip:
                 # stage for the per-peer stream batch below

@@ -311,3 +311,36 @@ def test_pending_checkpoint_guards(tmp_path):
     assert not os.path.exists(os.path.join(path, "pending_frames.npz"))
     plane2 = WireDataPlane(Daemon(engine))
     assert checkpoint.load_pending(path, plane2) == 0
+
+
+def test_restored_frames_wait_for_wire_reattach(tmp_path):
+    """A restored frame released before its pod re-attaches a wire waits
+    in the orphan queue (grace window) and delivers once the wire
+    re-registers; an expired wait is counted, never silently dropped."""
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=10_000.0)
+    # restore a frame due in 50ms for a (pod, uid) with NO wire yet
+    plane.restore_pending([("default/a", 1, b"\xab" * 64, 50_000.0)],
+                          now_s=0.0)
+    plane.tick(now_s=0.1)  # due, but no wire: orphaned, not dropped
+    assert plane.undeliverable == 0
+    # the pod re-attaches its wire (the reconnect flow after restart)
+    wa = daemon._add_wire(pb.WireDef(local_pod_name="a", kube_ns="default",
+                                     link_uid=1, intf_name_in_pod="eth1"))
+    plane.tick(now_s=0.2)
+    assert list(wa.egress) == [b"\xab" * 64]
+    assert plane.undeliverable == 0
+
+    # expiry path: grace elapses with no wire -> counted
+    plane.restore_pending([("default/ghost", 9, b"\xcd" * 32, 10_000.0)],
+                          now_s=1.0)
+    plane.orphan_grace_s = 0.05
+    plane.tick(now_s=1.1)   # due, orphaned with 50ms grace
+    plane.tick(now_s=1.3)   # grace expired
+    assert plane.undeliverable == 1
