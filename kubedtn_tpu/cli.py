@@ -190,7 +190,8 @@ def cmd_daemon(args) -> int:
         if os.path.exists(stale):
             os.remove(stale)
     registry, hist = make_registry(engine,
-                                   sim_counters_fn=dataplane.counters_fn)
+                                   sim_counters_fn=dataplane.counters_fn,
+                                   dataplane=dataplane)
     engine.stats.observer = hist
     daemon.hist = hist
     server, port = make_server(daemon, port=args.port)

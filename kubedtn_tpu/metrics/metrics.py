@@ -148,6 +148,47 @@ class InterfaceStatsCollector:
         return out
 
 
+class DataPlaneStatsCollector:
+    """kubedtn_dataplane_* counters from the wire data plane — the
+    runtime-health series the reference has no analogue for (its data
+    plane is kernel state): tick/shaping volume, bypass hits, orphaned
+    releases, peer-forward errors, and ring backpressure drops."""
+
+    SERIES = (
+        ("ticks", "Data-plane ticks executed"),
+        ("shaped", "Frames shaped through the netem/TBF chain"),
+        ("dropped", "Frames dropped by shaping (loss/queue)"),
+        ("bypassed", "Frames that skipped shaping via the TCP/IP bypass"),
+        ("undeliverable",
+         "Released frames whose wire never re-registered within grace"),
+        ("forward_errors", "Failed per-frame forwards to peer daemons"),
+        ("ring_dropped", "Frames lost to remote-stage ring overflow"),
+        ("tick_errors", "Tick failures survived by the runner"),
+    )
+
+    def __init__(self, plane) -> None:
+        self._plane = plane
+
+    def collect(self):
+        plane = self._plane
+        values = {
+            "ticks": plane.ticks,
+            "shaped": plane.shaped,
+            "dropped": plane.dropped,
+            "bypassed": plane.bypassed,
+            "undeliverable": plane.undeliverable,
+            "forward_errors": plane.daemon.forward_errors,
+            "ring_dropped": plane.ring_dropped,
+            "tick_errors": plane.tick_errors,
+        }
+        out = []
+        for name, doc in self.SERIES:
+            g = CounterMetricFamily(f"kubedtn_dataplane_{name}", doc)
+            g.add_metric([], float(values[name]))
+            out.append(g)
+        return out
+
+
 class MetricsServer:
     """Serves the registry on an HTTP port — the daemon's :51112/metrics
     endpoint (reference daemon/main.go:57-66)."""
@@ -189,11 +230,13 @@ class MetricsServer:
 
 
 def make_registry(engine=None, sim_counters_fn=None,
-                  max_interfaces: int = 10_000):
+                  max_interfaces: int = 10_000, dataplane=None):
     """Registry with the parity collectors installed."""
     registry = CollectorRegistry()
     hist = LatencyHistograms(registry)
     if engine is not None:
         registry.register(InterfaceStatsCollector(
             engine, sim_counters_fn, max_interfaces=max_interfaces))
+    if dataplane is not None:
+        registry.register(DataPlaneStatsCollector(dataplane))
     return registry, hist

@@ -128,3 +128,38 @@ def test_node_totals_exclude_deleted_links():
     engine.destroy_pod("r1")  # removes r1's link ends (rows keep counters)
     after = node_tx(generate_latest(registry).decode())
     assert after < before
+
+
+def test_dataplane_stats_series():
+    """kubedtn_dataplane_* counters track the wire plane's runtime
+    health (no reference analogue — its data plane is kernel state)."""
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    engine, sim = build_cluster_with_traffic()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=1000.0)
+    w1 = daemon._add_wire(pb.WireDef(local_pod_name="r1",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    daemon._add_wire(pb.WireDef(local_pod_name="r2", kube_ns="default",
+                                link_uid=1, intf_name_in_pod="eth1"))
+    daemon._frame_in(w1, b"\x01" * 60)
+    t = 0.0
+    for _ in range(20):
+        plane.tick(now_s=t)
+        t += 0.001
+    registry, _ = make_registry(engine, lambda: sim.counters,
+                                dataplane=plane)
+    text = generate_latest(registry).decode()
+
+    def val(name):
+        line = [l for l in text.splitlines()
+                if l.startswith(f"kubedtn_dataplane_{name}_total ")][0]
+        return float(line.rsplit(" ", 1)[1])
+
+    assert val("ticks") == 20.0
+    assert val("shaped") == 1.0
+    assert val("undeliverable") == 0.0
+    assert val("tick_errors") == 0.0
