@@ -310,6 +310,29 @@ def contiguous_window(rows, valid, capacity: int) -> bool:
     return bool(np.all(~valid | (rows == expect)))
 
 
+@functools.partial(jax.jit, donate_argnums=0)
+def compact_state(state: EdgeState, perm: jax.Array,
+                  n_active: jax.Array) -> EdgeState:
+    """Repack rows so the active set occupies [0, n_active).
+
+    perm: i32[capacity] — perm[i] is the OLD row landing at new row i for
+    i < n_active; entries beyond n_active may be anything (their rows are
+    reset to inactive/defaults). One gather per array; the host remaps
+    its registries with the same permutation (SimEngine.compact).
+    Defragmentation keeps whole-drain update batches on the contiguous
+    streaming fast path after heavy churn (SURVEY §7 hard part (a)).
+    """
+    fresh = init_state(state.capacity)
+    live = jnp.arange(state.capacity) < n_active
+
+    def take(old, new):
+        moved = old[perm]
+        mask = live.reshape((-1,) + (1,) * (moved.ndim - 1))
+        return jnp.where(mask, moved, new)
+
+    return jax.tree.map(take, state, fresh)
+
+
 def grow_state(state: EdgeState, new_capacity: int) -> EdgeState:
     """Reallocate at a larger static capacity (host-side, amortized).
 

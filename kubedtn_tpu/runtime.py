@@ -144,8 +144,10 @@ class WireDataPlane:
         self._heap: list = []          # (release_s, seq, pod_key, uid, frame)
         self._seq = 0
         # one tick at a time; the ENGINE lock is held only for snapshot
-        # and write-back, never across device dispatch
-        self._tick_lock = threading.Lock()
+        # and write-back, never across device dispatch. Re-entrant: a
+        # compact() triggered from code already inside a tick (its
+        # counter-remap callback takes this lock) must not self-deadlock
+        self._tick_lock = threading.RLock()
         # wheel time is µs since the first tick's clock (which may be the
         # wall clock or a synthetic test clock); token → payload map held
         # Python-side, the wheel orders and releases
@@ -185,6 +187,9 @@ class WireDataPlane:
         self._thread: threading.Thread | None = None
         self.counters: EdgeCounters = init_counters(
             self.engine.state.capacity)
+        # engine.compact() renumbers rows; the cumulative per-row
+        # counters must follow them
+        self.engine.on_rows_remapped(self._on_rows_remapped)
         self.ticks = 0
         self.shaped = 0
         self.dropped = 0
@@ -360,9 +365,9 @@ class WireDataPlane:
         if self._origin_s is None:
             self._origin_s = now_s
         self.last_now_s = now_s
-        batches = self.daemon.drain_ingress(max_per_wire=self.max_slots)
+        drained = self.daemon.drain_ingress(max_per_wire=self.max_slots)
         shaped = 0
-        if batches:
+        if drained:
             engine = self.engine
             # -- snapshot under the engine lock (no device work) --------
             with engine._lock:
@@ -370,6 +375,19 @@ class WireDataPlane:
                 E = state.capacity
                 if self.counters.tx_packets.shape[0] != E:
                     self.counters = init_counters(E)  # engine grew
+                # Rows are re-resolved HERE, under the lock — the drain's
+                # row values are advisory and compact() may have
+                # renumbered rows since (shaping a batch on a stale row
+                # id would apply the wrong link's qdiscs and deliver to
+                # the wrong pod). A wire whose link vanished re-queues.
+                batches: list[tuple[int, list[int], list[bytes]]] = []
+                requeue = []
+                for wire, _row, lens, frames_list in drained:
+                    fresh = engine._rows.get((wire.pod_key, wire.uid))
+                    if fresh is None:
+                        requeue.append((wire, frames_list))
+                        continue
+                    batches.append((fresh, lens, frames_list))
                 # frames entering a directed edge exit at the PEER pod's
                 # wire (the reference writes into the peer's pod-side
                 # veth, grpcwire.go:256-271); _row_owner is maintained
@@ -383,6 +401,8 @@ class WireDataPlane:
                 # rows the control plane touches from here on keep their
                 # own dynamic state at write-back
                 engine._rows_touched.clear()
+            for wire, frames_list in requeue:
+                wire.ingress.extendleft(reversed(frames_list))
 
             # -- bypass split + shaping OUTSIDE the engine lock ---------
             kept: list[tuple[int, list[int], list[bytes]]] = []
@@ -599,6 +619,28 @@ class WireDataPlane:
     def counters_fn(self):
         """For metrics.make_registry(sim_counters_fn=...)."""
         return self.counters
+
+    def _on_rows_remapped(self, old_rows, n_active: int) -> None:
+        """Carry cumulative per-row counters through compact()'s row
+        renumbering (new row i accumulated under old_rows[i] so far)."""
+        with self._tick_lock:
+            sel = np.asarray(old_rows[:n_active], dtype=np.int64)
+            cap = self.engine.state.capacity
+
+            def permute(arr):
+                a = np.asarray(arr)
+                out = np.zeros((cap,) + a.shape[1:], a.dtype)
+                # masked SCATTER: an old row beyond the counter arrays
+                # (allocated after growth, before the next traffic tick)
+                # contributes zero at its own new position — packing at
+                # the front would shift every later row's counters onto
+                # the wrong link
+                keep = sel < a.shape[0]
+                idx = np.nonzero(keep)[0]
+                out[idx] = a[sel[keep]]
+                return out
+
+            self.counters = jax.tree.map(permute, self.counters)
 
     # -- thread --------------------------------------------------------
 

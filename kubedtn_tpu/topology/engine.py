@@ -160,6 +160,9 @@ class SimEngine:
         # placement answers cached per store placement generation
         self._placement_cache: dict[str, tuple[str, str]] = {}
         self._placement_gen: int = -1
+        # observers of compact()'s row renumbering (the data plane keeps
+        # cumulative per-row counters that must move with the rows)
+        self._remap_callbacks: list = []
         # cross-node peer-daemon dialing (reference common/utils.go:53-62,
         # "passthrough:///<nodeIP>:51111"): src_ip -> client with .Update.
         # Injectable for tests / non-default ports; cached per address.
@@ -701,6 +704,64 @@ class SimEngine:
         self._rows[k] = row
         self._row_owner[row] = k
         return row
+
+    def on_rows_remapped(self, cb) -> None:
+        """Register cb(old_rows_np, n_active): called after compact()
+        renumbers rows (new row i held old row old_rows_np[i]). Held by
+        WEAK reference: a replaced data plane must not be kept alive by
+        the engine, nor have its stale counters permuted forever."""
+        import weakref
+
+        ref = (weakref.WeakMethod(cb) if hasattr(cb, "__self__")
+               else weakref.ref(cb))
+        with self._lock:
+            self._remap_callbacks.append(ref)
+
+    def compact(self) -> dict:
+        """Repack active rows to [0, n): defragmentation after churn.
+
+        The allocator recycles freed rows LIFO, so heavy delete/add churn
+        scatters a topology's rows across capacity and whole-drain update
+        batches fall off the contiguous streaming fast path (they remain
+        correct via the scatter path, just slower). compact() restores
+        the dense layout with ONE device gather (SURVEY §7 hard part (a):
+        capacity padding + free-list compaction). Registered observers
+        (the data plane's per-row counters) are remapped OUTSIDE the
+        engine lock — a tick racing the callback may smear at most one
+        tick of counter increments across the renumbering.
+        """
+        with self._lock:
+            self._flush_device_locked()
+            items = sorted(self._rows.items())
+            n = len(items)
+            cap = self._state.capacity
+            old_rows = np.fromiter((r for _, r in items), np.int64, n)
+            perm = np.zeros((cap,), np.int32)
+            perm[:n] = old_rows
+            self._state = es.compact_state(
+                self._state, jnp.asarray(perm), jnp.int32(n))
+            mapping = {int(o): i for i, o in enumerate(old_rows)}
+            self._rows = {k: mapping[r] for k, r in self._rows.items()}
+            self._row_owner = {r: k for k, r in self._rows.items()}
+            self._shaped_rows = {mapping[r] for r in self._shaped_rows
+                                 if r in mapping}
+            self._free = list(range(cap - 1, n - 1, -1))
+            # the data plane's next write-back must not resurrect
+            # pre-compact dynamic state for any row
+            self._rows_touched = set(range(cap))
+            moved = int((old_rows != np.arange(n)).sum())
+            live = []
+            for ref in self._remap_callbacks:
+                cb = ref()
+                if cb is not None:
+                    live.append(cb)
+            self._remap_callbacks = [r for r in self._remap_callbacks
+                                     if r() is not None]
+        for cb in live:
+            cb(old_rows, n)
+        self.log.info("compact %s", _fields(action="compact", active=n,
+                                            moved=moved))
+        return {"active": n, "moved": moved}
 
     # -- queries -------------------------------------------------------
 

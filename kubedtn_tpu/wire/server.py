@@ -401,10 +401,13 @@ class Daemon:
     # -- sim ingress/egress bridge ------------------------------------
 
     def drain_ingress(self, max_per_wire: int = 64):
-        """Collect queued external frames as (row, sizes) batches for the
-        next sim step. Only wires marked hot are visited — O(wires with
-        traffic), not O(all wires); a wire left with residue (more than
-        max_per_wire queued, or no realized row yet) stays hot."""
+        """Collect queued external frames as (wire, row, sizes, frames)
+        batches for the next sim step. Only wires marked hot are visited —
+        O(wires with traffic), not O(all wires); a wire left with residue
+        (more than max_per_wire queued, or no realized row yet) stays hot.
+        The row here is advisory: the tick re-resolves every wire's row
+        under the engine lock before shaping (compact() may renumber rows
+        between this drain and the snapshot)."""
         with self._hot_lock:
             hot, self._hot = self._hot, set()
         out = []
@@ -425,7 +428,7 @@ class Daemon:
             if frames:
                 if self._classify is not None:
                     self.frame_stats.update(self._classify(frames))
-                out.append((row, [len(f) for f in frames], frames))
+                out.append((wire, row, [len(f) for f in frames], frames))
         return out
 
     def deliver_egress(self, pod_key: str, uid: int, frame: bytes) -> bool:

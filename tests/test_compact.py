@@ -1,0 +1,208 @@
+"""Row compaction: defragmentation after churn (SURVEY §7 hard part (a)).
+
+Heavy delete/add churn scatters rows across capacity (the allocator
+recycles LIFO); compact() repacks the active set to [0, n) with one
+device gather, the host registries follow, and the data plane's
+cumulative counters move with their rows.
+"""
+
+import numpy as np
+
+from kubedtn_tpu.api.types import Link, LinkProperties, Topology, TopologySpec
+from kubedtn_tpu.ops import edge_state as es
+from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+
+
+def _cluster(n_pods=8, uids_per=3):
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=128)
+    props = LinkProperties(latency="2ms")
+    names = [f"c{i}" for i in range(n_pods)]
+    specs = {p: [] for p in names}
+    uid = 0
+    for i, a in enumerate(names):
+        b = names[(i + 1) % n_pods]
+        for _ in range(uids_per):
+            uid += 1
+            specs[a].append(Link(local_intf=f"e{uid}a", peer_intf=f"e{uid}b",
+                                 peer_pod=b, uid=uid, properties=props))
+            specs[b].append(Link(local_intf=f"e{uid}b", peer_intf=f"e{uid}a",
+                                 peer_pod=a, uid=uid, properties=props))
+    for p in names:
+        store.create(Topology(name=p, spec=TopologySpec(links=specs[p])))
+    for p in names:
+        engine.setup_pod(p)
+    Reconciler(store, engine).drain()
+    return store, engine, names
+
+
+def _fragment(engine, names):
+    """Destroy/re-setup alternating pods twice: each pod's rows end up
+    scattered (the global set may stay dense — what churn breaks is the
+    PER-TOPOLOGY consecutiveness the contiguous fast path needs)."""
+    for _ in range(2):
+        for p in names[::2]:
+            engine.destroy_pod(p)
+        for p in names[::2]:
+            engine.setup_pod(p)
+
+
+def _pod_rows(engine, pod_key):
+    return np.sort(np.array([r for (k, _), r in engine._rows.items()
+                             if k == pod_key]))
+
+
+def _is_consecutive(rows):
+    return len(rows) > 0 and (np.diff(rows) == 1).all()
+
+
+def test_compact_preserves_links_and_properties():
+    store, engine, names = _cluster()
+    _fragment(engine, names)
+    before = {k: engine.link_row(*k) for k in engine._rows}
+    n = engine.num_active
+    scattered = [p for p in names
+                 if not _is_consecutive(_pod_rows(engine, f"default/{p}"))]
+    assert scattered, "fragmentation premise failed"
+
+    info = engine.compact()
+    assert info["active"] == n and info["moved"] > 0
+    # dense layout
+    assert sorted(engine._rows.values()) == list(range(n))
+    assert engine._row_owner == {r: k for k, r in engine._rows.items()}
+    # device agreement: same active count, same per-link properties
+    assert int(np.asarray(engine.state.active).sum()) == n
+    for key, old in before.items():
+        new = engine.link_row(*key)
+        assert new["uid"] == old["uid"]
+        assert new["latency_us"] == old["latency_us"]
+    # shaped-row mirror follows the renumbering (all links are shaped)
+    assert engine._shaped_rows == set(range(n))
+    # the engine keeps working: ping across a compacted link
+    p = engine.ping(names[0], names[1], uid=1)
+    assert p["reachable"] and p["rtt_us"] == 4000.0
+
+
+def test_compact_restores_contiguous_update_eligibility():
+    store, engine, names = _cluster()
+    _fragment(engine, names)
+    # a whole-topology update batch (one pod's rows) is the unit that
+    # must be consecutive for the streaming path
+    frag_pod = next(p for p in names
+                    if not _is_consecutive(_pod_rows(engine,
+                                                     f"default/{p}")))
+    rows = _pod_rows(engine, f"default/{frag_pod}")
+    pad = np.zeros(16, np.int64)
+    pad[:len(rows)] = rows
+    valid = np.arange(16) < len(rows)
+    assert not es.contiguous_window(pad, valid, engine.state.capacity)
+    engine.compact()
+    # compact orders rows by (pod_key, uid): every pod's block is
+    # consecutive again
+    for p in names:
+        assert _is_consecutive(_pod_rows(engine, f"default/{p}")), p
+    rows2 = _pod_rows(engine, f"default/{frag_pod}")
+    pad2 = np.zeros(16, np.int64)
+    pad2[:len(rows2)] = rows2
+    assert es.contiguous_window(pad2, valid, engine.state.capacity)
+
+
+def test_compact_moves_dataplane_counters():
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    store, engine, names = _cluster()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=1000.0)
+    wa = daemon._add_wire(pb.WireDef(local_pod_name=names[0],
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    daemon._add_wire(pb.WireDef(local_pod_name=names[1], kube_ns="default",
+                                link_uid=1, intf_name_in_pod="eth1"))
+    daemon._frame_in(wa, b"z" * 90)
+    t = 0.0
+    for _ in range(10):
+        plane.tick(now_s=t)
+        t += 0.001
+    old_row = engine.row_of(f"default/{names[0]}", 1)
+    assert float(np.asarray(plane.counters.tx_packets)[old_row]) == 1.0
+
+    _fragment(engine, names[2:])  # scatter other pods, keep names[0]
+    engine.compact()
+    new_row = engine.row_of(f"default/{names[0]}", 1)
+    tx = np.asarray(plane.counters.tx_packets)
+    assert float(tx[new_row]) == 1.0
+    assert float(tx.sum()) == 1.0  # nothing duplicated or stranded
+
+
+def test_compact_between_drain_and_snapshot_keeps_frames_on_their_link():
+    """Regression for the drain/compact race: rows are re-resolved under
+    the engine lock, so a compact() landing between the ingress drain and
+    the snapshot must NOT shape a batch with another link's qdiscs or
+    deliver it to the wrong pod."""
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=128)
+    # fast link f0<->f1 (2ms) among slow 500ms links
+    names = [f"f{i}" for i in range(6)]
+    specs = {p: [] for p in names}
+    uid = 0
+    for i, a in enumerate(names):
+        b = names[(i + 1) % len(names)]
+        uid += 1
+        props = LinkProperties(latency="2ms" if uid == 1 else "500ms")
+        specs[a].append(Link(local_intf=f"e{uid}a", peer_intf=f"e{uid}b",
+                             peer_pod=b, uid=uid, properties=props))
+        specs[b].append(Link(local_intf=f"e{uid}b", peer_intf=f"e{uid}a",
+                             peer_pod=a, uid=uid, properties=props))
+    for p in names:
+        store.create(Topology(name=p, spec=TopologySpec(links=specs[p])))
+    for p in names:
+        engine.setup_pod(p)
+    Reconciler(store, engine).drain()
+    # fragment so compact() actually renumbers
+    for p in names[::2]:
+        engine.destroy_pod(p)
+    for p in names[::2]:
+        engine.setup_pod(p)
+
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=1000.0)
+    wa = daemon._add_wire(pb.WireDef(local_pod_name="f0",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    wb = daemon._add_wire(pb.WireDef(local_pod_name="f1",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+
+    # interpose: compact() fires right after the tick's ingress drain,
+    # exactly inside the race window
+    orig = daemon.drain_ingress
+    fired = {"n": 0}
+
+    def hooked(**kw):
+        out = orig(**kw)
+        if out and not fired["n"]:
+            fired["n"] = 1
+            engine.compact()
+        return out
+
+    daemon.drain_ingress = hooked
+
+    frame = b"\xfa" * 80
+    daemon._frame_in(wa, frame)
+    t = 0.0
+    for _ in range(10):   # 10ms of ticks: far less than the 500ms links
+        plane.tick(now_s=t)
+        t += 0.001
+    assert fired["n"] == 1, "race window never exercised"
+    # delivered to f1 (the 2ms link's peer), on 2ms timing — a stale-row
+    # shaping would have applied a 500ms delay or misdelivered
+    assert list(wb.egress) == [frame]
+    for w in daemon.wires._by_id.values():
+        if w not in (wa, wb):
+            assert not w.egress, "frame misdelivered after compact"

@@ -130,7 +130,7 @@ def test_wire_lifecycle_and_packets(daemon_and_client):
 
     batches = daemon.drain_ingress()
     assert len(batches) == 1
-    row, sizes, frames = batches[0]
+    wire_out, row, sizes, frames = batches[0]
     assert sizes == [3, 2, 4]
     assert row == engine.row_of("default/r1", 1)
 
@@ -251,11 +251,11 @@ def test_drain_ingress_visits_only_hot_wires():
     for _ in range(70):
         wires[7].ingress.append(b"x" * 60)
     out = daemon.drain_ingress(max_per_wire=64)
-    assert len(out) == 1 and len(out[0][2]) == 64
+    assert len(out) == 1 and len(out[0][3]) == 64
     assert set(visited) == {wires[7].wire_id}  # nobody else visited
     visited.clear()
     out = daemon.drain_ingress(max_per_wire=64)  # residue still hot
-    assert len(out) == 1 and len(out[0][2]) == 6
+    assert len(out) == 1 and len(out[0][3]) == 6
     assert daemon.drain_ingress() == []          # drained -> cold
 
     # unrealized link: frames wait, wire stays hot until the row exists
@@ -270,7 +270,7 @@ def test_drain_ingress_visits_only_hot_wires():
     store.create(t)
     engine.setup_pod("late")
     out = daemon.drain_ingress()
-    assert len(out) == 1 and out[0][2] == [b"y" * 60]
+    assert len(out) == 1 and out[0][3] == [b"y" * 60]
 
 
 def test_directly_constructed_wire_not_starved():
@@ -294,11 +294,11 @@ def test_directly_constructed_wire_not_starved():
     wire.ingress.append(b"early" + b"\x00" * 55)  # BEFORE registration
     daemon.wires.add(wire)
     out = daemon.drain_ingress()
-    assert len(out) == 1 and out[0][2][0].startswith(b"early")
+    assert len(out) == 1 and out[0][3][0].startswith(b"early")
     # post-registration direct appends (and extend) also mark hot
     wire.ingress.extend([b"l" * 60, b"m" * 60])
     out = daemon.drain_ingress()
-    assert len(out) == 1 and len(out[0][2]) == 2
+    assert len(out) == 1 and len(out[0][3]) == 2
 
 
 def test_iadd_on_ingress_marks_hot():
@@ -318,4 +318,4 @@ def test_iadd_on_ingress_marks_hot():
         intf_name_in_pod="eth0"))
     wire.ingress += [b"a" * 60, b"b" * 60]
     out = daemon.drain_ingress()
-    assert len(out) == 1 and len(out[0][2]) == 2
+    assert len(out) == 1 and len(out[0][3]) == 2
