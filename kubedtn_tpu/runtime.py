@@ -336,7 +336,25 @@ class WireDataPlane:
         with self._tick_lock:
             explicit = now_s is not None
             if now_s is None:
+                if self._clock_ext:
+                    # the plane runs on a synthetic clock; mixing a
+                    # monotonic now with the synthetic origin would skew
+                    # every restored deadline by the epoch difference
+                    raise ValueError(
+                        "restore_pending: plane uses an explicit clock; "
+                        "pass now_s from the same clock")
                 now_s = time.monotonic()
+            elif (not self._clock_ext and self._origin_s is not None
+                    and abs(now_s - time.monotonic()) > 10.0):
+                # mirror direction: a synthetic now_s against a
+                # monotonic-derived origin makes every restored deadline
+                # hugely past/future due. An explicit now_s for a
+                # monotonic plane must itself be (approximately) the
+                # monotonic clock.
+                raise ValueError(
+                    "restore_pending: plane origin is on the monotonic "
+                    "clock but now_s is not; pass now_s from the same "
+                    "clock")
             if self._origin_s is None:
                 self._origin_s = now_s
                 self.last_now_s = now_s

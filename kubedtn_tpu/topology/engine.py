@@ -880,7 +880,10 @@ class SimEngine:
                 if cur == goal:
                     break
                 edge = int(nh_np[cur, goal])
-                assert edge >= 0, "finite dist but no next hop"
+                if edge < 0:
+                    return {"reachable": False, "hops": hops,
+                            "error": "next-hop walk diverged "
+                                     "(finite dist but no next hop)"}
                 nxt = int(dstv[edge])
                 total += float(lat[edge])
                 hops.append({
@@ -890,6 +893,11 @@ class SimEngine:
                     "latency_us": float(lat[edge]),
                 })
                 cur = nxt
-            assert cur == goal, "next-hop walk diverged from dist"
+            if cur != goal:
+                # float-tie pathologies in nh (e.g. zero-latency
+                # equal-cost cycles under the tie epsilon) can make the
+                # walk loop without reaching goal; report, don't crash
+                return {"reachable": False, "hops": hops,
+                        "error": "next-hop walk diverged from dist"}
         return {"reachable": reachable, "hops": hops,
                 "total_latency_us": total}

@@ -344,3 +344,47 @@ def test_restored_frames_wait_for_wire_reattach(tmp_path):
     plane.tick(now_s=1.1)   # due, orphaned with 50ms grace
     plane.tick(now_s=1.3)   # grace expired
     assert plane.undeliverable == 1
+
+
+def test_restore_pending_rejects_mixed_clocks():
+    """A plane driven by a synthetic clock must not accept a default
+    (monotonic) now_s in restore_pending — deadlines would be skewed by
+    the epoch difference between the two clocks (ADVICE r3)."""
+    import pytest
+
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    plane = WireDataPlane(Daemon(engine), dt_us=10_000.0)
+    plane.tick(now_s=5.0)  # synthetic clock: origin=5.0, _clock_ext set
+    with pytest.raises(ValueError, match="explicit clock"):
+        plane.restore_pending([("default/a", 1, b"\x00" * 32, 1_000.0)])
+    # the explicit-clock path still works
+    assert plane.restore_pending(
+        [("default/a", 1, b"\x00" * 32, 1_000.0)], now_s=5.1) == 1
+
+
+def test_restore_pending_rejects_synthetic_now_on_monotonic_plane():
+    """Mirror direction of the clock guard: an obviously-synthetic now_s
+    against a monotonic-derived origin must raise, not silently release
+    every restored frame immediately."""
+    import time
+
+    import pytest
+
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    plane = WireDataPlane(Daemon(engine), dt_us=10_000.0)
+    plane.tick()  # monotonic clock: origin = time.monotonic()
+    with pytest.raises(ValueError, match="monotonic"):
+        plane.restore_pending([("default/a", 1, b"\x00" * 32, 1_000.0)],
+                              now_s=100.0)
+    # an explicit now_s on the same (monotonic) clock is accepted
+    assert plane.restore_pending(
+        [("default/a", 1, b"\x00" * 32, 1_000.0)],
+        now_s=time.monotonic()) == 1
