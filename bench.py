@@ -233,8 +233,42 @@ def bench_shape_step(extras: dict) -> None:
 
         timed(lambda st, s, h, t, k: shaping.shape_step(
             st, s, h, t, k, interpret=False), "shape_pallas_pkts_per_s")
+
+        # persistent-tiled + on-core PRNG variant: the layout transposes
+        # and the host-side threefry that bounded the drop-in kernel's
+        # margin (round-3 VERDICT) are hoisted out of the loop entirely
+        act_i32 = state.active.astype(jnp.int32)
+
+        @functools.partial(jax.jit, donate_argnums=0, static_argnums=1)
+        def run_tiled(ts, iters):
+            sizes_t = shaping.tile_vec(sizes, ts)
+            act_t = shaping.tile_vec(act_i32, ts)
+            t_arr_t = shaping.tile_vec(t0s, ts)
+
+            def body(ts, i):
+                ts, _d, _f = shaping.shape_step_tiled.__wrapped__(
+                    ts, sizes_t, act_t, t_arr_t, i, interpret=False)
+                return ts, ()
+
+            ts, _ = jax.lax.scan(body, ts, jnp.arange(iters))
+            return ts
+
+        samples = []
+        for _ in range(3):
+            ts = shaping.tile_state(jax.tree.map(lambda x: x.copy(),
+                                                 state))
+            ts = run_tiled(ts, SHAPE_ITERS)
+            jax.block_until_ready(ts.tokens)
+            t0 = time.perf_counter()
+            ts = run_tiled(ts, SHAPE_ITERS)
+            jax.block_until_ready(ts.tokens)
+            samples.append(time.perf_counter() - t0)
+        dt = sorted(samples)[1]
+        extras["shape_pallas_tiled_pkts_per_s"] = round(
+            n_active * SHAPE_ITERS / dt, 1)
     else:
         extras["shape_pallas_pkts_per_s"] = None
+        extras["shape_pallas_tiled_pkts_per_s"] = None
         extras["shape_pallas_note"] = "skipped: non-TPU backend"
 
 
@@ -269,11 +303,29 @@ def bench_wire_streaming(extras: dict) -> None:
     client.SendToStream(iter(pkts))
     stream_s = time.perf_counter() - t0
     assert len(wire.egress) == 2 * n + 1
+
+    # the coalesced transport the daemons actually use for egress
+    # (runtime._flush_remote → SendToBulk): ~256 frames per gRPC message
+    # instead of one, which is what lifts the streamed path past the
+    # ~25k msg/s Python-gRPC ceiling
+    n_bulk, chunk = 100_000, 256
+    batches = [pb.PacketBatch(packets=[pkts[0]] * chunk)
+               for _ in range(n_bulk // chunk)]
+    client.SendToBulk(iter(batches[:4]))  # warm
+    wire.egress.clear()
+    t0 = time.perf_counter()
+    client.SendToBulk(iter(batches))
+    bulk_s = time.perf_counter() - t0
+    n_bulk_done = len(wire.egress)
+    assert n_bulk_done == (n_bulk // chunk) * chunk
     client.close()
     server.stop(0)
     extras["wire_unary_frames_per_s"] = round(n / unary_s, 1)
     extras["wire_stream_frames_per_s"] = round(n / stream_s, 1)
     extras["wire_stream_speedup"] = round(unary_s / stream_s, 2)
+    extras["wire_bulk_frames_per_s"] = round(n_bulk_done / bulk_s, 1)
+    extras["wire_bulk_speedup_vs_stream"] = round(
+        (n_bulk_done / bulk_s) / (n / stream_s), 1)
 
 
 def main() -> None:
@@ -347,6 +399,19 @@ def main() -> None:
 
     with_retry("wire_streaming", lambda: bench_wire_streaming(extras),
                extras)
+
+    def run_live_plane():
+        from kubedtn_tpu.scenarios import live_plane
+
+        r = live_plane(pairs=8,
+                       frames_per_wire=8_000 if degraded else 40_000)
+        extras["live_plane"] = {
+            k: r[k] for k in ("pairs", "frames_per_wire", "frames_per_s",
+                              "rounds_frames_per_s", "dropped",
+                              "tick_errors")
+        }
+
+    with_retry("live_plane", run_live_plane, extras)
 
     def run_scale_1m():
         from kubedtn_tpu.scenarios import scale_1m

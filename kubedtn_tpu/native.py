@@ -56,6 +56,13 @@ def _declare(lib) -> None:
         "kdt_classify_frame": (c.c_int32, [u8p, c.c_uint64]),
         "kdt_classify_batch": (None, [u8p, u64p, u64p, c.c_int64,
                                       c.POINTER(c.c_int32)]),
+        "kdt_classify_batch_ptrs": (None, [c.POINTER(c.c_char_p), u64p,
+                                           c.c_int64,
+                                           c.POINTER(c.c_int32)]),
+        "kdt_ft_decide_batch_ptrs": (c.c_int64, [c.c_void_p,
+                                                 c.POINTER(c.c_char_p),
+                                                 u64p, c.c_int64, u8p,
+                                                 u8p, u8p]),
         "kdt_ft_new": (c.c_void_p, [c.c_uint64]),
         "kdt_ft_free": (None, [c.c_void_p]),
         "kdt_ft_active_established": (None, [c.c_void_p, c.c_uint32,
@@ -85,6 +92,8 @@ def _declare(lib) -> None:
         "kdt_tw_new": (c.c_void_p, [c.c_uint64, c.c_uint32, c.c_uint32]),
         "kdt_tw_free": (None, [c.c_void_p]),
         "kdt_tw_schedule": (None, [c.c_void_p, c.c_uint64, c.c_uint64]),
+        "kdt_tw_schedule_batch": (None, [c.c_void_p, u64p, u64p,
+                                         c.c_int64]),
         "kdt_tw_advance": (c.c_int64, [c.c_void_p, c.c_uint64, u64p,
                                        c.c_int64]),
         "kdt_tw_size": (c.c_uint64, [c.c_void_p]),
@@ -155,24 +164,61 @@ def classify_frame(frame: bytes) -> str:
     return FRAME_TYPES[lib.kdt_classify_frame(_buf(frame), len(frame))]
 
 
+def _frame_arrays(frames: list[bytes]):
+    """(blob, offs u64[n], lens u64[n]) for a blob-form batch call (the
+    offline decoder paths; the data-plane hot paths use the pointer-array
+    forms and never concatenate)."""
+    import numpy as np
+
+    n = len(frames)
+    blob = b"".join(frames)
+    lens = np.fromiter((len(f) for f in frames), np.uint64, count=n)
+    offs = np.zeros(n, np.uint64)
+    np.cumsum(lens[:-1], out=offs[1:])
+    return blob, offs, lens
+
+
 def classify_batch(frames: list[bytes]) -> list[str]:
     """One native call for a whole ingress drain."""
+    import numpy as np
+
     lib = _load()
     n = len(frames)
     if n == 0:
         return []
-    blob = b"".join(frames)
-    offs, lens = [], []
-    pos = 0
-    for f in frames:
-        offs.append(pos)
-        lens.append(len(f))
-        pos += len(f)
-    out = (ctypes.c_int32 * n)()
+    blob, offs, lens = _frame_arrays(frames)
+    out = np.zeros(n, np.int32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
     lib.kdt_classify_batch(
-        _buf(blob), (ctypes.c_uint64 * n)(*offs), (ctypes.c_uint64 * n)(*lens),
-        n, out)
-    return [FRAME_TYPES[v] for v in out]
+        _buf(blob), offs.ctypes.data_as(u64p), lens.ctypes.data_as(u64p),
+        n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return [FRAME_TYPES[v] for v in out.tolist()]
+
+
+def classify_counts(frames: list[bytes], lens=None) -> dict[str, int]:
+    """Per-protocol counts for a whole drain with NO per-frame Python
+    beyond a pointer-array build: one native call + one bincount (the
+    hot-path form of classify_batch — the data plane only needs the
+    counters, and the pointer form skips the blob concatenation)."""
+    import numpy as np
+
+    lib = _load()
+    n = len(frames)
+    if n == 0:
+        return {}
+    ptrs = (ctypes.c_char_p * n)(*frames)
+    if lens is None:
+        lens_a = np.fromiter((len(f) for f in frames), np.uint64, count=n)
+    else:
+        lens_a = np.ascontiguousarray(lens, np.uint64)
+    out = np.zeros(n, np.int32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.kdt_classify_batch_ptrs(
+        ptrs, lens_a.ctypes.data_as(u64p), n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    counts = np.bincount(out, minlength=len(FRAME_TYPES))
+    return {FRAME_TYPES[i]: int(c)
+            for i, c in enumerate(counts.tolist()) if c}
 
 
 def _ip(v) -> int:
@@ -220,6 +266,35 @@ class FlowTable:
 
     def on_close(self, lip, lport, rip, rport) -> None:
         self._lib.kdt_ft_close(self._h, _ip(lip), lport, _ip(rip), rport)
+
+    def decide_batch(self, frames: list[bytes], eligible, shaped,
+                     lens=None):
+        """Bypass verdicts for a whole ingress drain in ONE native call:
+        parse + establish + shaped-disable + sk_msg verdict per frame
+        (the per-frame semantics of runtime._try_bypass). `eligible` and
+        `shaped` are per-frame bool sequences; returns a uint8 array
+        where 1 = the frame bypasses shaping."""
+        import numpy as np
+
+        n = len(frames)
+        out = np.zeros(n, np.uint8)
+        if n == 0:
+            return out
+        ptrs = (ctypes.c_char_p * n)(*frames)
+        if lens is None:
+            lens_a = np.fromiter((len(f) for f in frames), np.uint64,
+                                 count=n)
+        else:
+            lens_a = np.ascontiguousarray(lens, np.uint64)
+        elig = np.ascontiguousarray(eligible, np.uint8)
+        shp = np.ascontiguousarray(shaped, np.uint8)
+        c = ctypes
+        u8p, u64p = c.POINTER(c.c_uint8), c.POINTER(c.c_uint64)
+        self._lib.kdt_ft_decide_batch_ptrs(
+            self._h, ptrs, lens_a.ctypes.data_as(u64p), n,
+            elig.ctypes.data_as(u8p), shp.ctypes.data_as(u8p),
+            out.ctypes.data_as(u8p))
+        return out
 
     def flag(self, lip, lport, rip, rport) -> int | None:
         v = self._lib.kdt_ft_flag(self._h, _ip(lip), lport, _ip(rip), rport)
@@ -299,6 +374,22 @@ class TimingWheel:
 
     def schedule(self, when_us: int, token: int) -> None:
         self._lib.kdt_tw_schedule(self._h, max(0, int(when_us)), token)
+
+    def schedule_batch(self, when_us, tokens) -> None:
+        """Schedule many (deadline, token) pairs in one native call —
+        one lock acquisition per tick instead of per frame. Negative
+        deadlines clamp to 0 (already due), matching schedule()."""
+        import numpy as np
+
+        w = np.maximum(np.asarray(when_us, np.float64), 0.0) \
+            .astype(np.uint64)
+        t = np.ascontiguousarray(tokens, np.uint64)
+        if w.shape[0] == 0:
+            return
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        self._lib.kdt_tw_schedule_batch(
+            self._h, np.ascontiguousarray(w).ctypes.data_as(u64p),
+            t.ctypes.data_as(u64p), w.shape[0])
 
     def advance(self, now_us: int) -> list[int]:
         # clamp BEFORE the c_uint64 coercion: a negative elapsed time (clock

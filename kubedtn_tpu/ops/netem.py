@@ -293,7 +293,16 @@ def shape_step(state: EdgeState, sizes: jax.Array, have_pkt: jax.Array,
     """
     E = state.capacity
     u = jax.random.uniform(key, (E, NU), dtype=jnp.float32)
+    return _shape_step_from_u(state, sizes, have_pkt, t_arrival, u)
 
+
+def _shape_step_from_u(state: EdgeState, sizes: jax.Array,
+                       have_pkt: jax.Array, t_arrival: jax.Array,
+                       u: jax.Array):
+    """shape_step past the uniform draw — shared by shape_step (one key →
+    one slot) and shape_slots_nodonate (one key → all K slots' uniforms
+    generated in a single fused threefry call; per-slot generation inside
+    the scan was the live tick's dominant cost)."""
     res, tk, tl, nf, corr, cnt = _shape_vmapped(
         state.props, state.tokens, state.t_last, state.backlog_until,
         state.corr, state.pkt_count, sizes, t_arrival, u,
@@ -364,6 +373,166 @@ def shape_step_nodonate(state: EdgeState, sizes: jax.Array,
     if _shape_step_nd is None:
         _shape_step_nd = jax.jit(shape_step.__wrapped__)
     return _shape_step_nd(state, sizes, have_pkt, t_arrival, key)
+
+
+def slot_independent_rows(props):
+    """bool[E]: rows whose per-packet shaping decisions never read state
+    written by an earlier packet of the same batch. True when the row has
+    no TBF child (rate==0 — the reference only installs TBF for rate!=0,
+    common/qdisc.go:115-123), no AR(1) correlation on any netem variable
+    (rho==0 passes crandom state through untouched), and no reorder (the
+    only consumer of pkt_count). For such rows netem's draws are iid, so
+    all K slots can be shaped in one elementwise kernel — the live data
+    plane's fast path. Works on numpy or jax arrays."""
+    import kubedtn_tpu.ops.edge_state as es
+
+    return ((props[:, es.P_RATE_BPS] == 0)
+            & (props[:, es.P_LATENCY_CORR] == 0)
+            & (props[:, es.P_LOSS_CORR] == 0)
+            & (props[:, es.P_DUPLICATE_CORR] == 0)
+            & (props[:, es.P_CORRUPT_CORR] == 0)
+            & (props[:, es.P_REORDER_CORR] == 0)
+            & (props[:, es.P_REORDER_PROB] == 0))
+
+
+_shape_slots_ind = None
+
+
+def shape_slots_indep_nodonate(state: EdgeState, row_idx: jax.Array,
+                               sizes: jax.Array, valid: jax.Array,
+                               key: jax.Array):
+    """Shape K slots on R gathered rows in ONE elementwise kernel — valid
+    only for rows that satisfy slot_independent_rows (callers route
+    others to shape_slots_nodonate). Every slot sees the row's CURRENT
+    corr/tokens state (iid draws, no TBF), so there is no cross-slot
+    recurrence and no scan; cost is O(R*K) fused elementwise work with a
+    single dispatch — R is the rows WITH traffic this tick, not the
+    engine's capacity. Padding convention: row_idx entries >= capacity
+    are padding (gathers clamp harmlessly; the scatter-add drops them —
+    XLA out-of-bounds scatter semantics) and must carry valid=False.
+
+    Returns (ShapeResult[R, K], new_pkt_count int32[E]) — the only state
+    a slot-independent row advances is pkt_count (by its survivor
+    count); tokens/t_last/backlog_until/corr are unchanged by
+    construction.
+    """
+    global _shape_slots_ind
+    if _shape_slots_ind is None:
+        def _ind(state, row_idx, sizes, valid, key):
+            R, K = sizes.shape
+            u = jax.random.uniform(key, (R, K, NU), dtype=jnp.float32)
+            t_arr = jnp.zeros((R,), jnp.float32)
+            over_slots = jax.vmap(
+                _shape_vmapped,
+                in_axes=(None, None, None, None, None, None, 1, None, 1),
+                out_axes=1)
+            res, _tk, _tl, _nf, _corr, _cnt = over_slots(
+                state.props[row_idx], state.tokens[row_idx],
+                state.t_last[row_idx], state.backlog_until[row_idx],
+                state.corr[row_idx], state.pkt_count[row_idx],
+                sizes, t_arr, u)
+            act = valid & state.active[row_idx][:, None]
+            inf = jnp.float32(jnp.inf)
+            res = ShapeResult(
+                depart_us=jnp.where(act, res.depart_us, inf),
+                delivered=res.delivered & act,
+                dropped_loss=res.dropped_loss & act,
+                dropped_queue=res.dropped_queue & act,
+                corrupted=res.corrupted & act,
+                duplicated=res.duplicated & act,
+                reordered=res.reordered & act,
+            )
+            delta = (act & ~res.dropped_loss).sum(axis=1) \
+                .astype(state.pkt_count.dtype)
+            new_count = state.pkt_count.at[row_idx].add(delta,
+                                                          mode="drop")
+            return res, new_count
+
+        _shape_slots_ind = jax.jit(_ind)
+    return _shape_slots_ind(state, row_idx, sizes, valid, key)
+
+
+_shape_slots_nd = None
+
+
+def shape_slots_nodonate(state: EdgeState, row_idx: jax.Array,
+                         sizes: jax.Array, valid: jax.Array,
+                         key: jax.Array):
+    """Shape K packet slots on R gathered rows in ONE device dispatch,
+    preserving per-row sequentiality — the slow-but-exact path for rows
+    with cross-slot state (TBF token bucket, AR(1) correlations, gap
+    reorder; see slot_independent_rows for the complement).
+
+    The live data plane's replacement for K sequential shape_step calls
+    per tick (the round-3 per-frame hot loop): all K slots' uniforms
+    come from ONE fused threefry call, and a lax.scan threads the
+    gathered rows' dynamic columns through the K slots inside a single
+    jitted computation — per-tick device dispatch is O(1) and the scan
+    length is the deepest per-wire backlog, over R busy rows rather than
+    the engine's whole capacity. Padding convention: row_idx entries
+    >= capacity are padding (gathers clamp harmlessly; the write-back
+    scatters drop them) and must carry valid=False.
+
+    Args:
+      state: EdgeState (not donated — live-plane snapshot semantics).
+      row_idx: int32[R] rows with traffic this tick.
+      sizes: float32[R, K] packet bytes (0 for empty slots).
+      valid: bool[R, K] slot occupancy.
+      key: per-tick PRNG key.
+
+    Returns: (state', ShapeResult with [R, K] leaves) — state' is the
+    FULL capacity-E state with the R rows' dynamic columns advanced.
+    """
+    global _shape_slots_nd
+    if _shape_slots_nd is None:
+        def _slots(state, row_idx, sizes, valid, key):
+            R, K = sizes.shape
+            u_all = jax.random.uniform(key, (K, R, NU), dtype=jnp.float32)
+            props = state.props[row_idx]
+            active = state.active[row_idx]
+            t_arr = jnp.zeros((R,), jnp.float32)
+            carry0 = (state.tokens[row_idx], state.t_last[row_idx],
+                      state.backlog_until[row_idx], state.corr[row_idx],
+                      state.pkt_count[row_idx])
+
+            def body(carry, xs):
+                tk0, tl0, nf0, corr0, cnt0 = carry
+                sz, va, u = xs
+                res, tk, tl, nf, corr, cnt = _shape_vmapped(
+                    props, tk0, tl0, nf0, corr0, cnt0, sz, t_arr, u)
+                act = va & active
+                keep = lambda new, old: jnp.where(act, new, old)  # noqa: E731
+                carry = (keep(tk, tk0), keep(tl, tl0), keep(nf, nf0),
+                         jnp.where(act[:, None], corr, corr0),
+                         keep(cnt, cnt0))
+                inf = jnp.float32(jnp.inf)
+                res = ShapeResult(
+                    depart_us=jnp.where(act, res.depart_us, inf),
+                    delivered=res.delivered & act,
+                    dropped_loss=res.dropped_loss & act,
+                    dropped_queue=res.dropped_queue & act,
+                    corrupted=res.corrupted & act,
+                    duplicated=res.duplicated & act,
+                    reordered=res.reordered & act)
+                return carry, res
+
+            xs = (jnp.moveaxis(sizes, 1, 0), jnp.moveaxis(valid, 1, 0),
+                  u_all)
+            (tk, tl, nf, corr, cnt), res = jax.lax.scan(body, carry0, xs)
+            new_state = dataclasses.replace(
+                state,
+                tokens=state.tokens.at[row_idx].set(tk, mode="drop"),
+                t_last=state.t_last.at[row_idx].set(tl, mode="drop"),
+                backlog_until=state.backlog_until.at[row_idx]
+                .set(nf, mode="drop"),
+                corr=state.corr.at[row_idx].set(corr, mode="drop"),
+                pkt_count=state.pkt_count.at[row_idx]
+                .set(cnt, mode="drop"))
+            res = jax.tree.map(lambda a: jnp.moveaxis(a, 0, 1), res)
+            return new_state, res
+
+        _shape_slots_nd = jax.jit(_slots)
+    return _shape_slots_nd(state, row_idx, sizes, valid, key)
 
 
 @partial(jax.jit, donate_argnums=0, static_argnums=2)

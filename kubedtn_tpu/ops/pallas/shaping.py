@@ -60,12 +60,14 @@ def _crandom(u, last, rho):
     return val, new_last
 
 
-def _shape_kernel(props_ref, corr_ref, u_ref, tokens_ref, t_last_ref,
-                  backlog_ref, count_ref, sizes_ref, t_arr_ref, act_ref,
-                  # outputs
-                  depart_ref, flags_ref, tokens_out, t_last_out,
-                  backlog_out, corr_out, count_out):
-    """One edge tile ([BR, 128] lanes) through the full qdisc chain."""
+def _shape_tile_math(u, props_ref, corr_ref, tokens_ref, t_last_ref,
+                     backlog_ref, count_ref, sizes_ref, t_arr_ref,
+                     act_ref, depart_ref, flags_ref, tokens_out,
+                     t_last_out, backlog_out, corr_out, count_out):
+    """One edge tile ([BR, 128] lanes) through the full qdisc chain.
+    `u` is a length-NU sequence of uniform tiles — read from an input
+    slab (drop-in/interpret path) or generated in-kernel (tiled TPU
+    path)."""
     pct = 1.0 / 100.0
 
     latency = props_ref[es.P_LATENCY_US]
@@ -92,11 +94,11 @@ def _shape_kernel(props_ref, corr_ref, u_ref, tokens_ref, t_last_ref,
     cnt_f = cnt.astype(jnp.float32)
 
     # -- netem stage (kernel enqueue order; see netem.netem_packet) ----
-    x_dup, dup_state = _crandom(u_ref[netem.U_DUP], c_dup, dup_rho)
+    x_dup, dup_state = _crandom(u[netem.U_DUP], c_dup, dup_rho)
     dup_hit = (dup > 0.0) & (x_dup * 100.0 < dup)
     dup_state = jnp.where(dup > 0.0, dup_state, c_dup)
 
-    x_loss, loss_state = _crandom(u_ref[netem.U_LOSS], c_loss, loss_rho)
+    x_loss, loss_state = _crandom(u[netem.U_LOSS], c_loss, loss_rho)
     loss_hit = (loss > 0.0) & (x_loss * 100.0 < loss)
     loss_state = jnp.where(loss > 0.0, loss_state, c_loss)
 
@@ -104,17 +106,17 @@ def _shape_kernel(props_ref, corr_ref, u_ref, tokens_ref, t_last_ref,
     duplicated = dup_hit & ~loss_hit
     survives = ~dropped
 
-    x_cor, cor_state = _crandom(u_ref[netem.U_CORRUPT], c_cor, cor_rho)
+    x_cor, cor_state = _crandom(u[netem.U_CORRUPT], c_cor, cor_rho)
     corrupted = (corrupt > 0.0) & (x_cor * 100.0 < corrupt) & survives
     cor_state = jnp.where((corrupt > 0.0) & survives, cor_state, c_cor)
 
-    x_del, del_state = _crandom(u_ref[netem.U_DELAY], c_delay, lat_rho)
+    x_del, del_state = _crandom(u[netem.U_DELAY], c_delay, lat_rho)
     delay = jnp.where(jitter > 0.0,
                       latency + jitter * (2.0 * x_del - 1.0), latency)
     delay = jnp.maximum(delay, 0.0)
     del_state = jnp.where((jitter > 0.0) & survives, del_state, c_delay)
 
-    x_reo, reo_state = _crandom(u_ref[netem.U_REORDER], c_reo, reo_rho)
+    x_reo, reo_state = _crandom(u[netem.U_REORDER], c_reo, reo_rho)
     reorder_on = reorder > 0.0
     candidate = (gap == 0.0) | (cnt_f >= gap - 1.0)
     do_reorder = reorder_on & candidate & (x_reo * 100.0 <= reorder) & survives
@@ -182,6 +184,41 @@ def _shape_kernel(props_ref, corr_ref, u_ref, tokens_ref, t_last_ref,
     corr_out[es.C_DUP] = jnp.where(act, dup_state, c_dup)
     corr_out[es.C_REORDER] = jnp.where(act, reo_state, c_reo)
     corr_out[es.C_CORRUPT] = jnp.where(act, cor_state, c_cor)
+
+
+def _shape_kernel(props_ref, corr_ref, u_ref, tokens_ref, t_last_ref,
+                  backlog_ref, count_ref, sizes_ref, t_arr_ref, act_ref,
+                  depart_ref, flags_ref, tokens_out, t_last_out,
+                  backlog_out, corr_out, count_out):
+    """Drop-in kernel: uniforms arrive as an input slab (threefry on the
+    host side — bit-identical to the vmapped path per key)."""
+    u = tuple(u_ref[k] for k in range(netem.NU))
+    _shape_tile_math(u, props_ref, corr_ref, tokens_ref, t_last_ref,
+                     backlog_ref, count_ref, sizes_ref, t_arr_ref,
+                     act_ref, depart_ref, flags_ref, tokens_out,
+                     t_last_out, backlog_out, corr_out, count_out)
+
+
+def _shape_kernel_prng(seed_ref, props_ref, corr_ref, tokens_ref,
+                       t_last_ref, backlog_ref, count_ref, sizes_ref,
+                       t_arr_ref, act_ref, depart_ref, flags_ref,
+                       tokens_out, t_last_out, backlog_out, corr_out,
+                       count_out):
+    """Tiled-TPU kernel: uniforms come from the on-core PRNG
+    (pltpu.prng_seed / prng_random_bits) — no [E, NU] HBM
+    materialization and no re-tiling of the random stream. Seeded per
+    (step seed, grid tile) so results are deterministic per seed and
+    independent across tiles. 24-bit mantissa uniforms in [0, 1), the
+    same distribution the threefry path feeds the kernel."""
+    br, lane = tokens_ref.shape
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+    bits = pltpu.prng_random_bits((netem.NU, br, lane))
+    u_all = (bits >> jnp.uint32(8)).astype(jnp.float32) * (2.0 ** -24)
+    u = tuple(u_all[k] for k in range(netem.NU))
+    _shape_tile_math(u, props_ref, corr_ref, tokens_ref, t_last_ref,
+                     backlog_ref, count_ref, sizes_ref, t_arr_ref,
+                     act_ref, depart_ref, flags_ref, tokens_out,
+                     t_last_out, backlog_out, corr_out, count_out)
 
 
 def _pad_rows(x: jax.Array, e_pad: int) -> jax.Array:
@@ -295,3 +332,171 @@ def shape_step(state: EdgeState, sizes: jax.Array, have_pkt: jax.Array,
         reordered=(fl & FLAG_REORDERED) > 0,
     )
     return new_state, res
+
+
+# ---------------------------------------------------------------------
+# Persistent tiled state: the steady-state batched plane keeps the edge
+# state in kernel layout ACROSS steps, so the per-call transposes of the
+# drop-in shape_step ([E,C] -> [C,R,128] for props/corr on entry, corr
+# back on exit) vanish from the hot loop, and the uniforms come from the
+# on-core PRNG instead of a host-side threefry materialized in HBM.
+# This is the round-3 VERDICT's "make the Pallas kernel earn its keep"
+# prescription; bench.py records both variants.
+# ---------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TiledShapeState:
+    """EdgeState's shaping-dynamic columns in kernel layout.
+
+    Arrays: props [NPROP, R, 128] (loop-invariant), corr [NCORR, R, 128],
+    tokens/t_last/backlog [R, 128] f32, count [R, 128] i32. `capacity` is
+    the logical edge count E (padding rows beyond it are inert: active
+    masks them out at tiling time).
+    """
+
+    props: jax.Array
+    corr: jax.Array
+    tokens: jax.Array
+    t_last: jax.Array
+    backlog: jax.Array
+    count: jax.Array
+    capacity: int
+    block_rows: int
+
+
+jax.tree_util.register_dataclass(
+    TiledShapeState,
+    data_fields=["props", "corr", "tokens", "t_last", "backlog", "count"],
+    meta_fields=["capacity", "block_rows"],
+)
+
+
+def _block_rows_for(E: int, block_rows: int) -> tuple[int, int]:
+    br = SUBLANES
+    while br < block_rows and br * 2 * LANE <= E:
+        br *= 2
+    e_pad = -(-E // (br * LANE)) * (br * LANE)
+    return br, e_pad
+
+
+def tile_state(state: EdgeState, block_rows: int = 128) -> TiledShapeState:
+    """One-time layout change into kernel tiles (the cost the drop-in
+    path pays on EVERY call)."""
+    E = state.capacity
+    br, e_pad = _block_rows_for(E, block_rows)
+    return TiledShapeState(
+        props=_tiles(state.props, e_pad),
+        corr=_tiles(state.corr, e_pad),
+        tokens=_tiles(state.tokens, e_pad),
+        t_last=_tiles(state.t_last, e_pad),
+        backlog=_tiles(state.backlog_until, e_pad),
+        count=_tiles(state.pkt_count, e_pad),
+        capacity=E,
+        block_rows=br,
+    )
+
+
+def untile_state(tstate: TiledShapeState, state: EdgeState) -> EdgeState:
+    """Fold the tiled dynamic columns back into an EdgeState (end of a
+    tiled run; the inverse of tile_state for everything that changes)."""
+    E = tstate.capacity
+
+    def untile(x):
+        return x.reshape(-1)[:E]
+
+    return dataclasses.replace(
+        state,
+        tokens=untile(tstate.tokens),
+        t_last=untile(tstate.t_last),
+        backlog_until=untile(tstate.backlog),
+        corr=tstate.corr.reshape(es.NCORR, -1)[:, :E].T,
+        pkt_count=untile(tstate.count),
+    )
+
+
+def tile_vec(x: jax.Array, tstate: TiledShapeState) -> jax.Array:
+    """[E] -> [R, 128] in tstate's padding (for sizes/act/t_arrival that
+    stay constant across a tiled run)."""
+    _, e_pad = _block_rows_for(tstate.capacity, tstate.block_rows)
+    return _tiles(x, e_pad)
+
+
+@functools.partial(jax.jit, donate_argnums=0,
+                   static_argnames=("interpret",))
+def shape_step_tiled(tstate: TiledShapeState, sizes_t: jax.Array,
+                     act_t: jax.Array, t_arr_t: jax.Array,
+                     seed, u_t: jax.Array | None = None, *,
+                     interpret: bool | None = None):
+    """One shaping step entirely in kernel layout.
+
+    DONATES tstate: the tiled buffers are reused in place, so a steady-
+    state loop does zero layout work and zero host-side PRNG — uniforms
+    are generated on-core from `seed` (int32; vary it per step). Pass
+    `u_t` ([NU, R, 128], e.g. from threefry) to force external uniforms —
+    required under interpret mode (the interpreter has no TPU PRNG) and
+    used by the parity tests.
+
+    Returns (tstate', depart [R,128], flags int32 [R,128]) — flags as in
+    FLAG_*; callers slice the first `capacity` lanes after untiling.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if interpret and u_t is None:
+        raise ValueError("interpret mode needs external uniforms (u_t): "
+                         "the Pallas interpreter has no TPU PRNG")
+    br = tstate.block_rows
+    R = tstate.tokens.shape[0]
+    grid = (R // br,)
+
+    def vec():
+        return pl.BlockSpec((br, LANE), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+
+    def slab(c):
+        return pl.BlockSpec((c, br, LANE), lambda i: (0, i, 0),
+                            memory_space=pltpu.VMEM)
+
+    f32 = jnp.float32
+    out_shapes = (
+        jax.ShapeDtypeStruct((R, LANE), f32),          # depart
+        jax.ShapeDtypeStruct((R, LANE), jnp.int32),    # flags
+        jax.ShapeDtypeStruct((R, LANE), f32),          # tokens
+        jax.ShapeDtypeStruct((R, LANE), f32),          # t_last
+        jax.ShapeDtypeStruct((R, LANE), f32),          # backlog
+        jax.ShapeDtypeStruct((es.NCORR, R, LANE), f32),  # corr
+        jax.ShapeDtypeStruct((R, LANE), jnp.int32),    # pkt_count
+    )
+    out_specs = (vec(), vec(), vec(), vec(), vec(), slab(es.NCORR), vec())
+
+    if u_t is not None:
+        (depart, flags, tokens, t_last, backlog, corr,
+         count) = pl.pallas_call(
+            _shape_kernel,
+            grid=grid,
+            in_specs=[slab(es.NPROP), slab(es.NCORR), slab(netem.NU),
+                      vec(), vec(), vec(), vec(), vec(), vec(), vec()],
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            interpret=interpret,
+        )(tstate.props, tstate.corr, u_t, tstate.tokens, tstate.t_last,
+          tstate.backlog, tstate.count, sizes_t, t_arr_t, act_t)
+    else:
+        seed_arr = jnp.asarray(seed, jnp.int32).reshape((1,))
+        (depart, flags, tokens, t_last, backlog, corr,
+         count) = pl.pallas_call(
+            _shape_kernel_prng,
+            grid=grid,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      slab(es.NPROP), slab(es.NCORR),
+                      vec(), vec(), vec(), vec(), vec(), vec(), vec()],
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            interpret=interpret,
+        )(seed_arr, tstate.props, tstate.corr, tstate.tokens,
+          tstate.t_last, tstate.backlog, tstate.count, sizes_t, t_arr_t,
+          act_t)
+
+    new_tstate = dataclasses.replace(
+        tstate, corr=corr, tokens=tokens, t_last=t_last, backlog=backlog,
+        count=count)
+    return new_tstate, depart, flags

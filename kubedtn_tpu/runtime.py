@@ -10,25 +10,46 @@ engine's edge state, holds them for their computed netem/TBF delay, then
 releases them to the wire egress queues — virtual time bound to the wall
 clock (the "real-time binding" of SURVEY.md §7 hard-part (e)).
 
-Three native fast paths ride the tick:
+The tick is batched END TO END — per-tick host work is O(batches), not
+O(frames), and device work is at most two dispatches:
 
-- **TCP/IP bypass** (the eBPF sockops/redir capability, reference
-  bpf/lib/sockops.c, redir.c): same-node TCP flows over UNSHAPED links
-  short-circuit the shaping kernels entirely — the frame crosses to the
-  peer wire in the same tick, and `bypassed` counts it. A flow that ever
-  crosses a row with non-zero shaping properties is disabled forever
-  (redir_disable semantics, reference bpf/lib/redir_disable.c:44-48; the
-  guard attaches wherever qdiscs exist, common/qdisc.go:285-287).
+- **TCP/IP bypass, one native call per tick** (the eBPF sockops/redir
+  capability, reference bpf/lib/sockops.c, redir.c): the whole drain's
+  verdicts come from `FlowTable.decide_batch` (parse → establish →
+  shaped-disable → sk_msg per frame, in C++ under one lock). Same-node
+  TCP flows over UNSHAPED links short-circuit the shaping kernels — the
+  frames cross to the peer wire in the same tick, `bypassed` counts
+  them. A flow that ever crosses a row with non-zero shaping properties
+  is disabled forever (redir_disable semantics, reference
+  bpf/lib/redir_disable.c:44-48; the guard attaches wherever qdiscs
+  exist, common/qdisc.go:285-287).
+- **Two-kernel shaping split**: rows whose packet decisions share no
+  cross-slot state — no TBF, no AR(1) correlations, no reorder
+  (netem.slot_independent_rows) — shape ALL their drained frames in one
+  elementwise kernel over [busy rows × slots]
+  (netem.shape_slots_indep_nodonate); rows with sequential state keep
+  exact kernel semantics via a gathered lax.scan
+  (netem.shape_slots_nodonate), capped at `seq_slots` per tick; the
+  residue waits in the plane's holdback buffer and shapes first next
+  tick (each frame classifies and takes its bypass verdict exactly
+  once).
 - **Lock-free shaping**: the tick snapshots row bindings under the engine
   lock, runs the device kernels OUTSIDE it, and merges only the shaping-
   dynamic columns back — a control-plane AddLinks never waits for a
   data-plane device dispatch.
-- **Ring-staged streaming egress**: released cross-node frames stage in
+- **Batched delay-line scheduling**: one `TimingWheel.schedule_batch`
+  call per tick; releases group frames per destination wire (one egress
+  extend per wire per release).
+- **Ring-staged coalesced egress**: released cross-node frames stage in
   the native SPSC FrameRing (the reference's per-wire pcap buffer role,
-  grpcwire.go:398-409) and cross to each peer daemon as ONE SendToStream
-  batch per tick instead of one unary SendToOnce per frame (the
-  reference's known per-packet weakness, grpcwire.go:452). Ring overflow
-  drops are counted in `counters.dropped_ring`.
+  grpcwire.go:398-409) and cross to each peer daemon as ONE SendToBulk
+  stream of ~256-frame PacketBatch messages per tick (Python gRPC tops
+  out near 25k MESSAGES/s, so the per-frame stream alone can never
+  reach kernel rates; vs the reference's unary-per-frame hot loop,
+  grpcwire.go:452). A peer that answers UNIMPLEMENTED (a
+  reference-built daemon) permanently falls back to per-frame
+  SendToStream. Ring overflow drops are counted in
+  `counters.dropped_ring`.
 
 Delayed releases are held in the native hierarchical timing wheel
 (native/kubedtn_native.cc, via kubedtn_tpu.native.TimingWheel) — the role
@@ -135,11 +156,27 @@ class WireDataPlane:
     """Shapes wire frames through the engine's edge state in real time."""
 
     def __init__(self, daemon, dt_us: float = 10_000.0,
-                 max_slots: int = 8, seed: int = 0) -> None:
+                 max_slots: int = 1024, seed: int = 0) -> None:
         self.daemon = daemon
         self.engine = daemon.engine
         self.dt_us = dt_us
+        # per-wire drain budget per tick. Slot-independent rows (no TBF,
+        # no correlations, no reorder — netem.slot_independent_rows)
+        # shape all of it in one elementwise kernel; rows with cross-slot
+        # state are capped at seq_slots per tick (the lax.scan length)
+        # and keep the residue queued in order.
         self.max_slots = max_slots
+        self.seq_slots = 64
+        # Frames drained but deferred by the seq_slots cap wait HERE, not
+        # back on wire.ingress: re-queueing them would re-classify them
+        # into frame_stats and re-run the bypass decision every tick
+        # (each frame must count and decide exactly once). Holdback
+        # frames shape FIRST next tick (FIFO), and their wires are
+        # excluded from the next drain so the buffer stays bounded by
+        # max_slots per wire. Like wire.ingress queues, holdback is
+        # process state — not part of the delay-line checkpoint.
+        self._holdback: dict[int, tuple[object, list[int],
+                                        list[bytes]]] = {}
         self._key = jax.random.key(seed)
         self._heap: list = []          # (release_s, seq, pod_key, uid, frame)
         self._seq = 0
@@ -383,171 +420,336 @@ class WireDataPlane:
         if self._origin_s is None:
             self._origin_s = now_s
         self.last_now_s = now_s
-        drained = self.daemon.drain_ingress(max_per_wire=self.max_slots)
+        drained = self.daemon.drain_ingress(max_per_wire=self.max_slots,
+                                            skip=self._holdback.keys()
+                                            if self._holdback else None)
         shaped = 0
-        if drained:
-            engine = self.engine
-            # -- snapshot under the engine lock (no device work) --------
-            with engine._lock:
-                state = engine.state  # flushes pending control-plane ops
-                E = state.capacity
-                if self.counters.tx_packets.shape[0] != E:
-                    self.counters = init_counters(E)  # engine grew
-                # Rows are re-resolved HERE, under the lock — the drain's
-                # row values are advisory and compact() may have
-                # renumbered rows since (shaping a batch on a stale row
-                # id would apply the wrong link's qdiscs and deliver to
-                # the wrong pod). A wire whose link vanished re-queues.
-                batches: list[tuple[int, list[int], list[bytes]]] = []
-                requeue = []
-                for wire, _row, lens, frames_list in drained:
-                    fresh = engine._rows.get((wire.pod_key, wire.uid))
-                    if fresh is None:
-                        requeue.append((wire, frames_list))
-                        continue
-                    batches.append((fresh, lens, frames_list))
-                # frames entering a directed edge exit at the PEER pod's
-                # wire (the reference writes into the peer's pod-side
-                # veth, grpcwire.go:256-271); _row_owner is maintained
-                # incrementally, so this is O(batch), not O(rows)
-                rowinfo: dict[int, tuple[str, int] | None] = {}
-                for row, _lens, _fr in batches:
-                    key = engine._row_owner.get(row)
-                    rowinfo[row] = (engine._peer.get(key, key)
-                                    if key is not None else None)
-                shaped_rows = set(engine._shaped_rows)
-                # rows the control plane touches from here on keep their
-                # own dynamic state at write-back
-                engine._rows_touched.clear()
-            for wire, frames_list in requeue:
-                wire.ingress.extendleft(reversed(frames_list))
-
-            # -- bypass split + shaping OUTSIDE the engine lock ---------
-            kept: list[tuple[int, list[int], list[bytes]]] = []
-            for row, lens, frames_list in batches:
-                target = rowinfo.get(row)
-                k_lens: list[int] = []
-                k_frames: list[bytes] = []
-                for ln, f in zip(lens, frames_list):
-                    if self._try_bypass(row, f, target, shaped_rows):
-                        continue
-                    k_lens.append(ln)
-                    k_frames.append(f)
-                if k_frames:
-                    kept.append((row, k_lens, k_frames))
-
-            if kept:
-                # advance the persistent shaping clocks by the wall time
-                # since the last shaped batch (the role sim.py's per-step
-                # roll_epoch plays in virtual-time mode)
-                if self._last_shaped_s is not None:
-                    elapsed_us = max(0.0,
-                                     (now_s - self._last_shaped_s) * 1e6)
-                    if elapsed_us > 0.0:
-                        state = netem.roll_epoch_nodonate(
-                            state, jnp.float32(elapsed_us))
-                # NOTE: committed only after a successful write-back — a
-                # skipped write-back (engine grew mid-shaping) must not
-                # swallow this interval's token refill
-                shaped_at = now_s
-                k = max(len(b[1]) for b in kept)
-                sizes = np.zeros((E, k), np.float32)
-                valid = np.zeros((E, k), bool)
-                frames: dict[tuple[int, int], bytes] = {}
-                for row, lens, fr in kept:
-                    for j, (ln, f) in enumerate(zip(lens, fr)):
-                        sizes[row, j] = float(ln)
-                        valid[row, j] = True
-                        frames[(row, j)] = f
-
-                self._key, sub = jax.random.split(self._key)
-                t_arrival = jnp.zeros((E,), jnp.float32)  # shared per tick
-                res_cols = []
-                for j in range(k):
-                    state, res = netem.shape_step_nodonate(
-                        state, jnp.asarray(sizes[:, j]),
-                        jnp.asarray(valid[:, j]), t_arrival,
-                        jax.random.fold_in(sub, j))
-                    res_cols.append(jax.tree.map(np.asarray, res))
-
-                # -- write back dynamic columns under the lock ----------
-                with engine._lock:
-                    cur = engine._state
-                    if cur.capacity == state.capacity:
-                        self._last_shaped_s = shaped_at
-                        touched = engine._rows_touched
-                        if touched:
-                            # rows applied/updated/deleted mid-shaping:
-                            # their flushed initialization (token fill,
-                            # cleared backlog) must win over our stale
-                            # pre-snapshot dynamics
-                            idx = jnp.asarray(sorted(touched), jnp.int32)
-
-                            def merge(new, old):
-                                return new.at[idx].set(old[idx])
-                        else:
-                            def merge(new, old):  # noqa: ARG001
-                                return new
-                        engine._state = dataclasses.replace(
-                            cur,
-                            tokens=merge(state.tokens, cur.tokens),
-                            t_last=merge(state.t_last, cur.t_last),
-                            backlog_until=merge(state.backlog_until,
-                                                cur.backlog_until),
-                            corr=merge(state.corr, cur.corr),
-                            pkt_count=merge(state.pkt_count,
-                                            cur.pkt_count))
-                    # else: engine grew mid-shaping — drop this tick's
-                    # dynamic-state advance rather than corrupt shapes;
-                    # results below still schedule deliveries
-
-                for (row, j), frame in frames.items():
-                    res = res_cols[j]
-                    if bool(res.delivered[row]):
-                        delay_s = float(res.depart_us[row]) / 1e6
-                        target = rowinfo.get(row)
-                        if target is not None:
-                            self._seq += 1
-                            if self._wheel is not None:
-                                deadline_us = (now_s + delay_s
-                                               - self._origin_s) * 1e6
-                                # deadline mirrored host-side so pending
-                                # frames are exportable (checkpointing)
-                                self._pending[self._seq] = (*target, frame,
-                                                            deadline_us)
-                                self._wheel.schedule(deadline_us, self._seq)
-                            else:
-                                heapq.heappush(
-                                    self._heap,
-                                    (now_s + delay_s, self._seq, *target,
-                                     frame))
-                        shaped += 1
-                    else:
-                        self.dropped += 1
-                self._accumulate(res_cols, sizes, valid)
+        if drained or self._holdback:
+            shaped = self._shape_drained(drained, now_s)
         self._release(now_s)
         self.ticks += 1
         self.shaped += shaped
         return shaped
 
-    def _accumulate(self, res_cols, sizes, valid) -> None:
-        tx_p = valid.sum(axis=1).astype(np.float32)
-        tx_b = (sizes * valid).sum(axis=1)
-        deliv = np.stack([r.delivered for r in res_cols], axis=1)
-        loss = np.stack([r.dropped_loss for r in res_cols], axis=1)
-        queue = np.stack([r.dropped_queue for r in res_cols], axis=1)
-        corr = np.stack([r.corrupted for r in res_cols], axis=1)
+    def _shape_drained(self, drained, now_s: float) -> int:
+        """Shape one tick's drained ingress, batched end-to-end: ONE
+        native bypass decision for every frame, at most TWO device
+        dispatches (slot-independent rows in an elementwise kernel,
+        TBF/correlated rows in a gathered scan), one batched wheel
+        schedule. Host-side work is O(batches) + a cheap per-frame tail
+        (pending-map insert), not the round-3 per-frame parse/dispatch
+        loop."""
+        engine = self.engine
+        # holdback (seq-cap residue from the previous tick) shapes FIRST,
+        # ahead of freshly drained frames, and skips the bypass decision
+        # — those frames were classified and decided when first drained
+        inputs: list[tuple[object, list[int], list[bytes], bool]] = []
+        if self._holdback:
+            holdback, self._holdback = self._holdback, {}
+            for wire, lens, fr in holdback.values():
+                inputs.append((wire, lens, fr, True))
+        for wire, _row, lens, frames_list in drained:
+            inputs.append((wire, lens, frames_list, False))
+        # -- snapshot under the engine lock (no device work) --------
+        with engine._lock:
+            state = engine.state  # flushes pending control-plane ops
+            E = state.capacity
+            if self.counters.tx_packets.shape[0] != E:
+                self.counters = init_counters(E)  # engine grew
+            # Rows are re-resolved HERE, under the lock — the drain's
+            # row values are advisory and compact() may have
+            # renumbered rows since (shaping a batch on a stale row
+            # id would apply the wrong link's qdiscs and deliver to
+            # the wrong pod). A wire whose link vanished re-queues.
+            batches: list[tuple[object, int, list[int], list[bytes],
+                                bool]] = []
+            requeue = []
+            for wire, lens, frames_list, predecided in inputs:
+                fresh = engine._rows.get((wire.pod_key, wire.uid))
+                if fresh is None:
+                    requeue.append((wire, frames_list))
+                    continue
+                batches.append((wire, fresh, lens, frames_list,
+                                predecided))
+            # frames entering a directed edge exit at the PEER pod's
+            # wire (the reference writes into the peer's pod-side
+            # veth, grpcwire.go:256-271); _row_owner is maintained
+            # incrementally, so this is O(batch), not O(rows)
+            rowinfo: dict[int, tuple[str, int] | None] = {}
+            for _w, row, _lens, _fr, _pd in batches:
+                key = engine._row_owner.get(row)
+                rowinfo[row] = (engine._peer.get(key, key)
+                                if key is not None else None)
+            shaped_rows = set(engine._shaped_rows)
+            # rows the control plane touches from here on keep their
+            # own dynamic state at write-back
+            engine._rows_touched.clear()
+        for wire, frames_list in requeue:
+            wire.ingress.extendleft(reversed(frames_list))
+        if not batches:
+            return 0
+
+        # -- vectorized bypass decision OUTSIDE the engine lock --------
+        # (eBPF sockops/redir semantics; no native flow table → no
+        # bypass, same gate as the per-frame _try_bypass)
+        ft = self._flowtable
+        if ft is not None:
+            flat_frames: list[bytes] = []
+            lens_parts: list[np.ndarray] = []
+            elig_parts: list[np.ndarray] = []
+            shp_parts: list[np.ndarray] = []
+            for _w, row, lens, fr, predecided in batches:
+                target = rowinfo.get(row)
+                ok = False
+                if target is not None and not predecided:
+                    # sockops redirection is strictly SAME-NODE
+                    # (socket-to-socket, redir.c:24-42); holdback frames
+                    # already took their verdict when first drained
+                    peer_wire = self.daemon.wires.get_by_key(*target)
+                    ok = peer_wire is not None and not peer_wire.peer_ip
+                m = len(fr)
+                flat_frames.extend(fr)
+                lens_parts.append(np.asarray(lens, np.uint64))
+                elig_parts.append(
+                    np.full(m, 1 if ok else 0, np.uint8))
+                shp_parts.append(
+                    np.full(m, 1 if row in shaped_rows else 0, np.uint8))
+            decide = ft.decide_batch(flat_frames,
+                                     np.concatenate(elig_parts),
+                                     np.concatenate(shp_parts),
+                                     lens=np.concatenate(lens_parts))
+            if decide.any():
+                pos = 0
+                kept_batches = []
+                for w, row, lens, fr, pd in batches:
+                    m = len(fr)
+                    d = decide[pos:pos + m]
+                    pos += m
+                    if d.any():
+                        by = [f for f, dd in zip(fr, d) if dd]
+                        self.bypassed += len(by)
+                        # latency ≈ 0: delivered in the same tick
+                        self.daemon.deliver_egress_bulk(*rowinfo[row], by)
+                        kl = [ln for ln, dd in zip(lens, d) if not dd]
+                        kf = [f for f, dd in zip(fr, d) if not dd]
+                        if kf:
+                            kept_batches.append((w, row, kl, kf, pd))
+                    else:
+                        kept_batches.append((w, row, lens, fr, pd))
+                batches = kept_batches
+        if not batches:
+            return 0
+
+        # -- route rows: slot-independent vs sequential ----------------
+        rows_np = np.fromiter((b[1] for b in batches), np.int64,
+                              count=len(batches))
+        props_rows = np.asarray(state.props[jnp.asarray(rows_np)])
+        indep = np.asarray(netem.slot_independent_rows(props_rows), bool)
+        seq_group = [i for i in range(len(batches)) if not indep[i]]
+        ind_group = [i for i in range(len(batches)) if indep[i]]
+        # sequential rows bound the scan length: the residue waits in
+        # the plane's holdback buffer (classified/decided exactly once)
+        # and shapes first next tick; its wire is excluded from the next
+        # drain so the buffer never exceeds one drain's worth
+        cap = self.seq_slots
+        for i in seq_group:
+            w, row, lens, fr, pd = batches[i]
+            if len(fr) > cap:
+                self._holdback[w.wire_id] = (w, lens[cap:], fr[cap:])
+                batches[i] = (w, row, lens[:cap], fr[:cap], pd)
+        if self._holdback:
+            # deferred work exists: the runner must tick again promptly
+            # rather than sleep out the period
+            self._wake.set()
+
+        # -- advance the persistent shaping clocks ---------------------
+        # by the wall time since the last shaped batch (the role
+        # sim.py's per-step roll_epoch plays in virtual-time mode)
+        if self._last_shaped_s is not None:
+            elapsed_us = max(0.0, (now_s - self._last_shaped_s) * 1e6)
+            if elapsed_us > 0.0:
+                state = netem.roll_epoch_nodonate(state,
+                                                  jnp.float32(elapsed_us))
+        # NOTE: committed only after a successful write-back — a
+        # skipped write-back (engine grew mid-shaping) must not
+        # swallow this interval's token refill
+        shaped_at = now_s
+
+        def pad_rows(n: int) -> int:
+            # coarse ladder (1, 8, 64, 512, ...) so the jit cache holds a
+            # handful of (R, K) shapes, not one per traffic pattern
+            p = 1
+            while p < n:
+                p <<= 3
+            return p
+
+        def pad_slots(n: int) -> int:
+            # finer ladder (1, 4, 16, ..., 1024): K is the expensive
+            # dimension, so waste at most 4×
+            p = 1
+            while p < n:
+                p <<= 2
+            return p
+
+        def build(group):
+            # padded [R, K] batch arrays; row_idx pads with E (gathers
+            # clamp harmlessly, write-back scatters drop)
+            R = len(group)
+            K = max(len(batches[i][3]) for i in group)
+            Rp, Kp = pad_rows(R), pad_slots(K)
+            row_idx = np.full(Rp, E, np.int32)
+            sizes = np.zeros((Rp, Kp), np.float32)
+            valid = np.zeros((Rp, Kp), bool)
+            for r, i in enumerate(group):
+                _w, row, lens, fr, _pd = batches[i]
+                m = len(fr)
+                row_idx[r] = row
+                sizes[r, :m] = lens
+                valid[r, :m] = True
+            return row_idx, sizes, valid
+
+        self._key, sub = jax.random.split(self._key)
+        state_after = state
+        group_results = []  # (group, res ShapeResult np, sizes, valid, row_idx)
+        if seq_group:
+            row_idx, sizes, valid = build(seq_group)
+            state_after, res = netem.shape_slots_nodonate(
+                state_after, jnp.asarray(row_idx), jnp.asarray(sizes),
+                jnp.asarray(valid), jax.random.fold_in(sub, 0))
+            group_results.append((seq_group, jax.tree.map(np.asarray, res),
+                                  sizes, valid, row_idx))
+        if ind_group:
+            row_idx, sizes, valid = build(ind_group)
+            res, new_count = netem.shape_slots_indep_nodonate(
+                state_after, jnp.asarray(row_idx), jnp.asarray(sizes),
+                jnp.asarray(valid), jax.random.fold_in(sub, 1))
+            state_after = dataclasses.replace(state_after,
+                                              pkt_count=new_count)
+            group_results.append((ind_group, jax.tree.map(np.asarray, res),
+                                  sizes, valid, row_idx))
+
+        # -- write back dynamic columns under the lock ----------------
+        with engine._lock:
+            cur = engine._state
+            if cur.capacity == state_after.capacity:
+                self._last_shaped_s = shaped_at
+                touched = engine._rows_touched
+                if touched:
+                    # rows applied/updated/deleted mid-shaping:
+                    # their flushed initialization (token fill,
+                    # cleared backlog) must win over our stale
+                    # pre-snapshot dynamics
+                    idx = jnp.asarray(sorted(touched), jnp.int32)
+
+                    def merge(new, old):
+                        return new.at[idx].set(old[idx])
+                else:
+                    def merge(new, old):  # noqa: ARG001
+                        return new
+                engine._state = dataclasses.replace(
+                    cur,
+                    tokens=merge(state_after.tokens, cur.tokens),
+                    t_last=merge(state_after.t_last, cur.t_last),
+                    backlog_until=merge(state_after.backlog_until,
+                                        cur.backlog_until),
+                    corr=merge(state_after.corr, cur.corr),
+                    pkt_count=merge(state_after.pkt_count,
+                                    cur.pkt_count))
+            # else: engine grew mid-shaping — drop this tick's
+            # dynamic-state advance rather than corrupt shapes;
+            # results below still schedule deliveries
+
+        # -- schedule releases: batched wheel insert ------------------
+        shaped = 0
+        deadline_parts: list[np.ndarray] = []
+        token_parts: list[np.ndarray] = []
+        use_wheel = self._wheel is not None
+        base_us = (now_s - self._origin_s) * 1e6
+        pending = self._pending
+        for group, res, _sizes, _valid, _row_idx in group_results:
+            deliv = res.delivered
+            depart = res.depart_us
+            for r, i in enumerate(group):
+                _w, row, _lens, fr, _pd = batches[i]
+                target = rowinfo.get(row)
+                m = len(fr)
+                drow = deliv[r, :m]
+                nd = int(drow.sum())
+                shaped += nd
+                self.dropped += m - nd
+                if nd == 0 or target is None:
+                    continue
+                if nd == m:
+                    sel_frames = fr
+                    sel_dep = depart[r, :m]
+                else:
+                    idxs = np.nonzero(drow)[0]
+                    sel_frames = [fr[j] for j in idxs.tolist()]
+                    sel_dep = depart[r, idxs]
+                pk, uid = target
+                s0 = self._seq
+                self._seq = s0 + nd
+                toks = range(s0 + 1, s0 + nd + 1)
+                if use_wheel:
+                    dls = base_us + sel_dep.astype(np.float64)
+                    # deadlines mirrored host-side so pending frames
+                    # are exportable (checkpointing)
+                    pending.update(zip(
+                        toks,
+                        ((pk, uid, f, d)
+                         for f, d in zip(sel_frames, dls.tolist()))))
+                    deadline_parts.append(dls)
+                    token_parts.append(
+                        np.arange(s0 + 1, s0 + nd + 1, dtype=np.uint64))
+                else:
+                    rel = (now_s
+                           + sel_dep.astype(np.float64) / 1e6).tolist()
+                    for t_rel, tok, f in zip(rel, toks, sel_frames):
+                        heapq.heappush(self._heap,
+                                       (t_rel, tok, pk, uid, f))
+            self._accumulate_rows(row_idx=_row_idx, res=res,
+                                  sizes=_sizes, valid=_valid)
+        if deadline_parts:
+            self._wheel.schedule_batch(np.concatenate(deadline_parts),
+                                       np.concatenate(token_parts))
+        return shaped
+
+    def _accumulate_rows(self, row_idx, res, sizes, valid) -> None:
+        """Accumulate one group's [R, K] shaping results into the
+        per-edge cumulative counters: a handful of row-indexed vector
+        adds, independent of frame count. Padding rows (index >= the
+        counter arrays) are masked out."""
+        rows = np.asarray(row_idx, np.int64)
+        cap = self.counters.tx_packets.shape[0]
+        keep = rows < cap
+        if not keep.any():
+            return
+        rows = rows[keep]
+        vs = valid[keep]
+        ss = sizes[keep]
+        deliv = res.delivered[keep]
+        loss = res.dropped_loss[keep]
+        queue = res.dropped_queue[keep]
+        corr = res.corrupted[keep]
         c = self.counters
+
+        def upd(arr, per_row):
+            a = np.asarray(arr).copy()
+            a[rows] += per_row  # rows are unique (one batch per wire)
+            return a
+
         self.counters = EdgeCounters(
-            tx_packets=c.tx_packets + tx_p,
-            tx_bytes=c.tx_bytes + tx_b,
-            rx_packets=c.rx_packets + deliv.sum(axis=1).astype(np.float32),
-            rx_bytes=c.rx_bytes + (sizes * deliv).sum(axis=1),
-            dropped_loss=c.dropped_loss + loss.sum(axis=1).astype(np.float32),
-            dropped_queue=c.dropped_queue +
-            queue.sum(axis=1).astype(np.float32),
+            tx_packets=upd(c.tx_packets, vs.sum(1).astype(np.float32)),
+            tx_bytes=upd(c.tx_bytes, (ss * vs).sum(1)),
+            rx_packets=upd(c.rx_packets, deliv.sum(1).astype(np.float32)),
+            rx_bytes=upd(c.rx_bytes, (ss * deliv).sum(1)),
+            dropped_loss=upd(c.dropped_loss,
+                             loss.sum(1).astype(np.float32)),
+            dropped_queue=upd(c.dropped_queue,
+                              queue.sum(1).astype(np.float32)),
             dropped_ring=c.dropped_ring,
-            rx_corrupted=c.rx_corrupted + corr.sum(axis=1).astype(np.float32),
+            rx_corrupted=upd(c.rx_corrupted,
+                             corr.sum(1).astype(np.float32)),
             duplicated=c.duplicated,
             reordered=c.reordered,
         )
@@ -555,14 +757,23 @@ class WireDataPlane:
     # -- release + cross-node streaming egress -------------------------
 
     def _release(self, now_s: float) -> None:
-        due: list[tuple[str, int, bytes]] = []
+        # ONE pass groups due frames by destination wire; delivery is then
+        # per-GROUP work (one egress extend, one lookup), keeping the
+        # per-frame cost to a dict-pop + append. Wheel release order is
+        # time-ordered; within a release batch per-wire FIFO order is
+        # preserved (appends happen in token order).
+        groups: dict[tuple[str, int], list[bytes]] = {}
+        setd = groups.setdefault
         if self._wheel is not None:
-            for token in self._wheel.advance((now_s - self._origin_s) * 1e6):
-                due.append(self._pending.pop(token)[:3])
+            pending_pop = self._pending.pop
+            for token in self._wheel.advance(
+                    (now_s - self._origin_s) * 1e6):
+                e = pending_pop(token)
+                setd((e[0], e[1]), []).append(e[2])
         else:
             while self._heap and self._heap[0][0] <= now_s:
                 _, _, pod_key, uid, frame = heapq.heappop(self._heap)
-                due.append((pod_key, uid, frame))
+                setd((pod_key, uid), []).append(frame)
         if self._orphans:
             # wires that appeared since last release get their waiting
             # frames; expired waits are counted, never silently dropped
@@ -570,7 +781,7 @@ class WireDataPlane:
             while self._orphans:
                 expire, pk, uid, frame = self._orphans.popleft()
                 if self.daemon.wires.get_by_key(pk, uid) is not None:
-                    due.append((pk, uid, frame))
+                    setd((pk, uid), []).append(frame)
                 elif now_s < expire:
                     keep.append((expire, pk, uid, frame))
                 else:
@@ -578,27 +789,33 @@ class WireDataPlane:
             self._orphans = keep
         staged = False
         ring_drops: dict[int, int] = {}
-        for pod_key, uid, frame in due:
-            wire = self.daemon.wires.get_by_key(pod_key, uid)
+        cap = self.daemon.capture
+        for wkey, frames in groups.items():
+            wire = self.daemon.wires.get_by_key(*wkey)
             if wire is None:
-                self._orphans.append(
-                    (now_s + self.orphan_grace_s, pod_key, uid, frame))
+                expire = now_s + self.orphan_grace_s
+                self._orphans.extend(
+                    (expire, wkey[0], wkey[1], f) for f in frames)
                 continue
             if wire.peer_ip:
                 # stage for the per-peer stream batch below
-                if self._remote.push(wire.peer_ip, wire.peer_intf_id, frame):
-                    staged = True
-                else:
-                    # overflow: charge the drop to this frame's edge so it
-                    # shows up in the interface metrics (tx_dropped)
-                    row = self.engine._rows.get((pod_key, uid))
-                    if row is not None:
-                        ring_drops[row] = ring_drops.get(row, 0) + 1
+                push = self._remote.push
+                addr, intf = wire.peer_ip, wire.peer_intf_id
+                for frame in frames:
+                    if push(addr, intf, frame):
+                        staged = True
+                    else:
+                        # overflow: charge the drop to this frame's edge
+                        # so it shows up in the interface metrics
+                        # (tx_dropped)
+                        row = self.engine._rows.get(wkey)
+                        if row is not None:
+                            ring_drops[row] = ring_drops.get(row, 0) + 1
             else:
-                wire.egress.append(frame)
-                cap = self.daemon.capture
+                wire.egress.extend(frames)
                 if cap is not None:
-                    cap.record(pod_key, uid, frame, "out")
+                    for frame in frames:
+                        cap.record(*wkey, frame, "out")
         if ring_drops:
             # one counter-array copy per release, however many frames fell
             dr = np.asarray(self.counters.dropped_ring).copy()
@@ -610,11 +827,22 @@ class WireDataPlane:
         if staged:
             self._flush_remote()
 
+    # frames per coalesced PacketBatch message on the bulk transport
+    BULK_CHUNK = 256
+
     def _flush_remote(self) -> None:
-        """Ship all staged cross-node frames: ONE SendToStream per peer
-        daemon per tick (vs the reference's unary-per-frame hot loop,
-        grpcwire.go:452-459). Per-peer deadline bounds a blackholed peer
-        to one timeout per tick, and errors are counted, not fatal."""
+        """Ship all staged cross-node frames. Preferred transport: ONE
+        SendToBulk stream of coalesced PacketBatch messages per peer per
+        tick — Python gRPC moves ~25k MESSAGES/s regardless of payload,
+        so the per-frame stream alone caps the live plane; coalescing
+        ~256 frames/message lifts the same path above 1M frames/s. A
+        peer that answers UNIMPLEMENTED (a reference-built daemon) is
+        remembered and gets the per-frame SendToStream (vs the
+        reference's unary-per-frame hot loop, grpcwire.go:452-459).
+        Per-peer deadline bounds a blackholed peer to one timeout per
+        tick, and errors are counted, not fatal."""
+        import grpc
+
         from kubedtn_tpu.wire import proto as pb
 
         by_peer: dict[str, list] = {}
@@ -626,11 +854,27 @@ class WireDataPlane:
             by_peer.setdefault(addr, []).append(
                 pb.Packet(remot_intf_id=intf, frame=frame))
         for addr, packets in by_peer.items():
+            daemon = self.daemon
             try:
-                self.daemon._peer_wire_client(addr).SendToStream(
-                    iter(packets), timeout=self.daemon.forward_timeout_s)
+                if daemon.peer_bulk_ok.get(addr, True):
+                    chunks = [
+                        pb.PacketBatch(
+                            packets=packets[i:i + self.BULK_CHUNK])
+                        for i in range(0, len(packets), self.BULK_CHUNK)]
+                    try:
+                        daemon._peer_wire_client(addr).SendToBulk(
+                            iter(chunks),
+                            timeout=daemon.forward_timeout_s)
+                        continue
+                    except grpc.RpcError as e:
+                        if e.code() != grpc.StatusCode.UNIMPLEMENTED:
+                            raise
+                        # reference-built peer: per-frame stream forever
+                        daemon.peer_bulk_ok[addr] = False
+                daemon._peer_wire_client(addr).SendToStream(
+                    iter(packets), timeout=daemon.forward_timeout_s)
             except Exception:
-                self.daemon.forward_errors += len(packets)
+                daemon.forward_errors += len(packets)
 
     # -- metrics feed --------------------------------------------------
 

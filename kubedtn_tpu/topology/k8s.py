@@ -48,6 +48,144 @@ class K8sUnavailable(RuntimeError):
     """The kubernetes client package is not importable."""
 
 
+class WatchExpiredError(RuntimeError):
+    """The watch's resourceVersion fell out of the apiserver's retained
+    window (HTTP 410 Gone / an ERROR event with code 410). Recovery is a
+    fresh LIST — NOT a backoff-resume, which would 410 forever."""
+
+    status = 410
+
+
+class ApiHttpError(RuntimeError):
+    """Non-2xx from the HTTP transport; `.status` carries the code so the
+    bridge's 404/409/410 handling works like the kubernetes client's
+    ApiException."""
+
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class HttpKubeApi:
+    """The bridge transport over the RAW Kubernetes REST protocol — no
+    `kubernetes` package needed. Point it at any endpoint speaking the
+    CustomObjects surface: `kubectl proxy` (http://127.0.0.1:8001) in
+    production, the test suite's protocol-level fake apiserver
+    (tests/fake_apiserver.py, the envtest role of reference
+    controllers/suite_test.go:44-80) in CI.
+
+    Implements the duck-typed surface K8sBridge expects
+    (list_topologies / watch_topologies / patch_status /
+    patch_finalizers). Watch streams JSON-lines events; an ERROR event
+    carrying code 410 raises WatchExpiredError so the informer loop
+    re-lists instead of resuming.
+    """
+
+    def __init__(self, base_url: str, namespace: str | None = None,
+                 timeout_s: float = 30.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.namespace = namespace
+        self.timeout_s = timeout_s
+
+    # -- plumbing ------------------------------------------------------
+
+    def _collection_path(self) -> str:
+        if self.namespace is None:
+            return f"/apis/{GROUP}/{VERSION}/{PLURAL}"
+        return (f"/apis/{GROUP}/{VERSION}/namespaces/"
+                f"{self.namespace}/{PLURAL}")
+
+    def _object_path(self, ns: str, name: str, sub: str = "") -> str:
+        p = f"/apis/{GROUP}/{VERSION}/namespaces/{ns}/{PLURAL}/{name}"
+        return p + (f"/{sub}" if sub else "")
+
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 content_type: str = "application/json") -> dict:
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        data = _json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method,
+            headers={"Content-Type": content_type} if data else {})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as resp:
+                return _json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise ApiHttpError(e.code, detail) from e
+
+    # -- bridge surface ------------------------------------------------
+
+    def list_topologies(self) -> tuple[list[dict], str]:
+        r = self._request("GET", self._collection_path())
+        return r.get("items", []), r["metadata"]["resourceVersion"]
+
+    def watch_topologies(self, resource_version: str):
+        import json as _json
+        import urllib.request
+
+        url = (f"{self.base_url}{self._collection_path()}"
+               f"?watch=true&resourceVersion={resource_version}")
+        req = urllib.request.Request(url)
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            for raw in resp:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                ev = _json.loads(raw)
+                if ev.get("type") == "ERROR":
+                    code = ev.get("object", {}).get("code")
+                    if code == 410:
+                        raise WatchExpiredError(
+                            ev["object"].get("message", "expired"))
+                    raise ApiHttpError(code or 500,
+                                       ev["object"].get("message", ""))
+                yield ev["type"], ev["object"]
+
+    def patch_status(self, ns: str, name: str, status: dict) -> None:
+        self._request("PATCH", self._object_path(ns, name, "status"),
+                      {"status": status},
+                      content_type="application/merge-patch+json")
+
+    def patch_finalizers(self, ns: str, name: str,
+                         finalizers: list[str]) -> None:
+        self._request("PATCH", self._object_path(ns, name),
+                      {"metadata": {"finalizers": finalizers}},
+                      content_type="application/merge-patch+json")
+
+
+class HttpLeaseApi:
+    """coordination.k8s.io/v1 Leases over raw HTTP, shaped like the
+    kubernetes CoordinationV1Api surface KubeLeaseStore injects
+    (read/create/replace_namespaced_lease returning dict manifests) —
+    real cross-pod leader election through `kubectl proxy` or the test
+    fake, with the apiserver's resourceVersion CAS intact (a PUT with a
+    stale RV answers 409, which KubeLeaseStore reads as a lost
+    election)."""
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0) -> None:
+        self._api = HttpKubeApi(base_url, timeout_s=timeout_s)
+
+    @staticmethod
+    def _path(ns: str, name: str = "") -> str:
+        p = f"/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
+        return p + (f"/{name}" if name else "")
+
+    def read_namespaced_lease(self, name: str, namespace: str) -> dict:
+        return self._api._request("GET", self._path(namespace, name))
+
+    def create_namespaced_lease(self, namespace: str, body: dict) -> dict:
+        return self._api._request("POST", self._path(namespace), body)
+
+    def replace_namespaced_lease(self, name: str, namespace: str,
+                                 body: dict) -> dict:
+        return self._api._request("PUT", self._path(namespace, name),
+                                  body)
+
+
 def make_kube_api(namespace: str | None = None):
     """Wrap the real `kubernetes` package into the bridge's transport
     surface. Raises K8sUnavailable when the package is absent (it is not
@@ -243,22 +381,58 @@ class K8sBridge:
 
     # -- background informer ------------------------------------------
 
+    # transient-failure backoff bounds (client-go reflector shape)
+    BACKOFF_INITIAL_S = 1.0
+    BACKOFF_MAX_S = 30.0
+
+    @staticmethod
+    def _is_expired(e: Exception) -> bool:
+        return isinstance(e, WatchExpiredError) or \
+            getattr(e, "status", None) == 410
+
     def run(self, on_error: Callable[[Exception], None] | None = None,
             stop: threading.Event | None = None) -> None:
-        """Blocking informer loop: LIST once, then WATCH forever, re-listing
-        on watch failure (the reference informer's resync behavior)."""
+        """Blocking informer loop: LIST once, then WATCH forever.
+
+        Failure handling distinguishes the two reflector cases instead
+        of treating every exception as "sleep 1s, full re-list":
+
+        - **410 Gone / WatchExpiredError** (our resourceVersion fell out
+          of the apiserver's retained window): a fresh LIST is the
+          correct and ONLY recovery — taken immediately, no backoff
+          (waiting cannot un-expire the version).
+        - **transient errors** (network blips, apiserver restarts,
+          5xx): resume the WATCH from the last seen resourceVersion
+          after an exponential backoff (1s → 30s), WITHOUT re-listing —
+          at 100k CRs a full LIST per blip is the difference between a
+          hiccup and an outage.
+
+        A successful watch event resets the backoff.
+        """
         stop = stop if stop is not None else self._stop
+        backoff = self.BACKOFF_INITIAL_S
+        need_list = True
         while not stop.is_set():
             try:
-                self.sync_once()
+                if need_list:
+                    self.sync_once()
+                    need_list = False
+                    backoff = self.BACKOFF_INITIAL_S
                 for ev in self.api.watch_topologies(self.cluster_rv):
                     if stop.is_set():
                         return
                     self.pump([ev])
-            except Exception as e:  # watch expired / transient API error
+                    backoff = self.BACKOFF_INITIAL_S
+                # orderly end of stream (server-side watch timeout):
+                # immediately re-watch from the last seen version
+            except Exception as e:
                 if on_error is not None:
                     on_error(e)
-                stop.wait(1.0)
+                if self._is_expired(e):
+                    need_list = True  # re-list NOW; no sleep
+                    continue
+                stop.wait(backoff)
+                backoff = min(backoff * 2.0, self.BACKOFF_MAX_S)
 
     def start(self) -> None:
         if self._thread is not None:

@@ -251,6 +251,17 @@ void kdt_classify_batch(const uint8_t* buf, const uint64_t* offsets,
   }
 }
 
+// Pointer-array form: the caller passes each frame's own buffer (ctypes
+// c_char_p straight into the Python bytes objects) — no concatenated
+// blob copy on the hot path.
+void kdt_classify_batch_ptrs(const uint8_t* const* frames,
+                             const uint64_t* lens, int64_t n,
+                             int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = kdt_classify_frame(frames[i], lens[i]);
+  }
+}
+
 // ===================== 2. bypass flow table =====================
 
 enum ProxyFlag : int32_t {
@@ -385,6 +396,116 @@ void kdt_ft_shaped_egress(void* h, uint32_t sip, uint16_t sport,
   if (it != ft->proxy.end() && it->second.flag != KDT_PROXY_DISABLED) {
     it->second.flag = KDT_PROXY_DISABLED;
   }
+}
+
+// Batched bypass decision for a whole ingress drain — the per-frame
+// Python path (runtime._try_bypass) collapsed to ONE native call per
+// tick. For each frame i: parse the IPv4/TCP 4-tuple (802.1Q-aware,
+// fragments excluded — non-first fragments carry payload where the TCP
+// header would be); when first seen, register both sockops
+// establishment hooks (both endpoints are local wires, so active and
+// passive establish fire on this node, as at connection setup in the
+// reference, BEFORE any frame crosses a device); a frame on a shaped
+// row disables its flow forever (redir_disable.c:44-48); otherwise the
+// sk_msg verdict decides. eligible[i]=0 (no local peer wire) and
+// non-TCP frames always take the shaping path. out_bypass[i]=1 means
+// the frame short-circuits shaping. Returns how many bypassed.
+}  // extern "C"
+
+namespace {
+
+// One frame's bypass decision with ft->mu already held: parse the
+// IPv4/TCP 4-tuple (802.1Q-aware, fragments excluded), establish on
+// first sight, disable forever on a shaped row, else the sk_msg
+// verdict. Returns 1 when the frame bypasses shaping.
+inline uint8_t decide_one(FlowTable* ft, const uint8_t* f, uint64_t len,
+                          uint8_t shaped) {
+  // -- parse_tcp_flow parity (runtime.py) --
+  if (len < 14) return 0;
+  uint64_t off = 14;
+  uint16_t ether_type = rd16(f + 12);
+  if (ether_type == 0x8100 && len >= 18) {
+    ether_type = rd16(f + 16);
+    off = 18;
+  }
+  if (ether_type != 0x0800 || len < off + 20) return 0;
+  const int ihl = (f[off] & 0x0F) * 4;
+  if ((f[off] >> 4) != 4 || ihl < 20 || len < off + ihl + 4) return 0;
+  if (f[off + 9] != 6) return 0;  // TCP only
+  if ((rd16(f + off + 6) & 0x3FFF) != 0) return 0;  // any fragment
+  const uint32_t sip = static_cast<uint32_t>(f[off + 12]) << 24 |
+                       static_cast<uint32_t>(f[off + 13]) << 16 |
+                       static_cast<uint32_t>(f[off + 14]) << 8 |
+                       f[off + 15];
+  const uint32_t dip = static_cast<uint32_t>(f[off + 16]) << 24 |
+                       static_cast<uint32_t>(f[off + 17]) << 16 |
+                       static_cast<uint32_t>(f[off + 18]) << 8 |
+                       f[off + 19];
+  const uint16_t sport = rd16(f + off + ihl);
+  const uint16_t dport = rd16(f + off + ihl + 2);
+  const Tuple4 fwd{sip, dip, sport, dport};
+  auto it = ft->proxy.find(fwd);
+  if (it == ft->proxy.end()) {
+    // first sight: active then passive establish (sockops pair)
+    if (sip != dip || sport != dport) {
+      if (ft->active_estab.size() < ft->capacity) {
+        ft->active_estab.emplace(Addr2{sip, sport}, Addr2{dip, dport});
+      }
+      auto ae = ft->active_estab.find(Addr2{sip, sport});
+      if (ae != ft->active_estab.end() &&
+          ft->proxy.size() + 2 <= ft->capacity) {
+        const Addr2 orig = ae->second;
+        const Tuple4 proxy_key{sip, orig.ip, sport, orig.port};
+        const Tuple4 proxy_val{dip, sip, dport, sport};
+        ft->proxy[proxy_key] = ProxyVal{proxy_val, KDT_PROXY_INIT};
+        ft->proxy[proxy_val] = ProxyVal{proxy_key, KDT_PROXY_INIT};
+        ft->active_estab.erase(ae);
+      }
+      it = ft->proxy.find(fwd);
+    }
+  }
+  if (shaped) {
+    // traffic crossing a shaped device disables the flow FOREVER
+    if (it != ft->proxy.end() && it->second.flag != KDT_PROXY_DISABLED) {
+      it->second.flag = KDT_PROXY_DISABLED;
+    }
+    return 0;
+  }
+  // sk_msg verdict (kdt_ft_msg_redirect body, lock already held)
+  if (it == ft->proxy.end() || it->second.flag == KDT_PROXY_DISABLED) {
+    ft->passed.fetch_add(1, std::memory_order_relaxed);
+    return 0;
+  }
+  if (it->second.flag == KDT_PROXY_INIT) {
+    it->second.flag = KDT_PROXY_ENABLED;
+    ft->passed.fetch_add(1, std::memory_order_relaxed);
+    return 0;
+  }
+  ft->bypassed.fetch_add(1, std::memory_order_relaxed);
+  return 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pointer-array form: no concatenated blob copy (see
+// kdt_classify_batch_ptrs).
+int64_t kdt_ft_decide_batch_ptrs(void* h, const uint8_t* const* frames,
+                                 const uint64_t* lens, int64_t n,
+                                 const uint8_t* eligible,
+                                 const uint8_t* shaped,
+                                 uint8_t* out_bypass) {
+  auto* ft = static_cast<FlowTable*>(h);
+  std::lock_guard<std::mutex> g(ft->mu);
+  int64_t bypassed = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    out_bypass[i] = eligible[i]
+        ? decide_one(ft, frames[i], lens[i], shaped[i])
+        : 0;
+    bypassed += out_bypass[i];
+  }
+  return bypassed;
 }
 
 // TCP close (sockops.c bpf_sock_ops_state_cb): drop this direction's proxy
@@ -646,6 +767,18 @@ void kdt_tw_schedule(void* h, uint64_t when_us, uint64_t token) {
   std::lock_guard<std::mutex> g(tw->mu);
   tw->place(when_us, token);
   ++tw->size;
+}
+
+// Batched schedule: the whole tick's delivered frames in one call (one
+// lock acquisition, no per-frame ctypes crossing).
+void kdt_tw_schedule_batch(void* h, const uint64_t* when_us,
+                           const uint64_t* tokens, int64_t n) {
+  auto* tw = static_cast<TimingWheel*>(h);
+  std::lock_guard<std::mutex> g(tw->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    tw->place(when_us[i], tokens[i]);
+  }
+  tw->size += static_cast<uint64_t>(n);
 }
 
 // Advance virtual time to now_us; write up to cap tokens whose deadline

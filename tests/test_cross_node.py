@@ -348,20 +348,28 @@ def test_health_watch_parking_capped(two_nodes):
 
 
 def test_cross_node_egress_batches_over_sendtostream():
-    """Released cross-node frames cross as ONE SendToStream batch per peer
-    per tick, not one unary RPC per frame (the reference's per-packet hot
-    loop, grpcwire.go:452)."""
+    """Released cross-node frames cross as ONE coalesced SendToBulk
+    stream per peer per tick — not one unary RPC per frame (the
+    reference's per-packet hot loop, grpcwire.go:452) and not one gRPC
+    message per frame either (Python gRPC caps near ~25k messages/s)."""
     from kubedtn_tpu.runtime import WireDataPlane
 
     class CountingDaemon(Daemon):
         stream_calls = 0
+        bulk_calls = 0
 
         def SendToStream(self, request_iterator, context):
             resp = super().SendToStream(request_iterator, context)
             type(self).stream_calls += 1
             return resp
 
+        def SendToBulk(self, request_iterator, context):
+            resp = super().SendToBulk(request_iterator, context)
+            type(self).bulk_calls += 1
+            return resp
+
     CountingDaemon.stream_calls = 0
+    CountingDaemon.bulk_calls = 0
     store_b = TopologyStore()
     engine_b = SimEngine(store_b, capacity=64)
     daemon_b = CountingDaemon(engine_b)
@@ -392,9 +400,77 @@ def test_cross_node_egress_batches_over_sendtostream():
     dp_a.tick(now_s=5.001)  # unshaped: released immediately
     got = list(wire_b.egress)
     assert len(got) == n, f"only {len(got)}/{n} frames crossed"
-    assert CountingDaemon.stream_calls == 1, \
-        f"{CountingDaemon.stream_calls} stream calls for one tick's batch"
+    assert CountingDaemon.bulk_calls == 1, \
+        f"{CountingDaemon.bulk_calls} bulk calls for one tick's batch"
+    assert CountingDaemon.stream_calls == 0  # bulk peer: no fallback
     assert daemon_a.forward_errors == 0
+    server_b.stop(0)
+
+
+def test_cross_node_egress_falls_back_to_stream_for_reference_peer():
+    """A peer daemon that doesn't implement the SendToBulk extension (a
+    reference-built Go daemon — its IDL stops at SendToStream,
+    kube_dtn.proto:171) answers UNIMPLEMENTED once; the egress flush
+    remembers that and ships every later batch over the per-frame
+    SendToStream, losing nothing."""
+    import grpc as _grpc
+
+    from kubedtn_tpu.runtime import WireDataPlane
+
+    class RefDaemon(Daemon):
+        stream_calls = 0
+
+        def SendToBulk(self, request_iterator, context):
+            context.abort(_grpc.StatusCode.UNIMPLEMENTED,
+                          "method SendToBulk not implemented")
+
+        def SendToStream(self, request_iterator, context):
+            resp = super().SendToStream(request_iterator, context)
+            type(self).stream_calls += 1
+            return resp
+
+    RefDaemon.stream_calls = 0
+    store_b = TopologyStore()
+    engine_b = SimEngine(store_b, capacity=64)
+    daemon_b = RefDaemon(engine_b)
+    server_b, port_b = make_server(daemon_b, port=0, host="127.0.0.1")
+    server_b.start()
+    addr_b = f"127.0.0.1:{port_b}"
+
+    store_a = TopologyStore()
+    engine_a = SimEngine(store_a, capacity=64)
+    engine_a.node_ip = "127.0.0.1:1"
+    daemon_a = Daemon(engine_a)
+    t1, _ = seed(store_a, engine_a.node_ip, addr_b, latency="")
+    engine_a.add_links(t1, t1.spec.links)
+
+    wire_b = daemon_b._add_wire(pb.WireDef(
+        local_pod_name="r2", kube_ns="default", link_uid=7,
+        intf_name_in_pod="eth1", peer_ip="127.0.0.1:1", peer_intf_id=1))
+    wire_a = daemon_a._add_wire(pb.WireDef(
+        local_pod_name="r1", kube_ns="default", link_uid=7,
+        intf_name_in_pod="eth1", peer_ip=addr_b,
+        peer_intf_id=wire_b.wire_id))
+
+    dp_a = WireDataPlane(daemon_a, max_slots=16)
+    n = 6
+    for i in range(n):
+        wire_a.ingress.append(bytes([i]) * 60)
+    dp_a.tick(now_s=5.0)
+    dp_a.tick(now_s=5.001)
+    assert len(wire_b.egress) == n, \
+        f"only {len(wire_b.egress)}/{n} frames crossed on fallback"
+    assert RefDaemon.stream_calls == 1
+    assert daemon_a.peer_bulk_ok.get(addr_b) is False
+    assert daemon_a.forward_errors == 0
+
+    # second batch goes straight to the stream, no bulk retry
+    for i in range(3):
+        wire_a.ingress.append(bytes([0x40 + i]) * 60)
+    dp_a.tick(now_s=5.1)
+    dp_a.tick(now_s=5.101)
+    assert len(wire_b.egress) == n + 3
+    assert RefDaemon.stream_calls == 2
     server_b.stop(0)
 
 

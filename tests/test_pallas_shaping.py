@@ -150,3 +150,88 @@ def test_inactive_and_no_packet_lanes_untouched():
                                   np.asarray(state.tokens)[idle])
     np.testing.assert_array_equal(np.asarray(new_state.pkt_count)[idle],
                                   np.asarray(state.pkt_count)[idle])
+
+
+@pytest.mark.parametrize("capacity", [1024, 3000])
+def test_tiled_step_matches_dropin_with_external_uniforms(capacity):
+    """The persistent-tiled kernel with external (threefry) uniforms is
+    bit-identical to the drop-in pallas path AND the vmapped path for
+    the same key — tiling is pure layout, not semantics."""
+    state = random_state(capacity, seed=11)
+    E = state.capacity
+    sizes = jnp.asarray(
+        np.random.default_rng(1).uniform(64, 1500, E).astype(np.float32))
+    have = state.active
+    t0s = jnp.zeros((E,), jnp.float32)
+    key = jax.random.key(99)
+
+    ref_state, ref_res = netem.shape_step.__wrapped__(
+        jax.tree.map(lambda x: x.copy(), state), sizes, have, t0s, key)
+
+    tstate = shaping.tile_state(state)
+    u = jax.random.uniform(key, (E, netem.NU), dtype=jnp.float32)
+    e_pad = tstate.tokens.shape[0] * shaping.LANE
+    u_t = shaping._tiles(u, e_pad)
+    sizes_t = shaping.tile_vec(sizes, tstate)
+    act_t = shaping.tile_vec((have & state.active).astype(jnp.int32),
+                             tstate)
+    t_arr_t = shaping.tile_vec(t0s, tstate)
+    tstate2, depart, flags = shaping.shape_step_tiled(
+        tstate, sizes_t, act_t, t_arr_t, 0, u_t, interpret=True)
+    got_state = shaping.untile_state(tstate2, state)
+
+    assert_state_close(ref_state, got_state)
+    fl = np.asarray(flags).reshape(-1)[:E]
+    dep = np.asarray(depart).reshape(-1)[:E]
+    ref_dep = np.asarray(ref_res.depart_us)
+    fin = np.isfinite(ref_dep)
+    assert np.array_equal(np.isfinite(dep), fin)
+    # same tolerance as the drop-in parity tests: fused-multiply
+    # contraction differs from the vmapped HLO by ~1 ULP on some lanes
+    np.testing.assert_allclose(dep[fin], ref_dep[fin], rtol=1e-5,
+                               atol=1e-2)
+    assert np.array_equal((fl & shaping.FLAG_DELIVERED) > 0,
+                          np.asarray(ref_res.delivered))
+    assert np.array_equal((fl & shaping.FLAG_DROP_LOSS) > 0,
+                          np.asarray(ref_res.dropped_loss))
+    assert np.array_equal((fl & shaping.FLAG_DROP_QUEUE) > 0,
+                          np.asarray(ref_res.dropped_queue))
+
+
+def test_tiled_state_roundtrip_and_multi_step_loop():
+    """tile -> N tiled steps -> untile equals N drop-in steps (external
+    uniforms), i.e. the persistent layout carries the whole mutable
+    state correctly across steps."""
+    state = random_state(2048, seed=5)
+    E = state.capacity
+    sizes = jnp.full((E,), 900.0, jnp.float32)
+    t0s = jnp.zeros((E,), jnp.float32)
+    key = jax.random.key(3)
+
+    ref = jax.tree.map(lambda x: x.copy(), state)
+    for i in range(4):
+        ref, _ = netem.shape_step.__wrapped__(
+            ref, sizes, ref.active, t0s, jax.random.fold_in(key, i))
+
+    tstate = shaping.tile_state(state)
+    e_pad = tstate.tokens.shape[0] * shaping.LANE
+    sizes_t = shaping.tile_vec(sizes, tstate)
+    act_t = shaping.tile_vec(state.active.astype(jnp.int32), tstate)
+    t_arr_t = shaping.tile_vec(t0s, tstate)
+    for i in range(4):
+        u = jax.random.uniform(jax.random.fold_in(key, i), (E, netem.NU),
+                               dtype=jnp.float32)
+        tstate, _, _ = shaping.shape_step_tiled(
+            tstate, sizes_t, act_t, t_arr_t, i,
+            shaping._tiles(u, e_pad), interpret=True)
+    got = shaping.untile_state(tstate, state)
+    assert_state_close(ref, got)
+
+
+def test_tiled_prng_requires_uniforms_under_interpret():
+    state = random_state(1024, seed=2)
+    tstate = shaping.tile_state(state)
+    z = shaping.tile_vec(jnp.zeros((state.capacity,), jnp.float32), tstate)
+    a = shaping.tile_vec(jnp.zeros((state.capacity,), jnp.int32), tstate)
+    with pytest.raises(ValueError, match="interpret mode"):
+        shaping.shape_step_tiled(tstate, z, a, z, 7, interpret=True)
