@@ -413,6 +413,18 @@ def main() -> None:
 
     with_retry("live_plane", run_live_plane, extras)
 
+    def run_reconverge_10k():
+        from kubedtn_tpu.scenarios import reconverge_10k
+
+        r = reconverge_10k(events=2 if degraded else 4)
+        extras["reconverge_10k"] = {
+            k: r[k] for k in ("nodes", "links", "full_recompute_s",
+                              "reconverge_s_steady", "speedup_vs_full",
+                              "matches_full_recompute")
+        }
+
+    with_retry("reconverge_10k", run_reconverge_10k, extras)
+
     def run_scale_1m():
         from kubedtn_tpu.scenarios import scale_1m
 

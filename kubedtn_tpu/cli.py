@@ -153,6 +153,22 @@ def cmd_daemon(args) -> int:
     setup()
     log = get_logger("daemon")
 
+    # persistent compilation cache: a restarted daemon skips the one-time
+    # batch-kernel compiles (seconds each on first traffic) that would
+    # otherwise show up as multi-second delivery latency right after boot
+    try:
+        import jax as _jax
+
+        cache_dir = os.environ.get(
+            "KUBEDTN_JAX_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "kubedtn-jax"))
+        _jax.config.update("jax_compilation_cache_dir", cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           1.0)
+    except Exception as e:  # an optimization, never fatal
+        log.info("compilation cache unavailable: %r", e)
+
     if args.port is None:
         args.port = _env_port("GRPC_PORT", 51111)
     if args.metrics_port is None:

@@ -163,6 +163,50 @@ def random_mesh(n_nodes: int, n_links: int, seed: int = 0,
     return _mk(names, pairs, props)
 
 
+def three_tier(pods: int = 100, leaves_per_pod: int = 96,
+               aggs_per_pod: int = 4, cores: int = 40,
+               uplinks_per_leaf: int = 2, cores_per_agg: int = 10,
+               seed: int = 0,
+               props: LinkProperties | None = None) -> EdgeList:
+    """Three-tier DC fabric at cluster scale: `pods` pods of
+    leaves + aggs, a shared core layer — the 10k-node structured
+    topology for the flap-reconvergence rung (a k8s cluster network's
+    shape, unlike random_mesh's high-betweenness sparse graph). Each
+    leaf uplinks to `uplinks_per_leaf` of its pod's aggs, each agg to
+    `cores_per_agg` cores. Per-link latencies get a deterministic ±10%
+    spread (seeded) so shortest paths are mostly unique — the
+    realistic-reconvergence regime rather than the all-ties one.
+
+    Defaults: 100*(96+4)+40 = 10_040 nodes, 100*96*2 + 100*4*10 =
+    23_200 links."""
+    rng = np.random.default_rng(seed)
+    names = [f"core{c}" for c in range(cores)]
+    names += [f"p{p}-agg{a}" for p in range(pods)
+              for a in range(aggs_per_pod)]
+    names += [f"p{p}-leaf{i}" for p in range(pods)
+              for i in range(leaves_per_pod)]
+    agg0 = cores
+    leaf0 = cores + pods * aggs_per_pod
+    pairs = []
+    for p in range(pods):
+        for i in range(leaves_per_pod):
+            leaf = leaf0 + p * leaves_per_pod + i
+            for u in range(uplinks_per_leaf):
+                agg = agg0 + p * aggs_per_pod + (i + u) % aggs_per_pod
+                pairs.append((leaf, agg))
+        for a in range(aggs_per_pod):
+            agg = agg0 + p * aggs_per_pod + a
+            for c in range(cores_per_agg):
+                core = (a * cores_per_agg + c + p) % cores
+                pairs.append((agg, core))
+    el = _mk(names, pairs, props)
+    base = el.props[:, es.P_LATENCY_US].copy()
+    base = np.where(base > 0, base, 1000.0)
+    el.props[:, es.P_LATENCY_US] = base * rng.uniform(0.9, 1.1,
+                                                      el.n_links)
+    return el
+
+
 def fat_tree(k: int, props: LinkProperties | None = None) -> EdgeList:
     """Standard k-ary fat-tree (k even): (k/2)² cores, k pods of k/2 agg +
     k/2 edge switches, k²/4 core-agg links per pod side, agg-edge full

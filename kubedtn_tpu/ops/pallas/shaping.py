@@ -199,6 +199,18 @@ def _shape_kernel(props_ref, corr_ref, u_ref, tokens_ref, t_last_ref,
                      t_last_out, backlog_out, corr_out, count_out)
 
 
+def _bits_to_uniform(bits: jax.Array) -> jax.Array:
+    """Random BITS → f32 uniforms in [0, 1) with a 24-bit mantissa.
+
+    pltpu.prng_random_bits returns a SIGNED int32 array; a plain
+    `bits >> 8` would be an arithmetic shift (sign-extending), mapping
+    half of all draws to NEGATIVE "uniforms" — which would read as
+    certain loss/duplicate/corrupt hits in the kernel. Bitcast to
+    uint32 first so the shift is logical."""
+    ub = jax.lax.bitcast_convert_type(bits, jnp.uint32)
+    return (ub >> jnp.uint32(8)).astype(jnp.float32) * (2.0 ** -24)
+
+
 def _shape_kernel_prng(seed_ref, props_ref, corr_ref, tokens_ref,
                        t_last_ref, backlog_ref, count_ref, sizes_ref,
                        t_arr_ref, act_ref, depart_ref, flags_ref,
@@ -213,7 +225,7 @@ def _shape_kernel_prng(seed_ref, props_ref, corr_ref, tokens_ref,
     br, lane = tokens_ref.shape
     pltpu.prng_seed(seed_ref[0], pl.program_id(0))
     bits = pltpu.prng_random_bits((netem.NU, br, lane))
-    u_all = (bits >> jnp.uint32(8)).astype(jnp.float32) * (2.0 ** -24)
+    u_all = _bits_to_uniform(bits)
     u = tuple(u_all[k] for k in range(netem.NU))
     _shape_tile_math(u, props_ref, corr_ref, tokens_ref, t_last_ref,
                      backlog_ref, count_ref, sizes_ref, t_arr_ref,

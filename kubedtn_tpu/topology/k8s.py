@@ -125,13 +125,24 @@ class HttpKubeApi:
 
     def watch_topologies(self, resource_version: str):
         import json as _json
+        import socket
         import urllib.request
 
         url = (f"{self.base_url}{self._collection_path()}"
                f"?watch=true&resourceVersion={resource_version}")
         req = urllib.request.Request(url)
+        # a connect failure IS a transient error and propagates; but once
+        # the stream is up, a read timeout just means the cluster was
+        # idle for timeout_s — that's an orderly end of stream (client-go
+        # re-watches immediately), NOT a failure to back off from
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-            for raw in resp:
+            while True:
+                try:
+                    raw = resp.readline()
+                except (TimeoutError, socket.timeout):
+                    return  # idle stream: caller re-watches from last RV
+                if not raw:
+                    return  # server closed the stream
                 raw = raw.strip()
                 if not raw:
                     continue

@@ -1,0 +1,137 @@
+"""Live-plane delivery-latency floor: measured, pinned, documented.
+
+The reference's netem delays are enforced by the kernel's qdisc watchdog
+(hrtimer, ~µs accuracy). This plane binds virtual time to the wall clock
+in the runner thread: ingress wakes a tick immediately and the runner
+sleeps until the timing wheel's next deadline, so the expected error is
+
+- delays >= ~1 tick period: sub-millisecond (the wheel wakes the runner
+  just-in-time; measured ~0.2ms median on an idle CPU host);
+- sub-tick delays (e.g. 1ms): one or two device-dispatch times (the
+  shaping call itself takes ~1-3ms on the CPU backend), bounded by one
+  tick period.
+
+These tests pin those bounds with CI headroom. docs/OPERATIONS.md
+carries the numbers and the kernel comparison. One-time jit compiles of
+new batch-size buckets (seconds each) are excluded by warming the
+kernels first — a fresh daemon pays them during its first seconds of
+traffic unless the persistent compilation cache is primed.
+"""
+
+import time
+from collections import deque
+
+import numpy as np
+
+from kubedtn_tpu.api.types import Link, LinkProperties, Topology, \
+    TopologySpec
+from kubedtn_tpu.runtime import WireDataPlane
+from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+from kubedtn_tpu.wire import proto as pb
+from kubedtn_tpu.wire.server import Daemon
+
+TICK_S = 0.010  # the plane's default period (dt_us=10_000)
+
+
+class _TimedDeque(deque):
+    def __init__(self):
+        super().__init__()
+        self.times = []
+
+    def append(self, x):
+        super().append(x)
+        self.times.append(time.monotonic())
+
+    def extend(self, xs):
+        xs = list(xs)
+        super().extend(xs)
+        now = time.monotonic()
+        self.times.extend([now] * len(xs))
+
+
+def _build(latency: str):
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    props = LinkProperties(latency=latency)
+    store.create(Topology(name="a", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="b", uid=1,
+             properties=props)])))
+    store.create(Topology(name="b", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="a", uid=1,
+             properties=props)])))
+    engine.setup_pod("a")
+    engine.setup_pod("b")
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon)
+    wa = daemon._add_wire(pb.WireDef(local_pod_name="a",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    wb = daemon._add_wire(pb.WireDef(local_pod_name="b",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    return plane, wa, wb
+
+
+def _warm_buckets():
+    """Compile the (R, K) batch-kernel buckets a single-wire measurement
+    touches, on a throwaway plane with deterministic ticks — the
+    one-time compile cost must not masquerade as delivery latency."""
+    plane, wa, _wb = _build("1ms")
+    t = 50.0
+    for burst in (1, 3, 10, 1):
+        wa.ingress.extend([b"w" * 100] * burst)
+        t += 0.02
+        plane.tick(now_s=t)
+        t += 0.02
+        plane.tick(now_s=t)
+
+
+def _measure(latency_s: float, latency: str, n: int = 25):
+    plane, wa, wb = _build(latency)
+    wb.egress = _TimedDeque()
+    plane.start()
+    try:
+        wa.ingress.append(b"w" * 100)  # runner warm (clock, hot set)
+        time.sleep(0.3 + latency_s)
+        wb.egress.times.clear()
+        wb.egress.clear()
+        sends = []
+        for i in range(n):
+            sends.append(time.monotonic())
+            wa.ingress.append(bytes([i % 256]) * 120)
+            time.sleep(0.02)
+        deadline = time.monotonic() + 5 + latency_s
+        while len(wb.egress.times) < n and time.monotonic() < deadline:
+            time.sleep(0.005)
+    finally:
+        plane.stop()
+    assert len(wb.egress.times) == n, (
+        f"only {len(wb.egress.times)}/{n} frames delivered")
+    return np.array([(d - s - latency_s) * 1000
+                     for s, d in zip(sends, wb.egress.times)])
+
+
+def test_live_delivery_error_bounds():
+    """One warmed process, three delay scales. Bounds are the measured
+    floor plus generous CI headroom — the point is to catch regressions
+    to tick-bound (>= period) or runaway (seconds) behavior, which is
+    what a broken wake-early path or a compile in the hot loop looks
+    like."""
+    _warm_buckets()
+    # >= 1 tick period: the wheel wake makes delivery sub-millisecond
+    for lat_s, lat in ((0.010, "10ms"), (0.100, "100ms")):
+        errs = _measure(lat_s, lat)
+        med = float(np.median(errs))
+        p90 = float(np.percentile(errs, 90))
+        assert med <= 5.0, f"{lat}: median error {med:.2f}ms"
+        assert p90 <= TICK_S * 1e3 + 10.0, f"{lat}: p90 {p90:.2f}ms"
+        assert errs.min() >= -1.0, f"{lat}: early delivery {errs.min()}ms"
+    # sub-tick delay: error = a couple of device dispatches, bounded by
+    # ~one tick period (kernel netem would be ~µs here — documented gap)
+    errs = _measure(0.001, "1ms")
+    med = float(np.median(errs))
+    p90 = float(np.percentile(errs, 90))
+    assert med <= TICK_S * 1e3, f"1ms: median error {med:.2f}ms"
+    assert p90 <= TICK_S * 1e3 + 15.0, f"1ms: p90 {p90:.2f}ms"
+    assert errs.min() >= -1.0, f"1ms: early delivery {errs.min()}ms"
