@@ -39,12 +39,24 @@ measurement phase retries transient failures; a phase that ultimately
 fails reports its error in extras instead of killing the whole bench, and
 a total failure still prints the one-line JSON (value 0, error set) so the
 driver always gets a parseable record.
+
+Two more outage lessons are structural (round-4 verdict):
+- every phase's result is flushed to BENCH_partial.json the moment it
+  completes, so a crash/outage mid-run loses at most the running phase,
+  never the finished ones;
+- the phases that can ONLY run on the real chip (pallas-tiled, scale_1m)
+  run FIRST when the backend is TPU — if the tunnel dies mid-bench the
+  on-chip-only evidence is already on disk.
+- the record carries a host fingerprint (CPU model, loadavg, nproc) and
+  the wire microbenches report medians over N>=5 repeats, so a slow host
+  is distinguishable from a real regression.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import statistics
 import subprocess
 import sys
 import time
@@ -59,10 +71,46 @@ SHAPE_ITERS = 100
 PROBE_ATTEMPTS = 2
 PROBE_TIMEOUT_S = 150
 PHASE_ATTEMPTS = 2
+WIRE_REPEATS = 5  # median-of-N for the gRPC microbenches
+
+PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_partial.json")
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def host_fingerprint() -> dict:
+    """CPU model + core count + loadavg: enough to tell 'the machine was
+    slower this round' apart from 'the code got slower' when two records
+    disagree (round-4 verdict weak-point 2)."""
+    fp: dict = {}
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("model name"):
+                    fp["cpu_model"] = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    fp["nproc"] = os.cpu_count()
+    try:
+        fp["loadavg_start"] = [round(x, 2) for x in os.getloadavg()]
+    except OSError:
+        pass
+    return fp
+
+
+def flush_partial(extras: dict, phases_done: list[str]) -> None:
+    """Persist everything measured so far: a mid-run crash or tunnel
+    outage loses at most the phase in flight."""
+    try:
+        with open(PARTIAL_PATH, "w") as f:
+            json.dump({"phases_done": phases_done, "extras": extras,
+                       "ts": time.time()}, f, indent=1)
+    except OSError as e:
+        log(f"partial flush failed: {e!r}")
 
 
 def probe_backend() -> bool:
@@ -224,7 +272,7 @@ def bench_shape_step(extras: dict) -> None:
             st = run(st, SHAPE_ITERS)
             jax.block_until_ready(st.props)
             samples.append(time.perf_counter() - t0)
-        dt = sorted(samples)[1]
+        dt = statistics.median(samples)
         extras[label] = round(n_active * SHAPE_ITERS / dt, 1)
 
     timed(netem.shape_step, "shape_vmapped_pkts_per_s")
@@ -263,7 +311,7 @@ def bench_shape_step(extras: dict) -> None:
             ts = run_tiled(ts, SHAPE_ITERS)
             jax.block_until_ready(ts.tokens)
             samples.append(time.perf_counter() - t0)
-        dt = sorted(samples)[1]
+        dt = statistics.median(samples)
         extras["shape_pallas_tiled_pkts_per_s"] = round(
             n_active * SHAPE_ITERS / dt, 1)
     else:
@@ -295,14 +343,23 @@ def bench_wire_streaming(extras: dict) -> None:
     pkts = [pb.Packet(remot_intf_id=wire.wire_id, frame=b"f" * 200)
             for _ in range(n)]
     client.SendToOnce(pkts[0])  # warm the channel
-    t0 = time.perf_counter()
-    for p in pkts:
-        client.SendToOnce(p)
-    unary_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    client.SendToStream(iter(pkts))
-    stream_s = time.perf_counter() - t0
-    assert len(wire.egress) == 2 * n + 1
+
+    median = statistics.median
+
+    # median-of-N so one scheduler hiccup can't halve the recorded rate
+    # (the r3→r4 record moved -48% on this phase with no code change on
+    # the measured path — indistinguishable from noise at N=1)
+    unary_ss, stream_ss = [], []
+    for _ in range(WIRE_REPEATS):
+        t0 = time.perf_counter()
+        for p in pkts:
+            client.SendToOnce(p)
+        unary_ss.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        client.SendToStream(iter(pkts))
+        stream_ss.append(time.perf_counter() - t0)
+    assert len(wire.egress) == 2 * n * WIRE_REPEATS + 1
+    unary_s, stream_s = median(unary_ss), median(stream_ss)
 
     # the coalesced transport the daemons actually use for egress
     # (runtime._flush_remote → SendToBulk): ~256 frames per gRPC message
@@ -312,12 +369,16 @@ def bench_wire_streaming(extras: dict) -> None:
     batches = [pb.PacketBatch(packets=[pkts[0]] * chunk)
                for _ in range(n_bulk // chunk)]
     client.SendToBulk(iter(batches[:4]))  # warm
-    wire.egress.clear()
-    t0 = time.perf_counter()
-    client.SendToBulk(iter(batches))
-    bulk_s = time.perf_counter() - t0
-    n_bulk_done = len(wire.egress)
-    assert n_bulk_done == (n_bulk // chunk) * chunk
+    bulk_ss = []
+    n_bulk_done = 0
+    for _ in range(WIRE_REPEATS):
+        wire.egress.clear()
+        t0 = time.perf_counter()
+        client.SendToBulk(iter(batches))
+        bulk_ss.append(time.perf_counter() - t0)
+        n_bulk_done = len(wire.egress)
+        assert n_bulk_done == (n_bulk // chunk) * chunk
+    bulk_s = median(bulk_ss)
     client.close()
     server.stop(0)
     extras["wire_unary_frames_per_s"] = round(n / unary_s, 1)
@@ -326,12 +387,18 @@ def bench_wire_streaming(extras: dict) -> None:
     extras["wire_bulk_frames_per_s"] = round(n_bulk_done / bulk_s, 1)
     extras["wire_bulk_speedup_vs_stream"] = round(
         (n_bulk_done / bulk_s) / (n / stream_s), 1)
+    extras["wire_repeats"] = WIRE_REPEATS
+    extras["wire_unary_samples_s"] = [round(s, 4) for s in unary_ss]
+    extras["wire_stream_samples_s"] = [round(s, 4) for s in stream_ss]
+    extras["wire_bulk_samples_s"] = [round(s, 4) for s in bulk_ss]
 
 
 def main() -> None:
     global ITERS, SHAPE_ITERS
     t_bench = time.perf_counter()
     extras: dict = {}
+    extras["host"] = host_fingerprint()
+    phases_done: list[str] = []
 
     degraded = not probe_backend()
     if degraded:
@@ -380,10 +447,16 @@ def main() -> None:
     except Exception as e:
         extras["backend"] = f"unavailable: {e}"
 
-    ups = with_retry("link_updates", lambda: bench_link_updates(extras),
-                     extras)
-
-    with_retry("shape_step", lambda: bench_shape_step(extras), extras)
+    def phase(name: str, fn) -> object:
+        """with_retry + incremental flush: the partial record on disk is
+        always current through the last finished phase. A phase that
+        exhausted its retries is recorded as failed, not done — the
+        partial file exists to answer 'which evidence is banked'."""
+        r = with_retry(name, fn, extras)
+        phases_done.append(
+            name if f"{name}_error" not in extras else f"{name}:failed")
+        flush_partial(extras, phases_done)
+        return r
 
     def run_reconcile():
         from kubedtn_tpu.scenarios import reconcile_100k
@@ -395,11 +468,6 @@ def main() -> None:
                               "device_calls", "meets_target")
         }
 
-    with_retry("reconcile_100k", run_reconcile, extras)
-
-    with_retry("wire_streaming", lambda: bench_wire_streaming(extras),
-               extras)
-
     def run_live_plane():
         from kubedtn_tpu.scenarios import live_plane
 
@@ -407,11 +475,9 @@ def main() -> None:
                        frames_per_wire=8_000 if degraded else 40_000)
         extras["live_plane"] = {
             k: r[k] for k in ("pairs", "frames_per_wire", "frames_per_s",
-                              "rounds_frames_per_s", "dropped",
-                              "tick_errors")
+                              "frames_per_s_best", "rounds_frames_per_s",
+                              "dropped", "tick_errors")
         }
-
-    with_retry("live_plane", run_live_plane, extras)
 
     def run_reconverge_10k():
         from kubedtn_tpu.scenarios import reconverge_10k
@@ -422,8 +488,6 @@ def main() -> None:
                               "reconverge_s_steady", "speedup_vs_full",
                               "matches_full_recompute")
         }
-
-    with_retry("reconverge_10k", run_reconverge_10k, extras)
 
     def run_scale_1m():
         from kubedtn_tpu.scenarios import reconcile_100k, scale_1m
@@ -446,15 +510,35 @@ def main() -> None:
             "realize_under_15s": c["reconcile_s"] < 15.0,
         }
 
+    # ON-CHIP-ONLY phases run FIRST on a live TPU backend: two rounds of
+    # tunnel outages taught that the evidence that can only come from the
+    # chip must be banked before anything else gets a chance to outlive
+    # the tunnel. (On CPU, shape_step still records the vmapped number.)
     if not degraded:
+        phase("shape_step", lambda: bench_shape_step(extras))
         # 10× the BASELINE top rung — scale headroom evidence; skipped on
         # the CPU fallback, where 2M-row device ops would dominate the
         # degraded run's time budget without measuring anything real
-        with_retry("scale_1m", run_scale_1m, extras)
-    else:
+        phase("scale_1m", run_scale_1m)
+
+    ups = phase("link_updates", lambda: bench_link_updates(extras))
+
+    if degraded:
+        phase("shape_step", lambda: bench_shape_step(extras))
         extras["scale_1m"] = None
 
+    phase("reconcile_100k", run_reconcile)
+    phase("wire_streaming", lambda: bench_wire_streaming(extras))
+    phase("live_plane", run_live_plane)
+    phase("reconverge_10k", run_reconverge_10k)
+
+    try:
+        extras["host"]["loadavg_end"] = [round(x, 2)
+                                         for x in os.getloadavg()]
+    except OSError:
+        pass
     extras["bench_wall_s"] = round(time.perf_counter() - t_bench, 1)
+    flush_partial(extras, phases_done)
     if ups is None:
         print(json.dumps({
             "metric": "link-updates/sec", "value": 0.0, "unit": "links/s",

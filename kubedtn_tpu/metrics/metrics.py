@@ -163,6 +163,10 @@ class DataPlaneStatsCollector:
          "Released frames whose wire never re-registered within grace"),
         ("forward_errors", "Failed per-frame forwards to peer daemons"),
         ("ring_dropped", "Frames lost to remote-stage ring overflow"),
+        ("peer_queue_dropped",
+         "Frames dropped at per-peer egress sender queues (slow peer)"),
+        ("bulk_unresolved",
+         "Bulk-transport frames whose wire id resolved to no wire"),
         ("tick_errors", "Tick failures survived by the runner"),
     )
 
@@ -179,6 +183,8 @@ class DataPlaneStatsCollector:
             "undeliverable": plane.undeliverable,
             "forward_errors": plane.daemon.forward_errors,
             "ring_dropped": plane.ring_dropped,
+            "peer_queue_dropped": plane.peer_queue_dropped,
+            "bulk_unresolved": plane.daemon.bulk_unresolved,
             "tick_errors": plane.tick_errors,
         }
         out = []

@@ -629,7 +629,7 @@ print(f"{time.perf_counter() - t0:.3f}", flush=True)
 
 
 def live_plane(pairs: int = 8, frames_per_wire: int = 40_000,
-               latency: str = "5ms", rounds: int = 3,
+               latency: str = "5ms", rounds: int = 5,
                dt_us: float = 2_000.0, timeout_s: float = 180.0):
     """End-to-end LIVE data-plane throughput: a real gRPC daemon with the
     real-time runner, `pairs` shaped pod pairs, and an out-of-process
@@ -640,8 +640,13 @@ def live_plane(pairs: int = 8, frames_per_wire: int = 40_000,
     live-plane role the reference fills with VXLAN+veth+eBPF kernel
     forwarding (reference daemon/vxlan/vxlan.go:31-151,
     grpcwire.go:386-462). A warm round compiles the batch-kernel shapes;
-    the best measured round is reported (the plane and the gRPC
-    ingestion threads share one GIL, so rounds jitter).
+    the MEDIAN round is reported as the headline (frames_per_s), with
+    the best round and all samples alongside. The injector subprocess,
+    gRPC server thread, and plane thread time-slice one machine (the
+    bench host exposes a single core), so individual rounds jitter both
+    ways — a round-4 instrumented run showed the profile is
+    non-monotone (e.g. 228k/356k/152k/227k/187k) with total GC time
+    <0.2s, i.e. scheduler arbitration, not state accumulation.
 
     There is no reference analogue to hold the frames at the end: egress
     deques are drained in-process.
@@ -723,7 +728,10 @@ def live_plane(pairs: int = 8, frames_per_wire: int = 40_000,
     t0 = time.perf_counter()
     run_round(max(2_000, frames_per_wire // 10))  # compile the shapes
     results = [run_round(frames_per_wire) for _ in range(rounds)]
-    best = max(r[0] for r in results)
+    import statistics
+
+    rates = sorted(r[0] for r in results)
+    median = statistics.median(rates)
     plane.stop()
     server.stop(0)
     inject_rates = [
@@ -736,7 +744,8 @@ def live_plane(pairs: int = 8, frames_per_wire: int = 40_000,
         "latency": latency,
         "frames_delivered": results[-1][1],
         "rounds_frames_per_s": [round(r[0], 1) for r in results],
-        "frames_per_s": round(best, 1),
+        "frames_per_s": round(median, 1),
+        "frames_per_s_best": round(max(rates), 1),
         "inject_frames_per_s": max(inject_rates) if inject_rates else 0.0,
         "ticks": plane.ticks,
         "dropped": plane.dropped,

@@ -169,18 +169,34 @@ class SimEngine:
         # Injectable for tests / non-default ports; cached per address.
         self._dialer = dialer
         self._peer_clients: dict[str, object] = {}
+        self._peer_clients_lock = threading.Lock()
 
     def _peer_daemon(self, src_ip: str):
+        # Raced by the engine's Update path, the per-frame forward path,
+        # and (round 5) every per-peer egress sender thread: without the
+        # double-checked emplace two racers both dial and one channel
+        # leaks open for the process lifetime. The dial itself happens
+        # OUTSIDE the lock (it can block on a slow network); the loser's
+        # channel is closed if it supports it.
         client = self._peer_clients.get(src_ip)
-        if client is None:
-            if self._dialer is not None:
-                client = self._dialer(src_ip)
-            else:
-                from kubedtn_tpu.wire.client import dial_daemon
+        if client is not None:
+            return client
+        if self._dialer is not None:
+            client = self._dialer(src_ip)
+        else:
+            from kubedtn_tpu.wire.client import dial_daemon
 
-                client = dial_daemon(src_ip)
-            self._peer_clients[src_ip] = client
-        return client
+            client = dial_daemon(src_ip)
+        with self._peer_clients_lock:
+            won = self._peer_clients.setdefault(src_ip, client)
+        if won is not client:
+            close = getattr(client, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+        return won
 
     # -- registries ----------------------------------------------------
 

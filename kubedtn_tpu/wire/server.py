@@ -193,8 +193,15 @@ class Daemon:
         self.frame_stats: Counter[str] = Counter()
         # daemon->daemon wire forwarding (the reference's per-frame
         # SendToOnce to the peer daemon, grpcwire.go:452-459): send
-        # errors counted, not fatal.
+        # errors counted, not fatal. Incremented from the tick thread
+        # AND the per-peer sender threads — use count_forward_errors.
         self.forward_errors = 0
+        self._err_lock = threading.Lock()
+        # bulk-transport frames whose remot_intf_id resolved to no wire:
+        # dropped (the per-frame SendToOnce aborts NOT_FOUND instead, but
+        # a stream can't abort per-message without killing the batch), so
+        # a mis-plumbed peer shows up HERE instead of as unexplained loss
+        self.bulk_unresolved = 0
         # peers assumed to speak the coalesced SendToBulk extension until
         # one answers UNIMPLEMENTED (a reference-built Go daemon); the
         # egress flush then falls back to per-frame SendToStream for that
@@ -211,6 +218,18 @@ class Daemon:
                               if _native.have_native() else None)
         except Exception:
             self._classify = None
+
+    def count_forward_errors(self, n: int) -> None:
+        """Thread-safe forward_errors increment (CPython += is not
+        atomic; per-peer sender threads race each other and the tick)."""
+        with self._err_lock:
+            self.forward_errors += n
+
+    def count_bulk_unresolved(self, n: int) -> None:
+        """Thread-safe bulk_unresolved increment (concurrent bulk
+        streams run on the server's worker pool)."""
+        with self._err_lock:
+            self.bulk_unresolved += n
 
     def _peer_wire_client(self, addr: str):
         # one per-address client cache per node, shared with the engine's
@@ -436,6 +455,8 @@ class Daemon:
                 if wire is not None:
                     self._frames_in_bulk(wire, frames)
                     n += len(frames)
+                else:
+                    self.count_bulk_unresolved(len(frames))
         return pb.BoolResponse(response=n > 0)
 
     def InjectBulk(self, request_iterator, context):
@@ -449,6 +470,7 @@ class Daemon:
             for wid, frames in groups.items():
                 wire = self.wires.get_by_id(wid)
                 if wire is None:
+                    self.count_bulk_unresolved(len(frames))
                     continue
                 wire.ingress.extend(frames)
                 if self.capture is not None:
@@ -531,7 +553,7 @@ class Daemon:
                     timeout=self.forward_timeout_s)
                 return True
             except Exception:
-                self.forward_errors += 1
+                self.count_forward_errors(1)
                 return False
         wire.egress.append(frame)
         if self.capture is not None:

@@ -446,23 +446,27 @@ inline uint8_t decide_one(FlowTable* ft, const uint8_t* f, uint64_t len,
   const Tuple4 fwd{sip, dip, sport, dport};
   auto it = ft->proxy.find(fwd);
   if (it == ft->proxy.end()) {
-    // first sight: active then passive establish (sockops pair)
-    if (sip != dip || sport != dport) {
-      if (ft->active_estab.size() < ft->capacity) {
-        ft->active_estab.emplace(Addr2{sip, sport}, Addr2{dip, dport});
-      }
-      auto ae = ft->active_estab.find(Addr2{sip, sport});
-      if (ae != ft->active_estab.end() &&
-          ft->proxy.size() + 2 <= ft->capacity) {
-        const Addr2 orig = ae->second;
-        const Tuple4 proxy_key{sip, orig.ip, sport, orig.port};
-        const Tuple4 proxy_val{dip, sip, dport, sport};
-        ft->proxy[proxy_key] = ProxyVal{proxy_val, KDT_PROXY_INIT};
-        ft->proxy[proxy_val] = ProxyVal{proxy_key, KDT_PROXY_INIT};
-        ft->active_estab.erase(ae);
-      }
-      it = ft->proxy.find(fwd);
+    // first sight: active then passive establish (sockops pair). The
+    // self-connection guard covers ONLY the active-establish emplace
+    // (kdt_ft_active_established's early return); the passive lookup
+    // still runs and may pair against a pre-existing active-estab entry
+    // for the same 2-tuple — exact parity with the per-frame path
+    // (runtime._try_bypass calls passive_established unconditionally).
+    if ((sip != dip || sport != dport) &&
+        ft->active_estab.size() < ft->capacity) {
+      ft->active_estab.emplace(Addr2{sip, sport}, Addr2{dip, dport});
     }
+    auto ae = ft->active_estab.find(Addr2{sip, sport});
+    if (ae != ft->active_estab.end() &&
+        ft->proxy.size() + 2 <= ft->capacity) {
+      const Addr2 orig = ae->second;
+      const Tuple4 proxy_key{sip, orig.ip, sport, orig.port};
+      const Tuple4 proxy_val{dip, sip, dport, sport};
+      ft->proxy[proxy_key] = ProxyVal{proxy_val, KDT_PROXY_INIT};
+      ft->proxy[proxy_val] = ProxyVal{proxy_key, KDT_PROXY_INIT};
+      ft->active_estab.erase(ae);
+    }
+    it = ft->proxy.find(fwd);
   }
   if (shaped) {
     // traffic crossing a shaped device disables the flow FOREVER

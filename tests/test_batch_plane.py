@@ -160,6 +160,9 @@ def test_decide_batch_matches_per_frame_bypass_semantics():
             frames.append(_mk_tcp(1, 2, 3, 4, proto=17))        # UDP
         elif kind < 0.3:
             frames.append(_mk_tcp(1, 2, 3, 4, frag=0x2000))     # fragment
+        elif kind < 0.35:
+            s = random.randint(1, 3)                  # self-connection
+            frames.append(_mk_tcp(s, 1000 + s, s, 1000 + s))
         else:
             s, d = random.randint(1, 3), random.randint(4, 6)
             frames.append(_mk_tcp(s, 1000 + s, d, 2000 + d,
@@ -188,6 +191,42 @@ def test_decide_batch_matches_per_frame_bypass_semantics():
 
     got = ft_bat.decide_batch(frames, elig, shaped)
     assert list(got) == ref
+    assert ft_bat.bypassed == ft_ref.bypassed
+    assert ft_bat.passed == ft_ref.passed
+
+
+@pytest.mark.skipif(not native.have_native(), reason="no native lib")
+def test_decide_batch_self_connection_stale_entry_parity():
+    """Pathological case the random mix can't hit: a SELF-connection
+    frame (sip==dip, sport==dport) whose 2-tuple has a STALE active-estab
+    entry (left by an embedder's direct active_established call, or by a
+    passive that failed at the capacity bound). The per-frame path calls
+    passive_established unconditionally — only the active emplace is
+    self-guarded — so the stale entry pairs and the flow can reach
+    ENABLED; the batched path must diverge in neither verdicts nor
+    counters."""
+    from kubedtn_tpu.runtime import parse_tcp_flow
+
+    ft_ref, ft_bat = native.FlowTable(), native.FlowTable()
+    for ft in (ft_ref, ft_bat):
+        ft.active_established(9, 1111, 10, 2222)  # stale: no passive ever
+
+    self_conn = _mk_tcp(9, 1111, 9, 1111)
+    frames = [self_conn, self_conn, self_conn]
+    elig, shaped = [True] * 3, [False] * 3
+
+    ref = []
+    for f in frames:
+        sip, sport, dip, dport = parse_tcp_flow(f)
+        if ft_ref.flag(sip, sport, dip, dport) is None:
+            ft_ref.active_established(sip, sport, dip, dport)
+            ft_ref.passive_established(dip, dport, sip, sport)
+        ref.append(1 if ft_ref.msg_redirect(sip, sport, dip, dport) else 0)
+
+    got = ft_bat.decide_batch(frames, elig, shaped)
+    assert list(got) == ref
+    # the stale entry pairs on first sight: INIT passes, then bypasses
+    assert ref == [0, 1, 1]
     assert ft_bat.bypassed == ft_ref.bypassed
     assert ft_bat.passed == ft_ref.passed
 
@@ -397,3 +436,141 @@ def test_live_plane_scenario_smoke():
     assert r["frames_per_s"] > 0
     # injector rounds up to whole 256-frame chunks
     assert r["frames_delivered"] == 2 * 1024
+
+
+def test_holdback_requeue_on_vanished_row_preserves_invariant():
+    """Holdback residue whose ROW vanished between ticks (link deleted
+    mid-wait) must go back into the holdback buffer, not wire.ingress —
+    re-queueing onto ingress would re-classify the frames into
+    frame_stats and re-run the bypass verdict (each frame counts and
+    decides exactly once). Once the link is re-added, the frames shape
+    and deliver normally."""
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    link_ab = Link(local_intf="eth1", peer_intf="eth1", peer_pod="b",
+                   uid=1, properties=LinkProperties(rate="10Gbit"))
+    store.create(Topology(name="a", spec=TopologySpec(links=[link_ab])))
+    store.create(Topology(name="b", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="a", uid=1,
+             properties=LinkProperties(rate="10Gbit"))])))
+    engine.setup_pod("a")
+    engine.setup_pod("b")
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=1_000.0)
+    plane.seq_slots = 16
+    wa = daemon._add_wire(pb.WireDef(local_pod_name="a",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    wb = daemon._add_wire(pb.WireDef(local_pod_name="b",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    frames = [bytes([i]) * 60 for i in range(40)]
+    wa.ingress.extend(frames)
+    shaped = plane.tick(now_s=4.0)
+    assert shaped == 16 and len(plane._holdback[wa.wire_id][2]) == 24
+    stats_after_drain = sum(daemon.frame_stats.values()) \
+        if daemon.frame_stats else None
+
+    # the link vanishes while the residue waits
+    topo_a = store.get("default", "a")
+    assert engine.del_links(topo_a, [link_ab])
+    assert engine.row_of("default/a", 1) is None
+    shaped = plane.tick(now_s=4.001)
+    assert shaped == 0
+    # residue back in HOLDBACK (not ingress), predecided state intact
+    assert len(wa.ingress) == 0
+    assert len(plane._holdback[wa.wire_id][2]) == 24
+    if stats_after_drain is not None:
+        assert sum(daemon.frame_stats.values()) == stats_after_drain
+
+    # link re-realizes: holdback shapes first, everything delivers
+    assert engine.add_links(topo_a, [link_ab])
+    total = 0
+    for k in range(2, 10):
+        total += plane.tick(now_s=4.0 + 0.001 * k)
+    assert total == 24
+    plane.tick(now_s=4.3)
+    assert len(wb.egress) == 40
+    if stats_after_drain is not None:
+        assert sum(daemon.frame_stats.values()) == stats_after_drain
+    assert plane.undeliverable == 0
+
+
+def test_holdback_requeue_on_deregistered_wire_is_counted():
+    """If the WIRE itself was deregistered while residue waited, its
+    frames can never be drained again — they must be counted in
+    plane.undeliverable, not leaked silently."""
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    link_ab = Link(local_intf="eth1", peer_intf="eth1", peer_pod="b",
+                   uid=1, properties=LinkProperties(rate="10Gbit"))
+    store.create(Topology(name="a", spec=TopologySpec(links=[link_ab])))
+    store.create(Topology(name="b", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="a", uid=1,
+             properties=LinkProperties(rate="10Gbit"))])))
+    engine.setup_pod("a")
+    engine.setup_pod("b")
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=1_000.0)
+    plane.seq_slots = 16
+    wa = daemon._add_wire(pb.WireDef(local_pod_name="a",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    daemon._add_wire(pb.WireDef(local_pod_name="b", kube_ns="default",
+                                link_uid=1, intf_name_in_pod="eth1"))
+    wa.ingress.extend(bytes([i]) * 60 for i in range(40))
+    assert plane.tick(now_s=4.0) == 16
+
+    # pod torn down: row gone AND wire deregistered
+    topo_a = store.get("default", "a")
+    assert engine.del_links(topo_a, [link_ab])
+    daemon.wires.delete_by_pod("default/a")
+    plane.tick(now_s=4.001)
+    assert plane.undeliverable == 24
+    assert wa.wire_id not in plane._holdback
+
+
+def test_bulk_unresolved_frames_are_counted():
+    """SendToBulk/InjectBulk frames whose remot_intf_id resolves to no
+    wire are dropped by design (a stream can't abort per-message) — but
+    they must be COUNTED so a mis-plumbed peer is diagnosable."""
+    import grpc
+
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+    from kubedtn_tpu.wire.server import Daemon, make_server
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    daemon = Daemon(engine)
+    server, port = make_server(daemon, port=0, host="127.0.0.1",
+                               log_rpcs=False)
+    server.start()
+    try:
+        client = DaemonClient(f"127.0.0.1:{port}")
+        wire = daemon._add_wire(pb.WireDef(
+            local_pod_name="w", kube_ns="default", link_uid=1,
+            intf_name_in_pod="eth0"))
+        good = pb.Packet(remot_intf_id=wire.wire_id, frame=b"g" * 64)
+        bad = pb.Packet(remot_intf_id=9999, frame=b"b" * 64)
+        client.SendToBulk(iter([pb.PacketBatch(packets=[good, bad, bad])]))
+        client.InjectBulk(iter([pb.PacketBatch(packets=[bad, good])]))
+        assert daemon.bulk_unresolved == 3
+        assert len(wire.ingress) == 2  # the good frames still landed
+        # per-frame SendToOnce keeps its NOT_FOUND abort semantics
+        with pytest.raises(grpc.RpcError) as ei:
+            client.SendToOnce(bad)
+        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+        client.close()
+    finally:
+        server.stop(0)

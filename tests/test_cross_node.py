@@ -128,6 +128,7 @@ def test_cross_node_frames_shaped_then_tunneled(two_nodes):
     wire_b = daemon_b.wires.get_by_key("default/r2", 7)
     assert len(wire_b.egress) == 0      # 10ms not yet elapsed
     dp_a.tick(now_s=50.011)             # past the netem delay: crosses now
+    assert dp_a.flush_peers()           # egress is async per-peer now
     assert list(wire_b.egress) == [frame]
     assert daemon_a.forward_errors == 0
     client_a.close()
@@ -398,6 +399,7 @@ def test_cross_node_egress_batches_over_sendtostream():
         wire_a.ingress.append(bytes([i]) * 60)
     dp_a.tick(now_s=5.0)
     dp_a.tick(now_s=5.001)  # unshaped: released immediately
+    assert dp_a.flush_peers()
     got = list(wire_b.egress)
     assert len(got) == n, f"only {len(got)}/{n} frames crossed"
     assert CountingDaemon.bulk_calls == 1, \
@@ -458,6 +460,7 @@ def test_cross_node_egress_falls_back_to_stream_for_reference_peer():
         wire_a.ingress.append(bytes([i]) * 60)
     dp_a.tick(now_s=5.0)
     dp_a.tick(now_s=5.001)
+    assert dp_a.flush_peers()
     assert len(wire_b.egress) == n, \
         f"only {len(wire_b.egress)}/{n} frames crossed on fallback"
     assert RefDaemon.stream_calls == 1
@@ -469,6 +472,7 @@ def test_cross_node_egress_falls_back_to_stream_for_reference_peer():
         wire_a.ingress.append(bytes([0x40 + i]) * 60)
     dp_a.tick(now_s=5.1)
     dp_a.tick(now_s=5.101)
+    assert dp_a.flush_peers()
     assert len(wire_b.egress) == n + 3
     assert RefDaemon.stream_calls == 2
     server_b.stop(0)
@@ -527,6 +531,123 @@ def test_warm_restart_mid_traffic_completes_cross_node_delivery(
     dp_a2.tick(now_s=100.3)  # 300ms after restore: 100ms still remain
     assert len(wire_b.egress) == 0
     dp_a2.tick(now_s=100.45)  # past the remaining delay: crosses to B
+    assert dp_a2.flush_peers()
     assert list(wire_b.egress) == [frame]
     assert dp_a2.undeliverable == 0
     client_b.close()
+
+
+def test_slow_peer_does_not_stall_local_delivery():
+    """Round-5: egress to each peer runs on its own sender thread with a
+    bounded queue (the reference's per-wire goroutine role,
+    grpcwire.go:386). A SLOW (not blackholed — just slow) peer must cost
+    only its own wires: ticks stay fast, local-pair delivery is
+    unaffected, the slow peer's frames still arrive, and frames to a
+    BLACKHOLED peer are counted in forward_errors — all without the tick
+    thread ever blocking on a peer RPC."""
+    from kubedtn_tpu.runtime import WireDataPlane
+
+    class SlowDaemon(Daemon):
+        delay_s = 0.6
+
+        def SendToBulk(self, request_iterator, context):
+            time.sleep(type(self).delay_s)
+            return super().SendToBulk(request_iterator, context)
+
+    class BlackholeDaemon(Daemon):
+        def SendToBulk(self, request_iterator, context):
+            time.sleep(30)
+            return super().SendToBulk(request_iterator, context)
+
+        SendToStream = SendToBulk
+
+    def serve(cls):
+        store = TopologyStore()
+        engine = SimEngine(store, capacity=16)
+        daemon = cls(engine)
+        server, port = make_server(daemon, port=0, host="127.0.0.1")
+        server.start()
+        return daemon, server, f"127.0.0.1:{port}"
+
+    slow_daemon, slow_server, slow_addr = serve(SlowDaemon)
+    hole_daemon, hole_server, hole_addr = serve(BlackholeDaemon)
+    slow_wire = slow_daemon._add_wire(pb.WireDef(
+        local_pod_name="rs", kube_ns="default", link_uid=7,
+        intf_name_in_pod="eth1", peer_ip="127.0.0.1:1", peer_intf_id=1))
+
+    # node A: one local pair (uid 1) + one wire to the slow peer (uid 7)
+    # + one wire to the blackholed peer (uid 8); all links unshaped so
+    # releases happen on the next tick
+    store_a = TopologyStore()
+    engine_a = SimEngine(store_a, capacity=64)
+    engine_a.node_ip = "127.0.0.1:1"
+    # timeout between the slow peer's 0.6s (succeeds) and the blackhole's
+    # 30s (fails on deadline)
+    daemon_a = Daemon(engine_a, forward_timeout_s=2.0)
+    links = [
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="l2", uid=1),
+        Link(local_intf="eth2", peer_intf="eth1",
+             peer_pod="physical/" + slow_addr, uid=7),
+        Link(local_intf="eth3", peer_intf="eth1",
+             peer_pod="physical/" + hole_addr, uid=8),
+    ]
+    t1 = Topology(name="l1", spec=TopologySpec(links=links))
+    t2 = Topology(name="l2", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="l1", uid=1)]))
+    for t in (t1, t2):
+        t.status.src_ip, t.status.net_ns = "127.0.0.1:1", "/proc/1/ns/net"
+        store_a.create(t)
+    assert engine_a.add_links(t1, [links[0]])
+    assert engine_a.add_links(t2, t2.spec.links)
+    assert engine_a.add_links(t1, links[1:])
+
+    wl1 = daemon_a._add_wire(pb.WireDef(
+        local_pod_name="l1", kube_ns="default", link_uid=1,
+        intf_name_in_pod="eth1"))
+    wl2 = daemon_a._add_wire(pb.WireDef(
+        local_pod_name="l2", kube_ns="default", link_uid=1,
+        intf_name_in_pod="eth1"))
+    ws = daemon_a._add_wire(pb.WireDef(
+        local_pod_name="l1", kube_ns="default", link_uid=7,
+        intf_name_in_pod="eth2", peer_ip=slow_addr,
+        peer_intf_id=slow_wire.wire_id))
+    wh = daemon_a._add_wire(pb.WireDef(
+        local_pod_name="l1", kube_ns="default", link_uid=8,
+        intf_name_in_pod="eth3", peer_ip=hole_addr, peer_intf_id=1))
+
+    dp = WireDataPlane(daemon_a, dt_us=1_000.0)
+    # warm the batch-kernel compiles outside the timed window
+    wl1.ingress.append(b"w" * 60)
+    dp.tick(now_s=1.0)
+    dp.tick(now_s=1.001)
+    wl2.egress.clear()
+
+    n = 4
+    for i in range(n):
+        ws.ingress.append(bytes([0x10 + i]) * 60)
+        wh.ingress.append(bytes([0x20 + i]) * 60)
+    dp.tick(now_s=2.0)        # shapes all three rows (pays the one-time
+    #                           R=3 bucket compile, excluded from timing)
+    t0 = time.perf_counter()
+    dp.tick(now_s=2.001)      # releases + hands to the per-peer senders
+    #                           (the tick that BLOCKED before round 5)
+    # local traffic injected and delivered while both peers are wedged
+    for i in range(n):
+        wl1.ingress.append(bytes([0x30 + i]) * 60)
+        dp.tick(now_s=2.002 + i * 0.001)
+    tick_wall = time.perf_counter() - t0
+    assert tick_wall < 0.45, (
+        f"ticks took {tick_wall:.2f}s — the tick thread blocked on a "
+        f"peer RPC (slow peer sleeps 0.6s, blackhole 30s)")
+    assert len(wl2.egress) == n, "local delivery stalled behind peers"
+
+    # the slow peer's frames still arrive (its sender waited it out)
+    assert dp.flush_peers(timeout_s=10.0)
+    assert len(slow_wire.egress) == n
+    # the blackholed peer's frames died on ITS sender's deadline and
+    # were counted — nobody else paid for them
+    assert daemon_a.forward_errors == n
+    assert dp.peer_queue_dropped == 0
+    dp.stop()
+    slow_server.stop(0)
+    hole_server.stop(0)

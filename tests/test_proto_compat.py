@@ -197,3 +197,107 @@ def test_every_reference_service_method_matches(ref_messages):
             assert m.client_streaming == streaming, (
                 f"{svc.name}.{m.name} streaming mode")
             assert not m.server_streaming
+
+
+# -- method-path-level interop -----------------------------------------
+#
+# The message-level tests above prove encodings match; these prove the
+# SERVER actually answers on the byte-identical full method strings a
+# reference-built Go client dials (protoc derives them from the package/
+# service/method names in kube_dtn.proto:145-172 into proto/v1/*_grpc.pb.go,
+# e.g. "/proto.v1.Local/AddLinks"). A handler-registration slip — wrong
+# package constant, renamed service — would pass every message test and
+# still answer UNIMPLEMENTED to every real client; these tests fail on it.
+
+import grpc
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+
+def _identity(b):
+    return b
+
+
+@pytest.fixture()
+def live_server():
+    from kubedtn_tpu.topology import SimEngine, TopologyStore
+    from kubedtn_tpu.wire.server import Daemon, make_server
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    daemon = Daemon(engine)
+    server, port = make_server(daemon, port=0, host="127.0.0.1",
+                               log_rpcs=False)
+    server.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield daemon, channel
+    channel.close()
+    server.stop(0)
+
+
+def test_registered_method_paths_match_protoc_derivation(ref_messages,
+                                                         live_server):
+    """Dial every reference service method on the exact path string the
+    reference's generated stubs use — derived here from the protoc
+    descriptor, NOT from our proto module — with a syntactically valid
+    request. Any status except UNIMPLEMENTED proves the server registered
+    a handler under the byte-identical path (no daemon handler in this
+    codebase returns UNIMPLEMENTED itself)."""
+    ref_cls, fd = ref_messages
+    _daemon, channel = live_server
+    for svc in fd.service:
+        for m in svc.method:
+            path = f"/{fd.package}.{svc.name}/{m.name}"
+            req_name = m.input_type.rsplit(".", 1)[1]
+            payload = _build(ref_cls, req_name, ref_cls) \
+                .SerializeToString(deterministic=True)
+            try:
+                if m.client_streaming:
+                    call = channel.stream_unary(
+                        path, request_serializer=_identity,
+                        response_deserializer=_identity)
+                    call(iter([payload]), timeout=10)
+                else:
+                    call = channel.unary_unary(
+                        path, request_serializer=_identity,
+                        response_deserializer=_identity)
+                    call(payload, timeout=10)
+            except grpc.RpcError as e:
+                assert e.code() != grpc.StatusCode.UNIMPLEMENTED, (
+                    f"{path}: not registered (UNIMPLEMENTED) — a "
+                    f"reference-built client dialing this path gets no "
+                    f"service")
+                # NOT_FOUND etc. for a dummy payload still proves the
+                # path resolved to our handler
+
+
+def _golden(name: str, kind: str) -> bytes:
+    with open(os.path.join(_DATA_DIR, f"golden_{name}.{kind}.hex")) as f:
+        return bytes.fromhex(f.read().strip())
+
+
+def test_captured_bytes_goldens_per_service(live_server):
+    """Replay one captured request per service as RAW BYTES against a
+    fresh live server and byte-compare the raw response to the captured
+    golden. The goldens were serialized through message classes built
+    from the checked-in protoc descriptor (reference-derived), so a
+    regression in our dynamic encodings OR our handler registration
+    cannot hide behind message-level tests that use our own classes on
+    both sides. Order matters: the Remote call creates the wire the
+    WireProtocol call targets (ids are deterministic on a fresh daemon).
+    """
+    _daemon, channel = live_server
+    seq = [
+        ("local_generate_node_interface_name",
+         "/proto.v1.Local/GenerateNodeInterfaceName"),
+        ("remote_add_grpc_wire_remote",
+         "/proto.v1.Remote/AddGRPCWireRemote"),
+        ("wire_send_to_once",
+         "/proto.v1.WireProtocol/SendToOnce"),
+    ]
+    for name, path in seq:
+        call = channel.unary_unary(path, request_serializer=_identity,
+                                   response_deserializer=_identity)
+        resp = call(_golden(name, "req"), timeout=10)
+        assert resp == _golden(name, "resp"), (
+            f"{path}: response bytes differ from captured golden")
