@@ -486,7 +486,8 @@ def main() -> None:
         extras["reconverge_10k"] = {
             k: r[k] for k in ("nodes", "links", "full_recompute_s",
                               "reconverge_s_steady", "speedup_vs_full",
-                              "matches_full_recompute")
+                              "matches_full_recompute", "flap10_down_s",
+                              "flap10_up_s", "flap10_cells")
         }
 
     def run_scale_1m():

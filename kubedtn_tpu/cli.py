@@ -469,6 +469,25 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def _setup_compile_cache() -> None:
+    """Persistent compilation cache for EVERY CLI command, not just the
+    daemon: the scenario rungs compile dozens of (n, block-size) kernel
+    buckets that cost tens of seconds each on a small CPU host — a
+    repeat `cli scenario ...` run should pay them once."""
+    try:
+        import jax as _jax
+
+        cache_dir = os.environ.get(
+            "KUBEDTN_JAX_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "kubedtn-jax"))
+        _jax.config.update("jax_compilation_cache_dir", cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           1.0)
+    except Exception:  # an optimization, never fatal
+        pass
+
+
 def main(argv=None) -> int:
     # Honor JAX_PLATFORMS before any backend initializes: the axon
     # TPU-tunnel platform ignores the env var alone, so CPU-pinned runs
@@ -481,6 +500,7 @@ def main(argv=None) -> int:
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
         except RuntimeError:
             pass
+    _setup_compile_cache()
 
     p = argparse.ArgumentParser(prog="tpudtn")
     sub = p.add_subparsers(dest="cmd", required=True)

@@ -216,22 +216,42 @@ def recompute_routes(state: EdgeState, n_nodes: int, max_hops: int = 16,
 # A link flap changes a handful of edge rows; recomputing all-pairs from
 # scratch re-relaxes max_hops times over every destination. The delta
 # path below re-derives only what the event can have changed, seeded
-# from the previous distance matrix:
+# from the previous distance matrix. ALL changed edges of one event are
+# processed in ONE batch (round-5): one fused detection pass, at most
+# one dense improvement pass, and one restricted fixpoint on the union
+# affected set — never k sequential mini-events with k host syncs.
 #
-# - weight INCREASE (link down / slower): exactly the pairs whose
-#   shortest path ran through a changed edge are invalidated (detected
-#   in closed form from the old distances), then a min-plus fixpoint
-#   re-relaxes from the mixed matrix. Unaffected pairs are provably
-#   still optimal (no path got cheaper), so they act as correct seeds
-#   and the fixpoint usually lands in 1-3 hops instead of max_hops.
-# - weight DECREASE (link up / faster): the old distances are valid
-#   upper bounds; the fixpoint simply tightens them.
+# - weight INCREASES (links down / slower): a pair is invalidated iff
+#   some OLD shortest path crossed some increased edge. The per-edge
+#   crossing test dist[u,j] == dist[u,s]+w_old+dist[d,j] is exact
+#   against the pre-event matrix, and simultaneous increases compose:
+#   a pair no test flags has an old shortest path avoiding EVERY
+#   increased edge, so its old value stays a valid (and optimal-among-
+#   old-paths) seed. Detection never pays an [n, n] pass: the row and
+#   column projections of each edge's flagged set have exact O(n)
+#   witnesses (see _per_edge_up_flags), and the precise pair mask is
+#   only computed on the gathered block a fixpoint will rebuild.
+# - weight DECREASES (links up / faster): improved pairs route through
+#   at least one decreased edge. Decompose any new shortest path at its
+#   FIRST decreased edge e: the prefix uses no decreased edge (its cost
+#   is exact in the post-increase world) and the suffix cost is the
+#   exact NEW distance from e's head. So: first compute exact new
+#   distances TO every decreased-edge source (a column-block fixpoint)
+#   and FROM every decreased-edge head (the same fixpoint on the
+#   reversed graph), then apply
+#     dist'[u,j] = min(seed[u,j], min_e Dc[u,s_e]+w_new_e+Dr[d_e,j])
+#   — a rank-k min-plus product, exact for every improved pair in one
+#   shot (no iteration-to-closure needed because Dc/Dr are exact, not
+#   old values), restricted to grouped candidate blocks (witness
+#   tests, _improve_candidates) because a restored link's improvement
+#   set is a cross, not a block.
 #
-# Correctness does not depend on guessing the affected set for
-# decreases, and for increases the detection is conservative (equal-cost
-# alternates are invalidated and immediately rebuilt). The fixpoint is a
-# lax.while_loop with an exact convergence test, capped at max_hops —
-# the same path-length bound the full recompute uses.
+# After the improvement products, only increase-invalidated pairs can
+# still be stale; restricted fixpoints on the affected sets (column
+# block, row block, grouped col+row, or dense — cheapest projections
+# win) finish. Pure-decrease events skip the fixpoint entirely. The
+# fixpoints are lax.while_loops with exact convergence tests, capped at
+# max_hops — the same path-length bound the full recompute uses.
 
 
 @partial(jax.jit, static_argnums=(1, 3, 4))
@@ -329,46 +349,137 @@ def _fix_block(state: EdgeState, n_nodes: int, d_block: jax.Array,
     return _fix_loop(weights, src, dstv, n_nodes, max_hops, d_block)
 
 
-@partial(jax.jit, static_argnums=5)
-def _event_projections(old_dist: jax.Array, s, d, wo, wn, n_nodes: int):
-    """Fused per-edge affected-set projections: (col_touched[n],
-    row_touched[n]) — the [n, n] crossing test never leaves the device
-    and fuses straight into the two reductions."""
-    eps = 1e-2 + 1e-5 * jnp.abs(old_dist)
-    via_old = old_dist[:, s][:, None] + wo + old_dist[d, :][None, :]
-    via_new = old_dist[:, s][:, None] + wn + old_dist[d, :][None, :]
-    up = wn > wo
-    hit = jnp.isfinite(old_dist) & (jnp.abs(via_old - old_dist) <= eps)
-    # decrease test: unreachable pairs (inf) that the cheaper edge now
-    # serves MUST be flagged — inf - eps is NaN and `< NaN` is always
-    # False, which would silently skip a link-up that reconnects a
-    # partition
-    improv = via_new < jnp.where(jnp.isfinite(old_dist),
-                                 old_dist - eps, INF)
-    touched = jnp.where(up, hit, improv)
-    return jnp.any(touched, axis=0), jnp.any(touched, axis=1)
+def _minplus_outer(a_cols: jax.Array, w: jax.Array,
+                   b_rows: jax.Array) -> jax.Array:
+    """min over e of a_cols[:, e] + w[e] + b_rows[e, :] — the rank-k
+    min-plus outer product behind batched detection and improvement.
+    The changed-edge axis k is small and static, so a Python unroll lets
+    XLA fuse the whole chain into ONE pass over the [n, n] output
+    (k passes via lax.scan would re-read the carry k times). Entries
+    padded with w=+inf are inert."""
+    out = a_cols[:, 0:1] + w[0] + b_rows[0:1, :]
+    for e in range(1, a_cols.shape[1]):
+        out = jnp.minimum(out, a_cols[:, e:e + 1] + w[e]
+                          + b_rows[e:e + 1, :])
+    return out
 
 
-@partial(jax.jit, static_argnums=6)
-def _inval_rows(old_dist: jax.Array, rows_idx: jax.Array, s, d, wo, wn,
-                n_nodes: int) -> jax.Array:
-    """Invalidation mask gathered to a row block: [B, n]."""
+# The pair-level crossing test for increased edge e=(s,d,w),
+# dist[u,j] == dist[u,s]+w+dist[d,j], projects to rows/columns with
+# exact O(n) WITNESSES: u has some flagged j iff j=d itself is flagged
+# (the suffix of any crossing shortest path is a crossing path to d),
+# so rows_e = {u : dist[u,s]+w <= dist[u,d]+eps} — two gathered
+# columns, no [n, n] pass. Symmetrically cols_e = {j : w+dist[d,j] <=
+# dist[s,j]+eps} from two gathered rows. Detection is O(n·k), not
+# O(n²·k); the precise pair-level mask is only ever computed on the
+# gathered block a fixpoint is about to rebuild (_up_inval_cols/_rows).
+
+
+@jax.jit
+def _up_inval_rows(old_dist: jax.Array, rows_idx: jax.Array,
+                   s, d, wo) -> jax.Array:
+    """Union increase-invalidation mask gathered to a row block [B, n]."""
     du = old_dist[rows_idx]                        # [B, n]
+    via = _minplus_outer(du[:, s], wo, old_dist[d, :])
     eps = 1e-2 + 1e-5 * jnp.abs(du)
-    via = du[:, s][:, None] + wo + old_dist[d, :][None, :]
-    hit = jnp.isfinite(du) & (jnp.abs(via - du) <= eps)
-    return jnp.where(wn > wo, hit, jnp.zeros_like(hit))
+    return jnp.isfinite(du) & (via <= du + eps)
 
 
-@partial(jax.jit, static_argnums=6)
-def _inval_cols(old_dist: jax.Array, cols_idx: jax.Array, s, d, wo, wn,
-                n_nodes: int) -> jax.Array:
-    """Invalidation mask gathered to a column block: [n, B]."""
+@jax.jit
+def _up_inval_cols(old_dist: jax.Array, cols_idx: jax.Array,
+                   s, d, wo) -> jax.Array:
+    """Union increase-invalidation mask gathered to a column block
+    [n, B]."""
     dj = old_dist[:, cols_idx]                     # [n, B]
+    via = _minplus_outer(old_dist[:, s], wo, old_dist[d][:, cols_idx])
     eps = 1e-2 + 1e-5 * jnp.abs(dj)
-    via = old_dist[:, s][:, None] + wo + old_dist[d, cols_idx][None, :]
-    hit = jnp.isfinite(dj) & (jnp.abs(via - dj) <= eps)
-    return jnp.where(wn > wo, hit, jnp.zeros_like(hit))
+    return jnp.isfinite(dj) & (via <= dj + eps)
+
+
+@partial(jax.jit, donate_argnums=0)
+def _apply_up_inval_dense(dist: jax.Array, s, d, wo):
+    """Invalidate (set +inf) every pair whose old shortest path crossed
+    an increased edge — dense, donated, one fused pass. Also returns
+    the hit projections (cols[n], rows[n]): the pair-level eps (scaled
+    by |dist[u,j]|) is slightly WIDER than the witness eps (scaled by
+    the endpoint distances), so callers that won't rebuild this very
+    matrix densely must add these projections to their rebuild sets or
+    a near-crossing pair could stay +inf."""
+    via = _minplus_outer(dist[:, s], wo, dist[d, :])
+    eps = 1e-2 + 1e-5 * jnp.abs(dist)
+    hit = jnp.isfinite(dist) & (via <= dist + eps)
+    return (jnp.where(hit, INF, dist),
+            jnp.any(hit, axis=0), jnp.any(hit, axis=1))
+
+
+@partial(jax.jit, donate_argnums=0)
+def _improve_block(seed: jax.Array, a: jax.Array, wn, b: jax.Array):
+    """Decrease application on an arbitrary [R, C] block: block' =
+    min(seed, rank-k min-plus product a[R, k] ⊗ wn ⊗ b[k, C]). Returns
+    (block', changed_over_rows[C], changed_over_cols[R]) — the ACTUAL
+    improved set, not an a-priori guess. R and C may each be the full
+    axis or a gathered candidate subset. `seed` may contain +inf
+    invalidation holes; a hole whose new path crosses a decreased edge
+    is rebuilt right here (this is also how a link-up reconnects a
+    partition: inf entries tighten through the product)."""
+    prod = _minplus_outer(a, wn, b)
+    d1 = jnp.minimum(seed, prod)
+    chg = d1 < seed
+    return d1, jnp.any(chg, axis=0), jnp.any(chg, axis=1)
+
+
+@jax.jit
+def _improve_candidates(old_dist: jax.Array, a_full: jax.Array, wn,
+                        b_full: jax.Array, s, d):
+    """PER-EDGE candidate improved rows/cols for a decrease batch,
+    O(n·k): a pair (u, j) can only improve through decreased edge e if
+    u's cost VIA e to e's head beats (or ties) its old distance there —
+    Dc[u,s_e]+wn_e <= old[u,d_e]+eps (the prefix of the improved path
+    is the exact new distance Dc, the suffix-cost witness is j=d_e) —
+    and symmetrically for columns. Conservative superset: ties are
+    kept so composed improvements (suffix improved by ANOTHER edge)
+    are never missed. Returns (u_mask[n, k], v_mask[k, n]) so the
+    caller can group edges by preferred projection — a restored link's
+    improvement set is a CROSS (its sources × everything plus
+    everything × its destinations), which only a grouped row-product +
+    col-product covers without going dense."""
+    col_d = old_dist[:, d]                       # [n, k]
+    via_u = a_full + wn[None, :]
+    eps_d = 1e-2 + 1e-5 * jnp.abs(col_d)
+    u_mask = jnp.isfinite(via_u) & (via_u <= col_d + eps_d)
+    row_s = old_dist[s, :]                       # [k, n]
+    via_v = wn[:, None] + b_full
+    eps_s = 1e-2 + 1e-5 * jnp.abs(row_s)
+    v_mask = jnp.isfinite(via_v) & (via_v <= row_s + eps_s)
+    return u_mask, v_mask
+
+
+@partial(jax.jit, static_argnums=(1, 3))
+def _fix_block_rev(state: EdgeState, n_nodes: int, d_block: jax.Array,
+                   max_hops: int) -> jax.Array:
+    """_fix_block on the REVERSED graph: column j of the result is the
+    exact distance FROM node j (dist rows, transposed) — used to get
+    exact new distances from every decreased-edge head."""
+    weights = edge_weights_latency(state)
+    src = jnp.where(state.active, state.dst, n_nodes)
+    dstv = jnp.where(state.active, state.src, 0)
+    return _fix_loop(weights, src, dstv, n_nodes, max_hops, d_block)
+
+
+@jax.jit
+def _per_edge_up_flags(old_dist: jax.Array, s, d, wo):
+    """Per-increased-edge affected projections via the exact witnesses:
+    (cols[k, n], rows[k, n]) from four gathered vectors per edge —
+    O(n·k) total. Entries padded with wo=+inf are inert."""
+    col_s = old_dist[:, s]                       # [n, k]
+    col_d = old_dist[:, d]                       # [n, k]
+    eps_d = 1e-2 + 1e-5 * jnp.abs(col_d)
+    rows = jnp.isfinite(col_d) & (col_s + wo[None, :] <= col_d + eps_d)
+    row_s = old_dist[s, :]                       # [k, n]
+    row_d = old_dist[d, :]                       # [k, n]
+    eps_s = 1e-2 + 1e-5 * jnp.abs(row_s)
+    cols = jnp.isfinite(row_s) & (wo[:, None] + row_d <= row_s + eps_s)
+    return cols, rows.T
 @partial(jax.jit, static_argnums=1)
 def _fix_rows_block(state: EdgeState, n_nodes: int, dist: jax.Array,
                     seed_rows: jax.Array, rows_idx: jax.Array,
@@ -503,25 +614,36 @@ def update_routes_incremental(state: EdgeState, n_nodes: int,
     +inf for a deleted/down edge — pass the DOWN direction with
     new_w=inf and the UP direction with old_w=inf).
 
-    Each changed edge is applied as its own mini-event (sequential
-    application is exact: a pair still routed through a later edge keeps
-    satisfying that edge's crossing test on the intermediate matrix),
-    and each picks the CHEAPER projection of its affected set by
-    estimated relaxation cost:
+    ALL edges of the event are processed as ONE batch (see the section
+    comment above for the exactness argument): O(n·k) witness-based
+    per-edge detection for the increases (no [n, n] detection passes),
+    exact endpoint-block fixpoints plus grouped rank-k min-plus
+    products for the decreases, then restricted fixpoints on the
+    affected sets, picking the cheapest projections by estimated
+    relaxation cost:
 
     - column block (cost ≈ E × B_cols per sweep): a transit link — many
       sources, few destinations behind it;
     - row block (cost ≈ E_block × n per sweep): a stub uplink — one
       source, every destination;
-    - both wide (a high-betweenness cut in a sparse mesh): dense seeded
-      fixpoint over the full matrix, still reusing everything valid.
+    - GROUPED col pass + row pass: a link's two directions (and a
+      restored link's improvement set) form a CROSS — narrow in each
+      projection separately, dense as a union;
+    - both wide per edge (a high-betweenness cut in a sparse mesh):
+      dense seeded fixpoint over the full matrix, still reusing
+      everything valid.
 
-    Returns (dist, nh, cells): `cells` is the number of matrix cells
-    re-derived (block area summed over edges) — the work measure the
-    flap bench reports. Tie caveat: where an event creates a NEW
-    equal-cost alternative without changing a distance, untouched
-    entries keep their previous (still shortest) next hop, which may
-    differ from a cold recompute's lowest-row tie-break.
+    Pure-decrease events (links up) skip the fixpoint: the grouped
+    products are already exact everywhere.
+
+    Returns (dist, nh, cells): `cells` counts matrix cells re-derived —
+    fixpoint block areas plus product block areas. Detection (O(n·k)
+    gathered witness tests) is NOT in `cells`; its cost is negligible
+    and included in the bench's wall-clock numbers. Tie
+    caveat: where an event creates a NEW equal-cost alternative without
+    changing a distance, untouched entries keep their previous (still
+    shortest) next hop, which may differ from a cold recompute's
+    lowest-row tie-break.
 
     Note max_hops caps fixpoint ITERATIONS, not path length: at
     convergence the result is the exact shortest-path matrix, matching
@@ -531,78 +653,352 @@ def update_routes_incremental(state: EdgeState, n_nodes: int,
     """
     import numpy as np
 
-    src_np = np.asarray(changed_src)
-    dst_np = np.asarray(changed_dst)
+    src_np = np.asarray(changed_src).astype(np.int64)
+    dst_np = np.asarray(changed_dst).astype(np.int64)
     wo_np = np.asarray(old_w, np.float32)
     wn_np = np.asarray(new_w, np.float32)
-    # one up-front copy each: the per-edge write-backs below DONATE their
-    # input, updating in place instead of copying [n, n] per scatter —
-    # without consuming the caller's arrays
+    # drop no-op rows (unchanged weight, including inf→inf)
+    keep = wo_np != wn_np
+    src_np, dst_np = src_np[keep], dst_np[keep]
+    wo_np, wn_np = wo_np[keep], wn_np[keep]
     dist = jnp.array(old_dist)
     nh = jnp.array(old_nh)
+    if len(src_np) == 0:
+        return dist, nh, 0
+    up = wn_np > wo_np
+    dn = ~up
     cells = 0
     E = state.capacity
+
+    def pad_edges(idx):
+        """(s, d, w) device arrays padded to pow2 with inert w=inf."""
+        k = int(idx.sum())
+        kp = _pow2(max(k, 1))
+        s = np.concatenate([src_np[idx], np.zeros(kp - k, np.int64)])
+        d = np.concatenate([dst_np[idx], np.zeros(kp - k, np.int64)])
+        return jnp.asarray(s, jnp.int32), jnp.asarray(d, jnp.int32)
+
+    # Per-edge flags are the default detection: a link-down's two
+    # directions flood OPPOSITE projections (one touches few rows
+    # across many columns, the other few columns across many rows), so
+    # the UNION is wide in both axes while each edge alone is narrow —
+    # grouping by per-edge preference keeps blocks small. The witness
+    # form makes this O(n·k), so there is no size cap.
+    pcK = prK = None
+    if up.any():
+        s_u, d_u = pad_edges(up)
+        ku = int(up.sum())
+        wo_u = jnp.asarray(np.concatenate(
+            [wo_np[up], np.full(s_u.shape[0] - ku, np.inf, np.float32)]))
+        pc, pr = _per_edge_up_flags(dist, s_u, d_u, wo_u)
+        pcK = np.array(pc)[:ku]
+        prK = np.array(pr)[:ku]
+        colU = pcK.any(axis=0)
+        rowU = prK.any(axis=0)
+    else:
+        colU = np.zeros(n_nodes, bool)
+        rowU = np.zeros(n_nodes, bool)
+
+    if dn.any():
+        # exact new distances TO decreased-edge sources (column block)
+        # and FROM decreased-edge heads (reverse-graph column block),
+        # seeded with increase invalidation applied
+        S_nodes = np.unique(src_np[dn])
+        D_nodes = np.unique(dst_np[dn])
+        Bs, Bd = _pow2(len(S_nodes)), _pow2(len(D_nodes))
+        S_pad = jnp.asarray(np.concatenate(
+            [S_nodes, np.full(Bs - len(S_nodes), S_nodes[0])]), jnp.int32)
+        D_pad = jnp.asarray(np.concatenate(
+            [D_nodes, np.full(Bd - len(D_nodes), D_nodes[0])]), jnp.int32)
+        seed_S = dist[:, S_pad]
+        seed_R = dist[D_pad, :]
+        if up.any():
+            seed_S = jnp.where(
+                _up_inval_cols(dist, S_pad, s_u, d_u, wo_u), INF, seed_S)
+            seed_R = jnp.where(
+                _up_inval_rows(dist, D_pad, s_u, d_u, wo_u), INF, seed_R)
+        Dc = _fix_block(state, n_nodes, seed_S, max_hops)     # [n, Bs]
+        Dr = _fix_block_rev(state, n_nodes, seed_R.T, max_hops).T
+        # per-decreased-edge gathers into the rank-k product operands
+        s_pos = {int(v): i for i, v in enumerate(S_nodes)}
+        d_pos = {int(v): i for i, v in enumerate(D_nodes)}
+        kd = int(dn.sum())
+        kp = _pow2(kd)
+        a_idx = np.zeros(kp, np.int64)
+        b_idx = np.zeros(kp, np.int64)
+        a_idx[:kd] = [s_pos[int(v)] for v in src_np[dn]]
+        b_idx[:kd] = [d_pos[int(v)] for v in dst_np[dn]]
+        wn_d = jnp.asarray(np.concatenate(
+            [wn_np[dn], np.full(kp - kd, np.inf, np.float32)]))
+        s_dn = jnp.asarray(np.concatenate(
+            [src_np[dn], np.zeros(kp - kd, np.int64)]), jnp.int32)
+        d_dn = jnp.asarray(np.concatenate(
+            [dst_np[dn], np.zeros(kp - kd, np.int64)]), jnp.int32)
+        A_full = Dc[:, jnp.asarray(a_idx, jnp.int32)]         # [n, kp]
+        B_full = Dr[jnp.asarray(b_idx, jnp.int32), :]         # [kp, n]
+        # candidate improved rows/cols (O(n·k) witness tests): restrict
+        # the product to the smaller projection instead of a dense n²
+        # pass — a single restored transit link improves a bounded
+        # block, not the whole matrix
+        u_mask, v_mask = _improve_candidates(dist, A_full, wn_d, B_full,
+                                             s_dn, d_dn)
+        u_mask = np.asarray(u_mask)[:, :kd]      # [n, kd]
+        v_mask = np.asarray(v_mask)[:kd, :]      # [kd, n]
+        # Group decreased edges by preferred product projection (the
+        # same cross-separation as the fixpoint strategy: a restored
+        # link improves its-sources × everything AND everything ×
+        # its-destinations). An edge goes to the col-product if its
+        # destination set is narrower than its source set; each group's
+        # product covers all its edges' improved pairs (a pair improved
+        # via edge e has u in U_e and j in V_e, so whichever group e
+        # landed in contains it). The two products commute: both take
+        # min with the same exact via-values.
+        prod_cols = np.zeros(n_nodes, bool)
+        prod_rows = np.zeros(n_nodes, bool)
+        for e in range(kd):
+            nu, nv = int(u_mask[:, e].sum()), int(v_mask[e].sum())
+            if nv <= nu:
+                prod_cols |= v_mask[e]
+            else:
+                prod_rows |= u_mask[:, e]
+        if up.any():
+            # mixed event: the witness tests compare against OLD
+            # distances, which increases may have stale-LOW — an
+            # improved pair whose prefix/suffix endpoint distance was
+            # raised can fail them. Such a pair's endpoint pair is
+            # invalidated, so its row/col is increase-flagged: widening
+            # with the increase projections restores the cover
+            # (first/last-decreased-edge decomposition), provided both
+            # products run.
+            prod_cols |= colU
+            prod_rows |= rowU
+        chg_c_np = np.zeros(n_nodes, bool)
+        chg_r_np = np.zeros(n_nodes, bool)
+        # per-product-pass changed flags, kept separate so the
+        # downstream nh/fixpoint grouping sees each pass's NARROW
+        # projection instead of the cross-shaped union
+        dn_pseudo: list[tuple[np.ndarray, np.ndarray]] = []
+        cost_prod = 0
+        if prod_cols.any():
+            cost_prod += n_nodes * _pow2(int(prod_cols.sum()))
+        if prod_rows.any():
+            cost_prod += _pow2(int(prod_rows.sum())) * n_nodes
+        if cost_prod > n_nodes * n_nodes:
+            # grouped blocks degenerate: one dense product
+            if up.any():
+                dist, iv_c, iv_r = _apply_up_inval_dense(dist, s_u, d_u,
+                                                         wo_u)
+                # the pair-level inval eps is wider than the witness
+                # eps: every pair the dense inval INF'd must reach a
+                # rebuild block, or a near-crossing pair the product
+                # doesn't improve would be stranded at +inf
+                iv_c, iv_r = np.asarray(iv_c), np.asarray(iv_r)
+                chg_c_np |= iv_c
+                chg_r_np |= iv_r
+                dn_pseudo.append((np.array(iv_c), np.array(iv_r)))
+            dist, chg_c, chg_r = _improve_block(dist, A_full, wn_d,
+                                                B_full)
+            cells += n_nodes * n_nodes
+            chg_c_np |= np.asarray(chg_c)
+            chg_r_np |= np.asarray(chg_r)
+            dn_pseudo.append((chg_c_np.copy(), chg_r_np.copy()))
+        else:
+            if prod_cols.any():
+                v_idx = np.nonzero(prod_cols)[0]
+                B = _pow2(len(v_idx))
+                cols = jnp.asarray(np.concatenate(
+                    [v_idx, np.full(B - len(v_idx), v_idx[0])]),
+                    jnp.int32)
+                seed_blk = dist[:, cols]
+                if up.any():
+                    iv_blk = _up_inval_cols(dist, cols, s_u, d_u, wo_u)
+                    seed_blk = jnp.where(iv_blk, INF, seed_blk)
+                    # pairs this pass INF'd must reach a rebuild block
+                    # (pair-level eps is wider than the witness eps)
+                    iv_c = np.zeros(n_nodes, bool)
+                    iv_c[v_idx] = np.asarray(
+                        jnp.any(iv_blk, axis=0))[:len(v_idx)]
+                    iv_r = np.array(np.asarray(jnp.any(iv_blk, axis=1)))
+                    chg_c_np |= iv_c
+                    chg_r_np |= iv_r
+                    dn_pseudo.append((iv_c, iv_r))
+                d_blk, chg_c_blk, chg_r_blk = _improve_block(
+                    seed_blk, A_full, wn_d, B_full[:, cols])
+                dist = _scatter_cols(dist, cols, d_blk)
+                pc_c = np.zeros(n_nodes, bool)
+                pc_c[v_idx] = np.asarray(chg_c_blk)[:len(v_idx)]
+                pc_r = np.array(np.asarray(chg_r_blk))
+                chg_c_np |= pc_c
+                chg_r_np |= pc_r
+                dn_pseudo.append((pc_c, pc_r))
+                cells += B * n_nodes
+            if prod_rows.any():
+                u_idx = np.nonzero(prod_rows)[0]
+                B = _pow2(len(u_idx))
+                rws = jnp.asarray(np.concatenate(
+                    [u_idx, np.full(B - len(u_idx), u_idx[0])]),
+                    jnp.int32)
+                seed_blk = dist[rws, :]
+                if up.any():
+                    iv_blk = _up_inval_rows(dist, rws, s_u, d_u, wo_u)
+                    seed_blk = jnp.where(iv_blk, INF, seed_blk)
+                    iv_c = np.array(np.asarray(jnp.any(iv_blk, axis=0)))
+                    iv_r = np.zeros(n_nodes, bool)
+                    iv_r[u_idx] = np.asarray(
+                        jnp.any(iv_blk, axis=1))[:len(u_idx)]
+                    chg_c_np |= iv_c
+                    chg_r_np |= iv_r
+                    dn_pseudo.append((iv_c, iv_r))
+                d_blk, chg_c_blk, chg_r_blk = _improve_block(
+                    seed_blk, A_full[rws, :], wn_d, B_full)
+                dist = _scatter_rows(dist, rws, d_blk)
+                pr_c = np.array(np.asarray(chg_c_blk))
+                pr_r = np.zeros(n_nodes, bool)
+                pr_r[u_idx] = np.asarray(chg_r_blk)[:len(u_idx)]
+                chg_c_np |= pr_c
+                chg_r_np |= pr_r
+                dn_pseudo.append((pr_c, pr_r))
+                cells += B * n_nodes
+        colU = colU | chg_c_np
+        rowU = rowU | chg_r_np
+
+    cols_np = np.nonzero(colU)[0]
+    rows_np = np.nonzero(rowU)[0]
+    n_cols, n_rows = len(cols_np), len(rows_np)
+    if n_cols == 0 and n_rows == 0:
+        return dist, nh, cells
+    # pure-decrease events: dist is already exact everywhere after the
+    # dense product; only the next hops of the changed block need
+    # refreshing. Any increase requires the restricted fixpoint.
+    need_fix = bool(up.any())
+    # invalidation state: with decreases present the dense pass above
+    # already INF'd every invalidated pair; otherwise the passes below
+    # apply invalidation on their gathered blocks
+    inval_applied = bool(dn.any())
+
     state_src = np.asarray(state.src)
     state_active = np.asarray(state.active)
     deg = np.bincount(state_src[state_active], minlength=n_nodes)
-    for k in range(len(src_np)):
-        sk = jnp.int32(src_np[k])
-        dk = jnp.int32(dst_np[k])
-        wo = jnp.float32(wo_np[k])
-        wn = jnp.float32(wn_np[k])
-        col_t, row_t = _event_projections(dist, sk, dk, wo, wn, n_nodes)
-        cols_np = np.nonzero(np.asarray(col_t))[0]
-        rows_np = np.nonzero(np.asarray(row_t))[0]
-        n_cols, n_rows = len(cols_np), len(rows_np)
-        if n_cols == 0 and n_rows == 0:
-            continue
-        # estimated per-sweep relaxation cost of each projection
-        cost_col = E * _pow2(max(n_cols, 1))
-        eb = _pow2(max(int(deg[rows_np].sum()), 1))
-        cost_row = eb * n_nodes
-        cost_full = E * n_nodes
-        if min(cost_col, cost_row) > cost_full // 2:
-            seed = dist
-            if bool(wn_np[k] > wo_np[k]):
-                inval_full = _inval_cols(
-                    dist, jnp.arange(n_nodes), sk, dk, wo, wn, n_nodes)
-                seed = jnp.where(inval_full, INF, dist)
-            dist = refine_dist(state, n_nodes, seed, max_hops, dst_chunk)
-            nh = next_hop_edges(state, dist, n_nodes, dst_chunk)
-            cells += n_nodes * n_nodes
-            continue
-        if cost_col <= cost_row:
-            B = _pow2(n_cols)
-            cols = jnp.asarray(np.concatenate(
-                [cols_np, np.full(B - n_cols, cols_np[0], np.int64)]))
-            inval = _inval_cols(dist, cols, sk, dk, wo, wn, n_nodes)
-            seed_cols = jnp.where(inval, INF, dist[:, cols])
+    cost_full = E * n_nodes
+
+    def cost_of(nc, nr, rows_sel):
+        c = E * _pow2(max(nc, 1))
+        r = _pow2(max(int(deg[rows_sel].sum()), 1)) * n_nodes
+        return c, r
+
+    def col_pass(dist, nh, cols_sel, fix):
+        B = _pow2(len(cols_sel))
+        cols = jnp.asarray(np.concatenate(
+            [cols_sel, np.full(B - len(cols_sel), cols_sel[0],
+                               np.int64)]))
+        seed_cols = dist[:, cols]
+        if fix:
+            if not inval_applied:
+                seed_cols = jnp.where(
+                    _up_inval_cols(dist, cols, s_u, d_u, wo_u),
+                    INF, seed_cols)
             d_cols = _fix_block(state, n_nodes, seed_cols, max_hops)
-            nh_cols = _nh_block(state, n_nodes, d_cols)
             dist = _scatter_cols(dist, cols, d_cols)
-            nh = _scatter_cols(nh, cols, nh_cols)
-            cells += B * n_nodes
         else:
-            B = _pow2(n_rows)
-            rows_idx = np.concatenate(
-                [rows_np, np.full(B - n_rows, n_nodes, np.int64)])
-            row_map = np.full(n_nodes + 1, B, np.int32)
-            row_map[rows_idx[:n_rows]] = np.arange(n_rows, dtype=np.int32)
-            sel_mask = state_active & (row_map[state_src] < B)
-            sel_np = np.nonzero(sel_mask)[0]
-            Eb = _pow2(max(len(sel_np), 1))
-            sel = np.concatenate(
-                [sel_np, np.full(Eb - len(sel_np), E, np.int64)])
-            rows_j = jnp.asarray(rows_idx, jnp.int32)
-            row_map_j = jnp.asarray(row_map)
-            sel_j = jnp.asarray(sel, jnp.int32)
-            inval = _inval_rows(dist, rows_j, sk, dk, wo, wn, n_nodes)
-            seed_rows = jnp.where(inval, INF, dist[rows_j])
+            d_cols = seed_cols  # already exact
+        nh_cols = _nh_block(state, n_nodes, d_cols)
+        nh = _scatter_cols(nh, cols, nh_cols)
+        return dist, nh, B * n_nodes
+
+    def row_pass(dist, nh, rows_sel, fix):
+        B = _pow2(len(rows_sel))
+        rows_idx = np.concatenate(
+            [rows_sel, np.full(B - len(rows_sel), n_nodes, np.int64)])
+        row_map = np.full(n_nodes + 1, B, np.int32)
+        row_map[rows_idx[:len(rows_sel)]] = np.arange(
+            len(rows_sel), dtype=np.int32)
+        sel_mask = state_active & (row_map[state_src] < B)
+        sel_np = np.nonzero(sel_mask)[0]
+        Eb = _pow2(max(len(sel_np), 1))
+        sel = np.concatenate(
+            [sel_np, np.full(Eb - len(sel_np), E, np.int64)])
+        rows_j = jnp.asarray(rows_idx, jnp.int32)
+        row_map_j = jnp.asarray(row_map)
+        sel_j = jnp.asarray(sel, jnp.int32)
+        seed_rows = dist[rows_j]
+        if fix:
+            if not inval_applied:
+                seed_rows = jnp.where(
+                    _up_inval_rows(dist, rows_j, s_u, d_u, wo_u),
+                    INF, seed_rows)
             d_rows = _fix_rows_block(state, n_nodes, dist, seed_rows,
                                      rows_j, row_map_j, sel_j, max_hops)
-            nh_rows = _nh_rows_block(state, n_nodes, dist, d_rows,
-                                     rows_j, row_map_j, sel_j)
+        else:
+            d_rows = seed_rows  # already exact
+        nh_rows = _nh_rows_block(state, n_nodes, dist, d_rows,
+                                 rows_j, row_map_j, sel_j)
+        if fix:
             dist = _scatter_rows(dist, rows_j, d_rows)
-            nh = _scatter_rows(nh, rows_j, nh_rows)
-            cells += B * n_nodes
+        nh = _scatter_rows(nh, rows_j, nh_rows)
+        return dist, nh, B * n_nodes
+
+    # Three candidate strategies, cheapest estimated cost wins:
+    # (1) ONE block on the union — right when the whole event leans one
+    #     way (e.g. every changed edge behind the same aggregation);
+    # (2) GROUPED: one column pass for the col-preferring edges, then
+    #     one row pass for the rest. A link-down's two directions
+    #     prefer OPPOSITE projections (leaf→agg touches one row across
+    #     all columns; agg→leaf one column across all rows): their
+    #     union is a cross, not a block, but each group stays narrow.
+    #     Ordering makes this exact: the column pass rebuilds every
+    #     invalidated pair whose column is in its block (including
+    #     those also in row-block rows), so by the time the row pass
+    #     runs, all non-block rows it reads are final;
+    # (3) DENSE seeded fixpoint, when both of the above degenerate.
+    cost_col, cost_row = cost_of(n_cols, n_rows, rows_np)
+    cost_union = min(cost_col, cost_row)
+
+    per_edge: list[tuple[np.ndarray, np.ndarray]] = []
+    if pcK is not None:
+        per_edge += [(pcK[e], prK[e]) for e in range(pcK.shape[0])]
+    if dn.any():
+        # decreases: group by each product pass's ACTUAL changed set
+        per_edge.extend(dn_pseudo)
+    group_cols = np.zeros(n_nodes, bool)
+    group_rows = np.zeros(n_nodes, bool)
+    cost_grouped = None
+    if per_edge:
+        for col_e, row_e in per_edge:
+            rows_sel = np.nonzero(row_e)[0]
+            c_c, c_r = cost_of(int(col_e.sum()), len(rows_sel), rows_sel)
+            if c_c <= c_r:
+                group_cols |= col_e
+            else:
+                group_rows |= row_e
+        cost_grouped = 0
+        if group_cols.any():
+            cost_grouped += cost_of(int(group_cols.sum()), 0, [])[0]
+        if group_rows.any():
+            gr = np.nonzero(group_rows)[0]
+            cost_grouped += cost_of(0, len(gr), gr)[1]
+
+    best = min(cost_union, cost_full,
+               cost_grouped if cost_grouped is not None else cost_full + 1)
+    if best == cost_full:
+        if need_fix:
+            if not inval_applied:
+                dist, _ic, _ir = _apply_up_inval_dense(dist, s_u, d_u,
+                                                       wo_u)
+            dist = refine_dist(state, n_nodes, dist, max_hops, dst_chunk)
+        nh = next_hop_edges(state, dist, n_nodes, dst_chunk)
+        return dist, nh, cells + n_nodes * n_nodes
+    if best == cost_union or cost_grouped is None:
+        if cost_col <= cost_row:
+            dist, nh, c = col_pass(dist, nh, cols_np, need_fix)
+        else:
+            dist, nh, c = row_pass(dist, nh, rows_np, need_fix)
+        return dist, nh, cells + c
+    if group_cols.any():
+        dist, nh, c = col_pass(dist, nh, np.nonzero(group_cols)[0],
+                               need_fix)
+        cells += c
+    if group_rows.any():
+        dist, nh, c = row_pass(dist, nh, np.nonzero(group_rows)[0],
+                               need_fix)
+        cells += c
     return dist, nh, cells

@@ -524,9 +524,10 @@ def reconverge_10k(events: int = 4, seed: int = 0, dst_chunk: int = 1004):
     the k8s-cluster shape rather than random_mesh's high-betweenness
     sparse graph), one link down per event, routes re-derived with the
     INCREMENTAL delta path (ops.routing.update_routes_incremental:
-    affected-projection detection, row- or column-restricted min-plus
+    one batched union detection, row- or column-restricted min-plus
     fixpoint seeded from the previous matrix) and verified against a
-    converged full recompute on the first event.
+    converged full recompute on the first event; plus one chaos-style
+    10-link flap processed as a single batched event (down and up).
 
     The BGP-convergence analogue of a real failure: the reference's pods
     would run routing daemons that withdraw/re-advertise; here the
@@ -582,6 +583,39 @@ def reconverge_10k(events: int = 4, seed: int = 0, dst_chunk: int = 1004):
                            "cells": int(cells)})
     steady = [e["reconverge_s"] for e in event_rows[1:]] or \
         [event_rows[0]["reconverge_s"]]
+
+    # chaos-style 10-link flap as ONE batched event (round-5): all 20
+    # directed edges in one detection + one restricted fixpoint, then
+    # all 10 links restored in one event (the composed-improvement
+    # case). Agreement for the multi-edge path is pinned by
+    # tests/test_routing.py's 10-link oracle; the bench records latency.
+    src0, dst0, uid0, props0 = el.directed()
+    flap = rng.choice(el.n_links, 10, replace=False)
+    both = np.concatenate([flap, flap + el.n_links]).astype(np.int32)
+    w_old = np.asarray(W(state))[both]
+    s_k = np.asarray(state.src)[both]
+    d_k = np.asarray(state.dst)[both]
+    state = es.delete_links(state, jnp.asarray(both),
+                            jnp.ones(len(both), bool))
+    tb = time.perf_counter()
+    dist, nh, cells_dn = R.update_routes_incremental(
+        state, n_nodes, dist, nh, s_k, d_k, w_old,
+        np.full(len(both), np.inf, np.float32), dst_chunk=dst_chunk)
+    jax.block_until_ready((dist, nh))
+    flap10_down_s = time.perf_counter() - tb
+    state = es.apply_links(
+        state, jnp.asarray(both), jnp.asarray(uid0[both]),
+        jnp.asarray(src0[both]), jnp.asarray(dst0[both]),
+        jnp.asarray(props0[both]), jnp.ones(len(both), bool))
+    w_new = np.asarray(W(state))[both]
+    tb = time.perf_counter()
+    dist, nh, cells_up = R.update_routes_incremental(
+        state, n_nodes, dist, nh, s_k, d_k,
+        np.full(len(both), np.inf, np.float32), w_new,
+        dst_chunk=dst_chunk)
+    jax.block_until_ready((dist, nh))
+    flap10_up_s = time.perf_counter() - tb
+
     return {
         "scenario": "reconverge_10k",
         "nodes": n_nodes,
@@ -592,6 +626,9 @@ def reconverge_10k(events: int = 4, seed: int = 0, dst_chunk: int = 1004):
         "reconverge_s_steady": round(float(np.mean(steady)), 3),
         "speedup_vs_full": round(full_s_ref / float(np.mean(steady)), 1),
         "matches_full_recompute": agrees,
+        "flap10_down_s": round(flap10_down_s, 3),
+        "flap10_up_s": round(flap10_up_s, 3),
+        "flap10_cells": int(cells_dn + cells_up),
         "wall_s": round(time.perf_counter() - t0, 3),
     }
 

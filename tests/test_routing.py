@@ -394,6 +394,107 @@ class TestIncrementalReconvergence:
         # bounded work: far less than the n*n a full recompute touches
         assert cells < n * n // 4, (cells, n * n)
 
+    def test_ten_link_flap_batch_matches_full_recompute(self):
+        """Round-5: a chaos-style 10-link flap (20 directed edges) is
+        ONE event — one batched detection, one (or two grouped)
+        restricted fixpoints — and must agree exactly with a converged
+        full recompute, down and up, including the link-up direction
+        where improvements can compose across several restored links."""
+        import dataclasses
+
+        el, state, lat = self._mesh(n_nodes=300, n_links=900, seed=21)
+        n = el.n_nodes
+        src0, dst0, uid0, props0 = el.directed()
+        dist, nh = self._full_exact(state, n)
+        rng = np.random.default_rng(5)
+        flap = rng.choice(el.n_links, 10, replace=False)
+        both = np.concatenate([flap, flap + el.n_links]).astype(np.int32)
+        w_old = np.asarray(R.edge_weights_latency(state))[both]
+        s_k = np.asarray(state.src)[both]
+        d_k = np.asarray(state.dst)[both]
+
+        state = es.delete_links(state, jnp.asarray(both),
+                                jnp.ones(len(both), bool))
+        dist, nh, cells = R.update_routes_incremental(
+            state, n, dist, nh, s_k, d_k, w_old,
+            np.full(len(both), np.inf, np.float32))
+        dist_f, _ = self._full_exact(state, n)
+        assert np.allclose(np.asarray(dist), np.asarray(dist_f),
+                           rtol=1e-5, atol=1e-1, equal_nan=True)
+        assert cells > 0
+        # next hops still realize the shortest distances
+        dn_, nhn = np.asarray(dist), np.asarray(nh)
+        w = np.asarray(R.edge_weights_latency(state))
+        dstv = np.asarray(state.dst)
+        ii, jj = np.nonzero(nhn >= 0)
+        e = nhn[ii, jj]
+        np.testing.assert_allclose(w[e] + dn_[dstv[e], jj], dn_[ii, jj],
+                                   rtol=1e-5, atol=1e-1)
+
+        # all 10 links back up in ONE event: composed improvements
+        # (pairs whose new path crosses SEVERAL restored links) must
+        # come out exact via the endpoint-block decomposition
+        state = es.apply_links(
+            state, jnp.asarray(both), jnp.asarray(uid0[both]),
+            jnp.asarray(src0[both]), jnp.asarray(dst0[both]),
+            jnp.asarray(props0[both]), jnp.ones(len(both), bool))
+        props2 = np.asarray(state.props).copy()
+        props2[:, es.P_LATENCY_US] = lat
+        state = dataclasses.replace(state, props=jnp.asarray(props2))
+        w_new = np.asarray(R.edge_weights_latency(state))[both]
+        dist, nh, _ = R.update_routes_incremental(
+            state, n, dist, nh, s_k, d_k,
+            np.full(len(both), np.inf, np.float32), w_new)
+        dist_f, _ = self._full_exact(state, n)
+        assert np.allclose(np.asarray(dist), np.asarray(dist_f),
+                           rtol=1e-5, atol=1e-1, equal_nan=True)
+
+    @pytest.mark.parametrize("mesh_seed,ev_seed", [(31, 9), (44, 17),
+                                                    (58, 23)])
+    def test_mixed_up_down_batch_matches_full_recompute(self, mesh_seed,
+                                                        ev_seed):
+        """One event containing BOTH increases and decreases (some links
+        slow down while others come up) exercises the interaction: the
+        decrease endpoint blocks must be seeded with increase
+        invalidation, every INF'd pair must reach a rebuild block (the
+        pair-level inval eps is wider than the witness eps — a stranded
+        +inf here is the round-5 review's finding 1), and the final
+        fixpoint must rebuild invalidated pairs the products didn't.
+        Multiple seeds because the failure mode is a float-tolerance
+        corner."""
+        import dataclasses
+
+        el, state, lat = self._mesh(n_nodes=250, n_links=750,
+                                    seed=mesh_seed)
+        n = el.n_nodes
+        dist, nh = self._full_exact(state, n)
+        rng = np.random.default_rng(ev_seed)
+        pick = rng.choice(el.n_links, 6, replace=False)
+        slow = np.concatenate([pick[:3], pick[:3] + el.n_links])
+        fast = np.concatenate([pick[3:], pick[3:] + el.n_links])
+        both = np.concatenate([slow, fast]).astype(np.int32)
+        w_old = np.asarray(R.edge_weights_latency(state))[both]
+        props = np.asarray(state.props).copy()
+        props[slow, es.P_LATENCY_US] *= 50.0    # increases
+        props[fast, es.P_LATENCY_US] *= 0.02    # decreases
+        state = dataclasses.replace(state, props=jnp.asarray(props))
+        w_new = np.asarray(R.edge_weights_latency(state))[both]
+        s_k = np.asarray(state.src)[both]
+        d_k = np.asarray(state.dst)[both]
+        dist, nh, cells = R.update_routes_incremental(
+            state, n, dist, nh, s_k, d_k, w_old, w_new)
+        dist_f, _ = self._full_exact(state, n)
+        assert np.allclose(np.asarray(dist), np.asarray(dist_f),
+                           rtol=1e-5, atol=1e-1, equal_nan=True)
+        # next hops realize the distances after a mixed event too
+        dn_, nhn = np.asarray(dist), np.asarray(nh)
+        w = np.asarray(R.edge_weights_latency(state))
+        dstv = np.asarray(state.dst)
+        ii, jj = np.nonzero(nhn >= 0)
+        e = nhn[ii, jj]
+        np.testing.assert_allclose(w[e] + dn_[dstv[e], jj], dn_[ii, jj],
+                                   rtol=1e-5, atol=1e-1)
+
     def test_no_change_event_is_free(self):
         """Deleting an edge that no shortest path uses re-derives
         nothing."""
