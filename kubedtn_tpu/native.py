@@ -59,6 +59,9 @@ def _declare(lib) -> None:
         "kdt_classify_batch_ptrs": (None, [c.POINTER(c.c_char_p), u64p,
                                            c.c_int64,
                                            c.POINTER(c.c_int32)]),
+        "kdt_parse_packet_batch": (c.c_int64, [u8p, c.c_uint64,
+                                               c.POINTER(c.c_int64),
+                                               u64p, u64p, c.c_int64]),
         "kdt_ft_decide_batch_ptrs": (c.c_int64, [c.c_void_p,
                                                  c.POINTER(c.c_char_p),
                                                  u64p, c.c_int64, u8p,
@@ -193,6 +196,36 @@ def classify_batch(frames: list[bytes]) -> list[str]:
         _buf(blob), offs.ctypes.data_as(u64p), lens.ctypes.data_as(u64p),
         n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
     return [FRAME_TYPES[v] for v in out.tolist()]
+
+
+def parse_packet_batch(blob: bytes):
+    """Decode one serialized PacketBatch into flat numpy arrays
+    (ids[int64], frame_offsets[uint64], frame_lens[uint64]) in ONE
+    native call — the ingestion hot path's replacement for a protobuf
+    runtime that would build a Python message object per frame. Offsets
+    index into `blob`; the caller materializes each frame as one bytes
+    slice. Raises ValueError on malformed input (callers fall back to
+    the protobuf runtime)."""
+    import numpy as np
+
+    lib = _load()
+    nb = len(blob)
+    # every packet costs >= 2 bytes of framing (tag + length)
+    n_max = nb // 2 + 1
+    ids = np.empty(n_max, np.int64)
+    offs = np.empty(n_max, np.uint64)
+    lens = np.empty(n_max, np.uint64)
+    c = ctypes
+    u64p = c.POINTER(c.c_uint64)
+    # zero-copy: c_char_p borrows the bytes object's buffer (the parser
+    # only reads, and the returned offsets index the Python-side blob)
+    n = lib.kdt_parse_packet_batch(
+        c.cast(c.c_char_p(blob), c.POINTER(c.c_uint8)), nb,
+        ids.ctypes.data_as(c.POINTER(c.c_int64)),
+        offs.ctypes.data_as(u64p), lens.ctypes.data_as(u64p), n_max)
+    if n < 0:
+        raise ValueError("malformed PacketBatch")
+    return ids[:n], offs[:n], lens[:n]
 
 
 def classify_counts(frames: list[bytes], lens=None) -> dict[str, int]:

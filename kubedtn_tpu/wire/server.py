@@ -438,6 +438,54 @@ class Daemon:
                 for f in frames:
                     self.capture.record(wire.pod_key, wire.uid, f, "in")
 
+    def _bulk_groups(self, item):
+        """Yield (wire_id, frames) groups from one bulk-stream message,
+        which arrives either as RAW serialized-PacketBatch bytes (the
+        native-decoder fast path registered by make_server) or as a
+        parsed PacketBatch (in-process callers, no-native builds).
+
+        Raw path: ONE native call yields flat (ids, offsets, lens)
+        arrays; each frame then costs a single bytes-slice — no
+        per-frame message objects. The all-one-wire case (how the
+        daemons' own egress coalesces) skips grouping entirely."""
+        if not isinstance(item, (bytes, bytearray, memoryview)):
+            groups: dict[int, list[bytes]] = {}
+            for pkt in item.packets:
+                # pkt.frame is already a bytes object — no copy
+                groups.setdefault(pkt.remot_intf_id, []).append(pkt.frame)
+            yield from groups.items()
+            return
+        from kubedtn_tpu import native as _nat
+
+        blob = bytes(item)
+        try:
+            ids, offs, lens = _nat.parse_packet_batch(blob)
+        except ValueError:
+            # malformed per the native walker: let the protobuf runtime
+            # be the arbiter (it raises its own error on true garbage)
+            batch = pb.PacketBatch()
+            batch.ParseFromString(blob)
+            yield from self._bulk_groups(batch)
+            return
+        if len(ids) == 0:
+            return
+        ends = offs + lens
+        if (ids[0] == ids).all():
+            yield int(ids[0]), [blob[o:e] for o, e in
+                                zip(offs.tolist(), ends.tolist())]
+            return
+        import numpy as np
+
+        order = np.argsort(ids, kind="stable")
+        ids_s = ids[order]
+        offs_s, ends_s = offs[order].tolist(), ends[order].tolist()
+        bounds = np.nonzero(np.diff(ids_s))[0] + 1
+        starts = [0, *bounds.tolist(), len(ids_s)]
+        for g in range(len(starts) - 1):
+            a, b = starts[g], starts[g + 1]
+            yield int(ids_s[a]), [blob[o:e] for o, e in
+                                  zip(offs_s[a:b], ends_s[a:b])]
+
     def SendToBulk(self, request_iterator, context):
         """Framework extension: client-streaming of PacketBatch — the
         daemons' own cross-node egress transport (runtime._flush_remote),
@@ -445,12 +493,8 @@ class Daemon:
         fewer gRPC messages. Falls outside the reference IDL; peers that
         don't speak it get the per-frame stream instead."""
         n = 0
-        for batch in request_iterator:
-            groups: dict[int, list[bytes]] = {}
-            for pkt in batch.packets:
-                # pkt.frame is already a bytes object — no defensive copy
-                groups.setdefault(pkt.remot_intf_id, []).append(pkt.frame)
-            for wid, frames in groups.items():
+        for item in request_iterator:
+            for wid, frames in self._bulk_groups(item):
                 wire = self.wires.get_by_id(wid)
                 if wire is not None:
                     self._frames_in_bulk(wire, frames)
@@ -463,11 +507,8 @@ class Daemon:
         """Framework extension: coalesced InjectFrame — pod-origin
         ingress at bulk-transport rates (load generation, tests)."""
         n = 0
-        for batch in request_iterator:
-            groups: dict[int, list[bytes]] = {}
-            for pkt in batch.packets:
-                groups.setdefault(pkt.remot_intf_id, []).append(pkt.frame)
-            for wid, frames in groups.items():
+        for item in request_iterator:
+            for wid, frames in self._bulk_groups(item):
                 wire = self.wires.get_by_id(wid)
                 if wire is None:
                     self.count_bulk_unresolved(len(frames))
@@ -509,7 +550,10 @@ class Daemon:
                     self._remark(wire)  # retry once the link is realized
                 continue
             # single consumer: len() can only grow under our feet, so
-            # `take` is always safe to pop
+            # `take` is always safe to pop (a C-speed copy+clear would
+            # be faster but clear() can race a concurrent append and
+            # silently drop it — the popleft form is the lock-free safe
+            # one)
             q = wire.ingress
             take = min(len(q), max_per_wire)
             pop = q.popleft
@@ -561,16 +605,27 @@ class Daemon:
         return True
 
 
-def _handler(fn, req_cls, resp_cls, streaming: bool):
+# Bulk ingestion RPCs skip protobuf deserialization entirely when the
+# native PacketBatch decoder is available: the handler receives the RAW
+# message bytes and decodes offsets/ids in one native call (the Python
+# protobuf runtime would build a message object per frame at hundreds of
+# ns each — the single largest ingestion cost at bulk rates). The
+# daemon-side methods accept both forms, so in-process callers and
+# builds without the native library keep the parsed-message path.
+_RAW_BYTES_METHODS = frozenset({"SendToBulk", "InjectBulk"})
+
+
+def _handler(fn, req_cls, resp_cls, streaming: bool, raw: bool = False):
+    deser = (lambda b: b) if raw else req_cls.FromString
     if streaming:
         return grpc.stream_unary_rpc_method_handler(
             fn,
-            request_deserializer=req_cls.FromString,
+            request_deserializer=deser,
             response_serializer=resp_cls.SerializeToString,
         )
     return grpc.unary_unary_rpc_method_handler(
         fn,
-        request_deserializer=req_cls.FromString,
+        request_deserializer=deser,
         response_serializer=resp_cls.SerializeToString,
     )
 
@@ -672,9 +727,15 @@ def make_server(daemon: Daemon, port: int = DEFAULT_PORT,
         ("Remote", pb.REMOTE_METHODS),
         ("WireProtocol", pb.WIRE_METHODS),
     ]
+    try:
+        from kubedtn_tpu import native as _nat
+        raw_ok = _nat.have_native()
+    except Exception:
+        raw_ok = False
     for service, methods in tables:
         handlers = {
-            m: _handler(getattr(daemon, m), req, resp, streaming)
+            m: _handler(getattr(daemon, m), req, resp, streaming,
+                        raw=raw_ok and m in _RAW_BYTES_METHODS)
             for m, (req, resp, streaming) in methods.items()
         }
         server.add_generic_rpc_handlers((

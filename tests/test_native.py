@@ -373,3 +373,55 @@ def test_wheel_advance_clamps_negative_time():
     assert tw.advance(6_000) == [42]     # wheel time not fast-forwarded
     tw.schedule(2_000, 7)                # still schedulable after the clamp
     assert tw.advance(2_500) == [7]
+
+
+@pytest.mark.skipif(not native.have_native(), reason="no native lib")
+class TestParsePacketBatch:
+    def test_roundtrip_matches_protobuf(self):
+        from kubedtn_tpu.wire import proto as pb
+
+        b = pb.PacketBatch(packets=[
+            pb.Packet(remot_intf_id=7, frame=b"hello"),
+            pb.Packet(remot_intf_id=1 << 40, frame=b"x" * 300),
+            pb.Packet(remot_intf_id=7, frame=b""),
+        ])
+        blob = b.SerializeToString()
+        ids, offs, lens = native.parse_packet_batch(blob)
+        assert ids.tolist() == [7, 1 << 40, 7]
+        frames = [blob[int(o):int(o) + int(n)]
+                  for o, n in zip(offs, lens)]
+        assert frames == [b"hello", b"x" * 300, b""]
+
+    def test_unknown_fields_skipped(self):
+        # a future PacketBatch with an extra field 2 (varint) per the
+        # wire format must still parse the known packets
+        from kubedtn_tpu.wire import proto as pb
+
+        core = pb.PacketBatch(packets=[
+            pb.Packet(remot_intf_id=3, frame=b"f")]).SerializeToString()
+        blob = core + bytes([0x10, 0x05])  # field 2, varint 5
+        ids, offs, lens = native.parse_packet_batch(blob)
+        assert ids.tolist() == [3]
+
+    def test_overflow_length_varints_rejected(self):
+        """Regression (round-5 review): a length varint near 2^64 must
+        be REJECTED, not wrap the cursor backward into an infinite loop
+        — this parser eats raw network bytes (remote-DoS surface)."""
+        huge = b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\x01"
+        with pytest.raises(ValueError):
+            native.parse_packet_batch(b"\x0a" + huge)  # outer length
+        with pytest.raises(ValueError):
+            # inner frame length inside a well-formed packet envelope
+            native.parse_packet_batch(
+                bytes([0x0a, 12, 0x12]) + huge + b"xx")
+        with pytest.raises(ValueError):
+            native.parse_packet_batch(b"\xff\xff\xff")  # garbage tag
+
+    def test_truncated_rejected(self):
+        from kubedtn_tpu.wire import proto as pb
+
+        blob = pb.PacketBatch(packets=[
+            pb.Packet(remot_intf_id=3, frame=b"abcdef")]) \
+            .SerializeToString()
+        with pytest.raises(ValueError):
+            native.parse_packet_batch(blob[:-3])
