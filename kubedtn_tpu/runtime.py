@@ -662,13 +662,20 @@ class WireDataPlane:
 
         # -- vectorized bypass decision OUTSIDE the engine lock --------
         # (eBPF sockops/redir semantics; no native flow table → no
-        # bypass, same gate as the per-frame _try_bypass)
+        # bypass, same gate as the per-frame _try_bypass). Per-protocol
+        # classification (frame_stats) is FUSED into the same native
+        # call: both need the frame pointer array, and building it is a
+        # third of each call's cost. A frame is counted exactly once —
+        # on its first decide pass (holdback frames are predecided and
+        # skip counting; frames requeued before deciding count when
+        # they finally decide).
         ft = self._flowtable
         if ft is not None:
             flat_frames: list[bytes] = []
             lens_parts: list[np.ndarray] = []
             elig_parts: list[np.ndarray] = []
             shp_parts: list[np.ndarray] = []
+            cnt_parts: list[np.ndarray] = []
             for _w, row, lens, fr, predecided in batches:
                 target = rowinfo.get(row)
                 ok = False
@@ -685,10 +692,16 @@ class WireDataPlane:
                     np.full(m, 1 if ok else 0, np.uint8))
                 shp_parts.append(
                     np.full(m, 1 if row in shaped_rows else 0, np.uint8))
-            decide = ft.decide_batch(flat_frames,
-                                     np.concatenate(elig_parts),
-                                     np.concatenate(shp_parts),
-                                     lens=np.concatenate(lens_parts))
+                cnt_parts.append(
+                    np.full(m, 0 if predecided else 1, np.uint8))
+            decide, class_stats = ft.decide_classify_batch(
+                flat_frames,
+                np.concatenate(elig_parts),
+                np.concatenate(shp_parts),
+                np.concatenate(cnt_parts),
+                lens=np.concatenate(lens_parts))
+            if class_stats:
+                self.daemon.frame_stats.update(class_stats)
             if decide.any():
                 pos = 0
                 kept_batches = []
@@ -708,6 +721,14 @@ class WireDataPlane:
                     else:
                         kept_batches.append((w, row, lens, fr, pd))
                 batches = kept_batches
+        elif self.daemon._classify is not None:
+            # flow table unavailable but the classifier is: keep
+            # frame_stats flowing (same exactly-once point — first
+            # decide-stage pass)
+            for _w, _row, lens, fr, predecided in batches:
+                if not predecided:
+                    self.daemon.frame_stats.update(
+                        self.daemon._classify(fr, lens))
         if not batches:
             return 0
 

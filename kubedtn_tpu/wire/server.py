@@ -562,8 +562,11 @@ class Daemon:
                 self._remark(wire)  # residue beyond this tick's budget
             if frames:
                 lens = [len(f) for f in frames]
-                if self._classify is not None:
-                    self.frame_stats.update(self._classify(frames, lens))
+                # per-protocol counting happens at the DECIDE stage (the
+                # data plane fuses it into the bypass-verdict native
+                # call — round 5), not here: the drain must stay cheap
+                # and each frame still counts exactly once, on its
+                # first decide pass.
                 out.append((wire, row, lens, frames))
         return out
 

@@ -598,11 +598,17 @@ extern "C" {
 
 // Pointer-array form: no concatenated blob copy (see
 // kdt_classify_batch_ptrs).
-int64_t kdt_ft_decide_batch_ptrs(void* h, const uint8_t* const* frames,
-                                 const uint64_t* lens, int64_t n,
-                                 const uint8_t* eligible,
-                                 const uint8_t* shaped,
-                                 uint8_t* out_bypass) {
+// Fused verdicts + per-protocol class counts: the data-plane tick needs
+// both for the same drained batch, and the frame-pointer marshalling is
+// a third of each call's cost — share it. countable[i]=0 (or passing
+// countable/out_class as NULL) skips classification (holdback frames
+// were counted on their first pass); out_class[i] is the FrameType or
+// -1 when skipped. The plain decide form delegates here so there is
+// exactly ONE decide loop to keep in sync with the per-frame path.
+int64_t kdt_ft_decide_classify_batch_ptrs(
+    void* h, const uint8_t* const* frames, const uint64_t* lens,
+    int64_t n, const uint8_t* eligible, const uint8_t* shaped,
+    const uint8_t* countable, uint8_t* out_bypass, int32_t* out_class) {
   auto* ft = static_cast<FlowTable*>(h);
   std::lock_guard<std::mutex> g(ft->mu);
   int64_t bypassed = 0;
@@ -611,8 +617,23 @@ int64_t kdt_ft_decide_batch_ptrs(void* h, const uint8_t* const* frames,
         ? decide_one(ft, frames[i], lens[i], shaped[i])
         : 0;
     bypassed += out_bypass[i];
+    if (out_class != nullptr) {
+      out_class[i] = (countable != nullptr && countable[i])
+          ? kdt_classify_frame(frames[i], lens[i])
+          : -1;
+    }
   }
   return bypassed;
+}
+
+int64_t kdt_ft_decide_batch_ptrs(void* h, const uint8_t* const* frames,
+                                 const uint64_t* lens, int64_t n,
+                                 const uint8_t* eligible,
+                                 const uint8_t* shaped,
+                                 uint8_t* out_bypass) {
+  return kdt_ft_decide_classify_batch_ptrs(
+      h, frames, lens, n, eligible, shaped, nullptr, out_bypass,
+      nullptr);
 }
 
 // TCP close (sockops.c bpf_sock_ops_state_cb): drop this direction's proxy

@@ -651,3 +651,44 @@ def test_slow_peer_does_not_stall_local_delivery():
     dp.stop()
     slow_server.stop(0)
     hole_server.stop(0)
+
+
+def test_plane_restart_recreates_peer_senders(two_nodes):
+    """stop()/start() must not black-hole cross-node egress: per-peer
+    sender threads are one-shot, so a restarted plane needs FRESH ones —
+    a cached dead sender would enqueue frames into a queue with no
+    consumer forever (round-5 review finding)."""
+    (store_a, engine_a, daemon_a, _, addr_a), \
+        (store_b, engine_b, daemon_b, _, addr_b) = two_nodes
+    t1, _ = seed(store_a, addr_a, addr_b, latency="")
+    seed(store_b, addr_a, addr_b, latency="")
+    assert engine_a.add_links(t1, t1.spec.links)
+    client_b = DaemonClient(addr_b)
+    resp = client_b.AddGRPCWireRemote(pb.WireDef(
+        local_pod_name="r2", kube_ns="default", link_uid=7,
+        intf_name_in_pod="eth1", peer_ip=addr_a))
+    wire_a = daemon_a._add_wire(pb.WireDef(
+        local_pod_name="r1", kube_ns="default", link_uid=7,
+        intf_name_in_pod="eth1", peer_ip=addr_b,
+        peer_intf_id=resp.peer_intf_id))
+    wire_b = daemon_b.wires.get_by_key("default/r2", 7)
+
+    dp = WireDataPlane(daemon_a)
+    wire_a.ingress.append(b"\x01" * 60)
+    dp.tick(now_s=10.0)
+    dp.tick(now_s=10.001)
+    assert dp.flush_peers()
+    assert len(wire_b.egress) == 1
+    assert len(dp._peer_senders) == 1
+
+    # restart the plane: the old sender thread is gone
+    dp.stop()
+    assert not dp._peer_senders
+    wire_a.ingress.append(b"\x02" * 60)
+    dp.tick(now_s=10.1)
+    dp.tick(now_s=10.101)
+    assert dp.flush_peers(), "egress black-holed after restart"
+    assert len(wire_b.egress) == 2
+    assert daemon_a.forward_errors == 0
+    dp.stop()
+    client_b.close()
