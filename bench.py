@@ -479,6 +479,19 @@ def main() -> None:
                               "dropped", "tick_errors")
         }
 
+    def run_live_soak():
+        from kubedtn_tpu.scenarios import live_plane_soak
+
+        r = live_plane_soak(pairs=8,
+                            seconds=12.0 if degraded else 25.0)
+        extras["live_soak"] = {
+            k: r[k] for k in ("seconds", "sustained_frames_per_s",
+                              "worst_window_frames_per_s", "flatness",
+                              "windows_frames_per_s",
+                              "end_ingress_backlog", "dropped",
+                              "tick_errors")
+        }
+
     def run_reconverge_10k():
         from kubedtn_tpu.scenarios import reconverge_10k
 
@@ -531,6 +544,7 @@ def main() -> None:
     phase("reconcile_100k", run_reconcile)
     phase("wire_streaming", lambda: bench_wire_streaming(extras))
     phase("live_plane", run_live_plane)
+    phase("live_soak", run_live_soak)
     phase("reconverge_10k", run_reconverge_10k)
 
     try:

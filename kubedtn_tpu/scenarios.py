@@ -653,6 +653,10 @@ for wid in wids:
     pkt = pb.Packet(remot_intf_id=wid, frame=frame)
     blobs.append(pb.PacketBatch(packets=[pkt] * chunk).SerializeToString())
 def gen():
+    if n_per < 0:  # soak mode: stream until the parent kills us
+        while True:
+            for b in blobs:
+                yield b
     left = [n_per] * len(wids)
     while any(left):
         for i in range(len(wids)):
@@ -663,6 +667,48 @@ t0 = time.perf_counter()
 call(gen())
 print(f"{time.perf_counter() - t0:.3f}", flush=True)
 """
+
+
+def _live_plane_setup(pairs: int, latency: str, dt_us: float,
+                      prefix: str):
+    """Shared topology/daemon/server/wire setup for the live-plane
+    scenarios (per-round benchmark and continuous soak): `pairs` shaped
+    pod pairs on a real gRPC daemon with the real-time runner started.
+    Returns (daemon, server, port, plane, wires_in, wires_out)."""
+    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon, make_server
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=4 * pairs + 8)
+    props = LinkProperties(latency=latency)
+    for i in range(pairs):
+        a, b = f"{prefix}-a{i}", f"{prefix}-b{i}"
+        store.create(Topology(name=a, spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=b,
+                 uid=i + 1, properties=props)])))
+        store.create(Topology(name=b, spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=a,
+                 uid=i + 1, properties=props)])))
+        engine.setup_pod(a)
+        engine.setup_pod(b)
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    server, port = make_server(daemon, port=0, host="127.0.0.1",
+                               log_rpcs=False)
+    server.start()
+    plane = WireDataPlane(daemon, dt_us=dt_us)
+    wires_in, wires_out = [], []
+    for i in range(pairs):
+        wires_in.append(daemon._add_wire(pb.WireDef(
+            local_pod_name=f"{prefix}-a{i}", kube_ns="default",
+            link_uid=i + 1, intf_name_in_pod="eth1")))
+        wires_out.append(daemon._add_wire(pb.WireDef(
+            local_pod_name=f"{prefix}-b{i}", kube_ns="default",
+            link_uid=i + 1, intf_name_in_pod="eth1")))
+    plane.start()
+    return daemon, server, port, plane, wires_in, wires_out
 
 
 def live_plane(pairs: int = 8, frames_per_wire: int = 40_000,
@@ -692,41 +738,9 @@ def live_plane(pairs: int = 8, frames_per_wire: int = 40_000,
     import subprocess
     import sys as _sys
 
-    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
-    from kubedtn_tpu.runtime import WireDataPlane
-    from kubedtn_tpu.wire import proto as pb
-    from kubedtn_tpu.wire.server import Daemon, make_server
-
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    store = TopologyStore()
-    engine = SimEngine(store, capacity=4 * pairs + 8)
-    props = LinkProperties(latency=latency)
-    for i in range(pairs):
-        a, b = f"lp-a{i}", f"lp-b{i}"
-        store.create(Topology(name=a, spec=TopologySpec(links=[
-            Link(local_intf="eth1", peer_intf="eth1", peer_pod=b,
-                 uid=i + 1, properties=props)])))
-        store.create(Topology(name=b, spec=TopologySpec(links=[
-            Link(local_intf="eth1", peer_intf="eth1", peer_pod=a,
-                 uid=i + 1, properties=props)])))
-        engine.setup_pod(a)
-        engine.setup_pod(b)
-    Reconciler(store, engine).drain()
-
-    daemon = Daemon(engine)
-    server, port = make_server(daemon, port=0, host="127.0.0.1",
-                               log_rpcs=False)
-    server.start()
-    plane = WireDataPlane(daemon, dt_us=dt_us)
-    wires_in, wires_out = [], []
-    for i in range(pairs):
-        wires_in.append(daemon._add_wire(pb.WireDef(
-            local_pod_name=f"lp-a{i}", kube_ns="default", link_uid=i + 1,
-            intf_name_in_pod="eth1")))
-        wires_out.append(daemon._add_wire(pb.WireDef(
-            local_pod_name=f"lp-b{i}", kube_ns="default", link_uid=i + 1,
-            intf_name_in_pod="eth1")))
-    plane.start()
+    daemon, server, port, plane, wires_in, wires_out = _live_plane_setup(
+        pairs, latency, dt_us, "lp")
     wid_list = ",".join(str(w.wire_id) for w in wires_in)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
 
@@ -791,6 +805,109 @@ def live_plane(pairs: int = 8, frames_per_wire: int = 40_000,
     }
 
 
+def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
+                    latency: str = "5ms", dt_us: float = 2_000.0,
+                    window_s: float = 2.5):
+    """SUSTAINED live-plane throughput under continuous load — the
+    honest counterpart of live_plane's per-round numbers. One injector
+    subprocess streams InjectBulk without a frame budget for
+    `seconds`; delivered frames are drained and counted per
+    `window_s` window, so the result exposes any rate decay over time
+    (state accumulation, GC growth, queue buildup) instead of
+    averaging it away. flatness = worst window / median window; a
+    plane that only bursts would show early windows far above late
+    ones. The reference's kernel plane sustains indefinitely
+    (grpcwire.go:386-462) — this is the measurement that claim is
+    compared against."""
+    import os
+    import statistics
+    import subprocess
+    import sys as _sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    daemon, server, port, plane, wires_in, wires_out = _live_plane_setup(
+        pairs, latency, dt_us, "sk")
+    wid_list = ",".join(str(w.wire_id) for w in wires_in)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.perf_counter()
+    proc = subprocess.Popen(
+        [_sys.executable, "-c", _INJECTOR_SRC, str(port), wid_list,
+         "-1", repo_root],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+
+    def drain_count() -> int:
+        # exact and safe against concurrent extends: popleft until empty
+        # (len()+clear() could silently eat frames appended in between)
+        c = 0
+        for w in wires_out:
+            dq = w.egress
+            while True:
+                try:
+                    dq.popleft()
+                except IndexError:
+                    break
+                c += 1
+        return c
+
+    try:
+        # window 0 opens at the FIRST delivery so injector startup
+        # (~1-2s of interpreter+grpc) never counts against the plane.
+        # A dead injector (stderr is discarded) must fail FAST and
+        # LOUDLY, not produce a plausible all-zero "success" record.
+        deadline = time.monotonic() + 60.0
+        while drain_count() == 0:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"soak injector exited rc={proc.returncode} before "
+                    f"first delivery")
+            if time.monotonic() >= deadline:
+                # fail LOUDLY: measuring windows against a
+                # not-yet-delivering pipeline would bank a plausible
+                # near-zero record as a successful phase
+                raise RuntimeError(
+                    "soak saw no delivery within 60s (injector alive)")
+            time.sleep(0.01)
+        windows: list[float] = []
+        t_end = time.monotonic() + seconds
+        while time.monotonic() < t_end:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"soak injector died mid-run rc={proc.returncode} "
+                    f"after {len(windows)} windows")
+            w0 = time.monotonic()
+            time.sleep(window_s)
+            got = drain_count()
+            windows.append(got / (time.monotonic() - w0))
+        # unbounded ingress means a too-fast injector shows up as
+        # BACKLOG, not as a rate dip — record it so "flat" can't hide
+        # buildup the delivered-rate windows never see
+        backlog = sum(len(w.ingress) for w in wires_in)
+    finally:
+        proc.kill()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+        plane.stop()
+        server.stop(0)
+    rates = sorted(windows)
+    med = statistics.median(rates) if rates else 0.0
+    return {
+        "scenario": "live_plane_soak",
+        "pairs": pairs,
+        "seconds": seconds,
+        "window_s": window_s,
+        "windows_frames_per_s": [round(w, 1) for w in windows],
+        "sustained_frames_per_s": round(med, 1),
+        "worst_window_frames_per_s": round(rates[0], 1) if rates else 0.0,
+        "flatness": round(rates[0] / med, 3) if med else 0.0,
+        "end_ingress_backlog": int(backlog),
+        "dropped": plane.dropped,
+        "tick_errors": plane.tick_errors,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 LADDER = {
     "3node": three_node,
     "fat_tree_64": fat_tree_64,
@@ -801,5 +918,6 @@ LADDER = {
     "scale_1m": scale_1m,
     "chaos_flaps": chaos_flaps,
     "live_plane": live_plane,
+    "live_plane_soak": live_plane_soak,
     "reconverge_10k": reconverge_10k,
 }

@@ -18,6 +18,7 @@ declares it but never implements it — kube_dtn.proto:171).
 from __future__ import annotations
 
 import threading
+import time
 from collections import Counter, deque
 from concurrent import futures
 from dataclasses import dataclass, field
@@ -197,6 +198,8 @@ class Daemon:
         # AND the per-peer sender threads — use count_forward_errors.
         self.forward_errors = 0
         self._err_lock = threading.Lock()
+        self._bp_slots = threading.BoundedSemaphore(
+            self._BP_MAX_SLEEPERS)
         # bulk-transport frames whose remot_intf_id resolved to no wire:
         # dropped (the per-frame SendToOnce aborts NOT_FOUND instead, but
         # a stream can't abort per-message without killing the batch), so
@@ -423,6 +426,35 @@ class Daemon:
             self.capture.record(wire.pod_key, wire.uid, frame, "in")
         return pb.BoolResponse(response=True)
 
+    # Bulk-ingestion backpressure: when a wire's ingress queue exceeds
+    # this many frames, the bulk handlers stall before extending further
+    # — gRPC flow control then pushes back on the sender, so a producer
+    # that outruns the data plane is paced instead of growing the
+    # unbounded deque without limit (the role kernel socket buffers play
+    # for the reference's wires). Per-frame RPCs are not gated: they
+    # cannot reach rates where this matters.
+    INGRESS_HIGH_WATER = 65_536
+    # at most this many gRPC workers may sit in a backpressure stall at
+    # once: the server pool has 16 workers, and a mesh of stalled bulk
+    # streams must never occupy them all and starve control-plane RPCs
+    # queued behind them — beyond the cap, producers overshoot the
+    # high-water mark by one batch instead of waiting
+    _BP_MAX_SLEEPERS = 4
+
+    def _ingress_backpressure(self, wire: Wire) -> None:
+        # bounded two ways: a ~2s deadline (a stopped data plane must
+        # not wedge a worker) and a sleeper cap (concurrent stalled
+        # streams must not exhaust the worker pool)
+        if not self._bp_slots.acquire(blocking=False):
+            return
+        try:
+            deadline = time.monotonic() + 2.0
+            while (len(wire.ingress) >= self.INGRESS_HIGH_WATER
+                   and time.monotonic() < deadline):
+                time.sleep(0.002)
+        finally:
+            self._bp_slots.release()
+
     def _frames_in_bulk(self, wire: Wire, frames: list[bytes]) -> None:
         """_frame_in for a whole PacketBatch group: ONE deque extend (one
         hot-mark/wake) instead of per-frame appends — the server half of
@@ -433,6 +465,7 @@ class Daemon:
                 for f in frames:
                     self.capture.record(wire.pod_key, wire.uid, f, "out")
         else:
+            self._ingress_backpressure(wire)
             wire.ingress.extend(frames)  # single notify marks it hot
             if self.capture is not None:
                 for f in frames:
@@ -513,6 +546,7 @@ class Daemon:
                 if wire is None:
                     self.count_bulk_unresolved(len(frames))
                     continue
+                self._ingress_backpressure(wire)
                 wire.ingress.extend(frames)
                 if self.capture is not None:
                     for f in frames:

@@ -574,3 +574,15 @@ def test_bulk_unresolved_frames_are_counted():
         client.close()
     finally:
         server.stop(0)
+
+
+def test_live_plane_soak_smoke():
+    """The sustained-rate soak at tiny scale: continuous injector,
+    windowed delivery counting, no drops, every window alive."""
+    from kubedtn_tpu.scenarios import live_plane_soak
+
+    r = live_plane_soak(pairs=2, seconds=3.0, window_s=1.0)
+    assert r["dropped"] == 0 and r["tick_errors"] == 0
+    assert len(r["windows_frames_per_s"]) >= 2
+    assert r["sustained_frames_per_s"] > 0
+    assert all(w > 0 for w in r["windows_frames_per_s"])
