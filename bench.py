@@ -220,13 +220,18 @@ def bench_link_updates(extras: dict) -> float:
             return st
 
         # warm up with the SAME static iters so the timed call reuses the
-        # compiled executable (a different iters would recompile)
+        # compiled executable (a different iters would recompile);
+        # median-of-3 timing — at the degraded iteration count a single
+        # sample swung 40-99M/s run to run on the shared build host
         st = run(jax.tree.map(lambda x: x.copy(), state), ITERS)
         jax.block_until_ready(st)
-        t0 = time.perf_counter()
-        st = run(st, ITERS)
-        jax.block_until_ready(st)
-        return L * ITERS / (time.perf_counter() - t0)
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            st = run(st, ITERS)
+            jax.block_until_ready(st)
+            samples.append(time.perf_counter() - t0)
+        return L * ITERS / statistics.median(samples)
 
     scattered = timed(rows_scat, False)
     extras["link_updates_scattered_per_s"] = round(scattered, 1)
