@@ -589,32 +589,47 @@ def reconverge_10k(events: int = 4, seed: int = 0, dst_chunk: int = 1004):
     # all 10 links restored in one event (the composed-improvement
     # case). Agreement for the multi-edge path is pinned by
     # tests/test_routing.py's 10-link oracle; the bench records latency.
+    # A warm-up flap (different links) compiles the multi-edge block-
+    # size buckets first, the same one-time-jit exclusion every other
+    # rung applies — a daemon's persistent cache makes restarts warm.
     src0, dst0, uid0, props0 = el.directed()
-    flap = rng.choice(el.n_links, 10, replace=False)
-    both = np.concatenate([flap, flap + el.n_links]).astype(np.int32)
-    w_old = np.asarray(W(state))[both]
-    s_k = np.asarray(state.src)[both]
-    d_k = np.asarray(state.dst)[both]
-    state = es.delete_links(state, jnp.asarray(both),
-                            jnp.ones(len(both), bool))
-    tb = time.perf_counter()
-    dist, nh, cells_dn = R.update_routes_incremental(
-        state, n_nodes, dist, nh, s_k, d_k, w_old,
-        np.full(len(both), np.inf, np.float32), dst_chunk=dst_chunk)
-    jax.block_until_ready((dist, nh))
-    flap10_down_s = time.perf_counter() - tb
-    state = es.apply_links(
-        state, jnp.asarray(both), jnp.asarray(uid0[both]),
-        jnp.asarray(src0[both]), jnp.asarray(dst0[both]),
-        jnp.asarray(props0[both]), jnp.ones(len(both), bool))
-    w_new = np.asarray(W(state))[both]
-    tb = time.perf_counter()
-    dist, nh, cells_up = R.update_routes_incremental(
-        state, n_nodes, dist, nh, s_k, d_k,
-        np.full(len(both), np.inf, np.float32), w_new,
-        dst_chunk=dst_chunk)
-    jax.block_until_ready((dist, nh))
-    flap10_up_s = time.perf_counter() - tb
+    def flap_event(state, dist, nh):
+        """One 10-link flap: delete all links (timed), restore all links
+        (timed); returns the new state/tables and the timings+cells.
+        The warm-up and the measured flap run this SAME code, so the
+        warm-up always compiles exactly the kernels the timed flap
+        uses."""
+        links = rng.choice(el.n_links, 10, replace=False)
+        both = np.concatenate([links, links + el.n_links]) \
+            .astype(np.int32)
+        w_old = np.asarray(W(state))[both]
+        s_k = np.asarray(state.src)[both]
+        d_k = np.asarray(state.dst)[both]
+        state = es.delete_links(state, jnp.asarray(both),
+                                jnp.ones(len(both), bool))
+        tb = time.perf_counter()
+        dist, nh, cells_dn = R.update_routes_incremental(
+            state, n_nodes, dist, nh, s_k, d_k, w_old,
+            np.full(len(both), np.inf, np.float32), dst_chunk=dst_chunk)
+        jax.block_until_ready((dist, nh))
+        down_s = time.perf_counter() - tb
+        state = es.apply_links(
+            state, jnp.asarray(both), jnp.asarray(uid0[both]),
+            jnp.asarray(src0[both]), jnp.asarray(dst0[both]),
+            jnp.asarray(props0[both]), jnp.ones(len(both), bool))
+        w_new = np.asarray(W(state))[both]
+        tb = time.perf_counter()
+        dist, nh, cells_up = R.update_routes_incremental(
+            state, n_nodes, dist, nh, s_k, d_k,
+            np.full(len(both), np.inf, np.float32), w_new,
+            dst_chunk=dst_chunk)
+        jax.block_until_ready((dist, nh))
+        up_s = time.perf_counter() - tb
+        return state, dist, nh, down_s, up_s, cells_dn + cells_up
+
+    state, dist, nh, _, _, _ = flap_event(state, dist, nh)  # warm-up
+    state, dist, nh, flap10_down_s, flap10_up_s, flap10_cells = \
+        flap_event(state, dist, nh)
 
     return {
         "scenario": "reconverge_10k",
@@ -628,7 +643,7 @@ def reconverge_10k(events: int = 4, seed: int = 0, dst_chunk: int = 1004):
         "matches_full_recompute": agrees,
         "flap10_down_s": round(flap10_down_s, 3),
         "flap10_up_s": round(flap10_up_s, 3),
-        "flap10_cells": int(cells_dn + cells_up),
+        "flap10_cells": int(flap10_cells),
         "wall_s": round(time.perf_counter() - t0, 3),
     }
 
