@@ -460,17 +460,31 @@ class TimingWheel:
             t.ctypes.data_as(u64p), w.shape[0])
 
     def advance(self, now_us: int) -> list[int]:
+        return self.advance_np(now_us).tolist()
+
+    def advance_np(self, now_us: int):
+        """advance() returning one numpy uint64 array instead of a list
+        of Python ints — the release path's per-frame int boxing was
+        measurable at bulk rates, and the array form lets the caller
+        group tokens by batch with vector ops."""
+        import numpy as np
+
         # clamp BEFORE the c_uint64 coercion: a negative elapsed time (clock
         # skew, synthetic test clocks) would wrap to ~1.8e19 and permanently
         # fast-forward the wheel, releasing everything ever scheduled
         now_us = max(0, int(now_us))
-        out: list[int] = []
+        parts: list = []
         while True:
             n = self._lib.kdt_tw_advance(self._h, now_us, self._out,
                                          len(self._out))
-            out.extend(self._out[:n])
+            if n:
+                parts.append(np.frombuffer(self._out, dtype=np.uint64,
+                                           count=n).copy())
             if n < len(self._out):
-                return out
+                break
+        if not parts:
+            return np.empty(0, np.uint64)
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
     def next_due_us(self) -> int | None:
         v = self._lib.kdt_tw_next_due_us(self._h)

@@ -206,9 +206,14 @@ def _bits_to_uniform(bits: jax.Array) -> jax.Array:
     `bits >> 8` would be an arithmetic shift (sign-extending), mapping
     half of all draws to NEGATIVE "uniforms" — which would read as
     certain loss/duplicate/corrupt hits in the kernel. Bitcast to
-    uint32 first so the shift is logical."""
+    uint32 first so the shift is logical. The shifted value is then
+    bitcast BACK to int32 before the float convert: Mosaic (TPU v5e)
+    has no uint32→float32 convert, and after the logical shift the
+    value fits in 24 bits, so the int32 bit pattern is the same
+    non-negative number and int32→float32 is supported."""
     ub = jax.lax.bitcast_convert_type(bits, jnp.uint32)
-    return (ub >> jnp.uint32(8)).astype(jnp.float32) * (2.0 ** -24)
+    sb = jax.lax.bitcast_convert_type(ub >> jnp.uint32(8), jnp.int32)
+    return sb.astype(jnp.float32) * (2.0 ** -24)
 
 
 def _shape_kernel_prng(seed_ref, props_ref, corr_ref, tokens_ref,

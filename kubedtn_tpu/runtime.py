@@ -960,29 +960,42 @@ class WireDataPlane:
         setd = groups.setdefault
         if self._wheel is not None:
             # Tokens arrive in wheel (time) order and consecutive tokens
-            # overwhelmingly share a batch: cache the current batch and
-            # its group list so the per-frame work is shift/mask +
-            # list-index + append — no dict op per frame. Exhausted
-            # batches are deleted so _pending tracks in-flight exactly.
+            # overwhelmingly share a batch: tokens come back as ONE
+            # numpy array, runs of equal batch-ids are found with vector
+            # ops, and the dominant case — a whole batch releasing
+            # together in index order (every latency-only batch shares
+            # one deadline) — is a single list extend, no per-frame
+            # work at all. Partial runs fall back to the per-token
+            # loop. Exhausted batches are deleted so _pending tracks
+            # in-flight exactly.
             pending = self._pending
-            last_bid = -1
-            entry = None
-            cur_list: list | None = None
-            for token in self._wheel.advance(
-                    (now_s - self._origin_s) * 1e6):
-                bid = token >> _TOK_BITS
-                if bid != last_bid:
-                    last_bid = bid
-                    entry = pending[bid]
+            toks = self._wheel.advance_np((now_s - self._origin_s) * 1e6)
+            if toks.size:
+                bids = toks >> np.uint64(_TOK_BITS)
+                idxs = toks & np.uint64(_TOK_MASK)
+                cut = np.nonzero(np.diff(bids))[0] + 1
+                starts = [0, *cut.tolist(), len(bids)]
+                for g in range(len(starts) - 1):
+                    a, b = starts[g], starts[g + 1]
+                    entry = pending[int(bids[a])]
                     cur_list = setd((entry[0], entry[1]), [])
-                i = token & _TOK_MASK
-                frames_l = entry[2]
-                cur_list.append(frames_l[i])
-                frames_l[i] = None
-                entry[4] -= 1
-                if entry[4] == 0:
-                    del pending[bid]
-                    last_bid = -1
+                    frames_l = entry[2]
+                    n = b - a
+                    if n == entry[4] == len(frames_l) and \
+                            int(idxs[a]) == 0 and int(idxs[b - 1]) == n - 1 \
+                            and (n <= 2 or bool(
+                                (np.diff(idxs[a:b].astype(np.int64))
+                                 == 1).all())):
+                        # full batch, token order == index order
+                        cur_list.extend(frames_l)
+                        del pending[int(bids[a])]
+                        continue
+                    for i in idxs[a:b].tolist():
+                        cur_list.append(frames_l[i])
+                        frames_l[i] = None
+                    entry[4] -= n
+                    if entry[4] == 0:
+                        del pending[int(bids[a])]
         else:
             while self._heap and self._heap[0][0] <= now_s:
                 _, _, pod_key, uid, frame = heapq.heappop(self._heap)

@@ -11,18 +11,31 @@ import sys
 # Must be set before jax initializes its backends. Note: the env var alone
 # is not enough under the axon TPU-tunnel platform, which overrides
 # JAX_PLATFORMS — the explicit config.update below is what sticks.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+#
+# KUBEDTN_TEST_PLATFORM=tpu keeps the real backend instead, for the few
+# on-chip-only tests (kernel paths interpret mode cannot execute, e.g.
+# the tiled Pallas on-core PRNG). Everything else skips or fails off the
+# 8-device mesh under that mode — select the on-chip tests explicitly:
+#   KUBEDTN_TEST_PLATFORM=tpu pytest tests -k on_chip
+_TEST_PLATFORM = os.environ.get("KUBEDTN_TEST_PLATFORM", "cpu")
+if _TEST_PLATFORM not in ("cpu", "tpu"):
+    raise RuntimeError(
+        f"KUBEDTN_TEST_PLATFORM={_TEST_PLATFORM!r}: expected 'cpu' or "
+        f"'tpu' (exact, lowercase)")
+if _TEST_PLATFORM == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if _TEST_PLATFORM == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
