@@ -171,6 +171,20 @@ def classify_frame(frame: bytes) -> str:
     return FRAME_TYPES[lib.kdt_classify_frame(_buf(frame), len(frame))]
 
 
+def frame_ptrs_u64(frames: list[bytes]):
+    """uint64[n] array of the frames' buffer addresses for the
+    pointer-array native calls. A c_char_p array's buffer IS a uint64
+    pointer array; the returned frombuffer view keeps that array — and
+    through it the frames — alive, but the CALLER must keep the frames
+    themselves referenced until the native call returns. The lifetime
+    contract lives HERE, once: decide_classify_batch and the data
+    plane's mixed bytes/segment pointer builder both use it."""
+    import numpy as np
+
+    arr = (ctypes.c_char_p * len(frames))(*frames)
+    return np.frombuffer(arr, np.uint64)
+
+
 def _frame_arrays(frames: list[bytes]):
     """(blob, offs u64[n], lens u64[n]) for a blob-form batch call (the
     offline decoder paths; the data-plane hot paths use the pointer-array
@@ -328,15 +342,32 @@ class FlowTable:
         import numpy as np
 
         n = len(frames)
-        out = np.zeros(n, np.uint8)
         if n == 0:
-            return out, {}
-        ptrs = (ctypes.c_char_p * n)(*frames)
+            return np.zeros(0, np.uint8), {}
+        ptrs_u64 = frame_ptrs_u64(frames)
         if lens is None:
             lens_a = np.fromiter((len(f) for f in frames), np.uint64,
                                  count=n)
         else:
-            lens_a = np.ascontiguousarray(lens, np.uint64)
+            lens_a = lens
+        return self.decide_classify_ptrs(ptrs_u64, lens_a, eligible,
+                                         shaped, countable)
+
+    def decide_classify_ptrs(self, ptrs_u64, lens, eligible, shaped,
+                             countable):
+        """Core of the fused decide+classify call taking a raw uint64
+        frame-pointer array — the zero-copy segment path computes
+        pointers as base+offset vector adds, so no per-frame Python
+        object is ever touched. The CALLER guarantees every pointed-to
+        buffer outlives this call."""
+        import numpy as np
+
+        n = len(ptrs_u64)
+        out = np.zeros(n, np.uint8)
+        if n == 0:
+            return out, {}
+        ptrs_c = np.ascontiguousarray(ptrs_u64, np.uint64)
+        lens_a = np.ascontiguousarray(lens, np.uint64)
         elig = np.ascontiguousarray(eligible, np.uint8)
         shp = np.ascontiguousarray(shaped, np.uint8)
         c = ctypes
@@ -351,7 +382,8 @@ class FlowTable:
             cls = np.empty(n, np.int32)
             cls_p = cls.ctypes.data_as(c.POINTER(c.c_int32))
         self._lib.kdt_ft_decide_classify_batch_ptrs(
-            self._h, ptrs, lens_a.ctypes.data_as(u64p), n,
+            self._h, ptrs_c.ctypes.data_as(c.POINTER(c.c_char_p)),
+            lens_a.ctypes.data_as(u64p), n,
             elig.ctypes.data_as(u8p), shp.ctypes.data_as(u8p),
             cnt_p, out.ctypes.data_as(u8p), cls_p)
         stats: dict = {}

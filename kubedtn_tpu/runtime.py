@@ -73,6 +73,7 @@ import numpy as np
 from kubedtn_tpu import native
 from kubedtn_tpu.ops import netem
 from kubedtn_tpu.ops.queues import EdgeCounters, init_counters
+from kubedtn_tpu.wire.server import FrameSeg, flatten_frames
 
 # The tick shapes with netem.shape_step_nodonate / rolls with
 # netem.roll_epoch_nodonate: the stock kernels donate their EdgeState
@@ -83,10 +84,59 @@ _ETH_IPV4 = 0x0800
 _PROTO_TCP = 6
 
 # wheel-token layout: (batch_seq << _TOK_BITS) | slot_index. Slots per
-# batch are bounded by max_slots (default 1024) << 2^20; batch_seq wraps
+# batch are bounded by max_slots (default 4096) << 2^20; batch_seq wraps
 # after 2^44 batches — beyond any process lifetime at data-plane rates.
 _TOK_BITS = 20
 _TOK_MASK = (1 << _TOK_BITS) - 1
+
+
+class _LazyFrames:
+    """Deferred materialization of a shaped batch's frames: the pending
+    delay-line entry holds the drained parts (FrameSeg windows / bytes)
+    and only turns them into per-frame bytes objects when delivery,
+    checkpoint export, or a partial release actually needs them — the
+    all-delivered whole-batch release (every latency-only batch) goes
+    straight from the blob to the egress extend."""
+
+    __slots__ = ("parts",)
+
+    def __init__(self, parts) -> None:
+        self.parts = parts
+
+    def materialize(self) -> list[bytes]:
+        return flatten_frames(self.parts)
+
+
+def _cat_lens(a, b):
+    """Concatenate two per-frame length containers (int lists from the
+    legacy path, uint64 arrays from the segment path)."""
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return np.concatenate([np.asarray(a, np.uint64),
+                               np.asarray(b, np.uint64)])
+    return a + b
+
+
+def _split_parts(parts: list, k: int) -> tuple[list, list]:
+    """Split a mixed parts list at frame index k (segments split by
+    window index, zero copies)."""
+    head: list = []
+    tail: list = []
+    cnt = 0
+    for p in parts:
+        n = len(p) if type(p) is FrameSeg else 1
+        if cnt >= k:
+            tail.append(p)
+        elif cnt + n <= k:
+            head.append(p)
+            cnt += n
+        else:
+            cut = k - cnt
+            head.append(FrameSeg(p.blob, p.offs, p.lens, p.lo,
+                                 p.lo + cut))
+            tail.append(FrameSeg(p.blob, p.offs, p.lens, p.lo + cut,
+                                 p.hi))
+            cnt = k
+    return head, tail
 
 
 def parse_tcp_flow(frame: bytes) -> tuple[int, int, int, int] | None:
@@ -286,7 +336,7 @@ class WireDataPlane:
     """Shapes wire frames through the engine's edge state in real time."""
 
     def __init__(self, daemon, dt_us: float = 10_000.0,
-                 max_slots: int = 1024, seed: int = 0) -> None:
+                 max_slots: int = 4096, seed: int = 0) -> None:
         self.daemon = daemon
         self.engine = daemon.engine
         self.dt_us = dt_us
@@ -294,7 +344,10 @@ class WireDataPlane:
         # no correlations, no reorder — netem.slot_independent_rows)
         # shape all of it in one elementwise kernel; rows with cross-slot
         # state are capped at seq_slots per tick (the lax.scan length)
-        # and keep the residue queued in order.
+        # and keep the residue queued in order. The budget only BINDS
+        # under saturation (light-load drains take whatever is queued),
+        # where bigger batches amortize per-tick fixed costs — queueing
+        # delay dominates delivery precision there anyway.
         self.max_slots = max_slots
         self.seq_slots = 64
         # Frames drained but deferred by the seq_slots cap wait HERE, not
@@ -372,6 +425,14 @@ class WireDataPlane:
         self.dropped = 0
         self.bypassed = 0      # frames that skipped shaping entirely
         self.tick_errors = 0   # unexpected tick failures (thread survives)
+        # cumulative wall seconds per tick stage — the live-plane's own
+        # breakdown of where time goes (drain = ingress collection,
+        # decide = classify+bypass verdict, kernel = device shaping
+        # incl. result sync, schedule = pending/wheel inserts + counter
+        # accumulation, release = due-frame delivery). ~6 perf_counter
+        # reads per tick; read via stage_breakdown()
+        self.stage_s = {"drain": 0.0, "decide": 0.0, "kernel": 0.0,
+                        "schedule": 0.0, "release": 0.0}
         self.last_now_s: float | None = None  # clock of the latest tick
         self._clock_ext = False  # latest tick ran on a caller-supplied clock
         self._ff_active = False  # fast_forward loop in progress
@@ -496,8 +557,12 @@ class WireDataPlane:
                 origin = self._origin_s
                 wheel_now = (0.0 if base is None or origin is None
                              else (base - origin) * 1e6)
-                for pk, uid, frames, deadlines, _rem in \
-                        self._pending.values():
+                for entry in self._pending.values():
+                    pk, uid, frames, deadlines = entry[:4]
+                    if type(frames) is _LazyFrames:
+                        # materialize IN the entry so a later partial
+                        # release and this export agree on slot identity
+                        frames = entry[2] = frames.materialize()
                     for i, frame in enumerate(frames):
                         if frame is not None:  # still in flight
                             out.append((pk, uid, frame,
@@ -570,16 +635,34 @@ class WireDataPlane:
         if self._origin_s is None:
             self._origin_s = now_s
         self.last_now_s = now_s
+        stage = self.stage_s
+        t0 = time.perf_counter()
         drained = self.daemon.drain_ingress(max_per_wire=self.max_slots,
                                             skip=self._holdback.keys()
                                             if self._holdback else None)
+        t1 = time.perf_counter()
+        stage["drain"] += t1 - t0
         shaped = 0
         if drained or self._holdback:
             shaped = self._shape_drained(drained, now_s)
+        t2 = time.perf_counter()
         self._release(now_s)
+        stage["release"] += time.perf_counter() - t2
         self.ticks += 1
         self.shaped += shaped
         return shaped
+
+    def stage_breakdown(self) -> dict:
+        """Cumulative per-stage tick seconds plus the derived share of
+        total accounted time — the first question of any live-plane
+        throughput investigation."""
+        total = sum(self.stage_s.values())
+        return {
+            "seconds": {k: round(v, 4) for k, v in self.stage_s.items()},
+            "share": {k: (round(v / total, 3) if total > 0 else 0.0)
+                      for k, v in self.stage_s.items()},
+            "ticks": self.ticks,
+        }
 
     def _shape_drained(self, drained, now_s: float) -> int:
         """Shape one tick's drained ingress, batched end-to-end: ONE
@@ -639,7 +722,7 @@ class WireDataPlane:
                 # the wire itself was deregistered mid-flight: neither
                 # its ingress deque nor a holdback slot will ever drain
                 # again — count the frames instead of leaking silently
-                self.undeliverable += len(frames_list)
+                self.undeliverable += len(lens)
             elif predecided:
                 # Holdback residue whose row vanished mid-wait: back into
                 # _holdback, NOT wire.ingress — a later drain would
@@ -651,7 +734,8 @@ class WireDataPlane:
                 prev = self._holdback.get(wire.wire_id)
                 if prev is not None:
                     self._holdback[wire.wire_id] = (
-                        wire, lens + prev[1], frames_list + prev[2])
+                        wire, _cat_lens(lens, prev[1]),
+                        frames_list + prev[2])
                 else:
                     self._holdback[wire.wire_id] = (wire, lens,
                                                     frames_list)
@@ -669,13 +753,19 @@ class WireDataPlane:
         # on its first decide pass (holdback frames are predecided and
         # skip counting; frames requeued before deciding count when
         # they finally decide).
+        t_decide0 = time.perf_counter()
         ft = self._flowtable
         if ft is not None:
-            flat_frames: list[bytes] = []
+            ptr_parts: list[np.ndarray] = []
             lens_parts: list[np.ndarray] = []
             elig_parts: list[np.ndarray] = []
             shp_parts: list[np.ndarray] = []
             cnt_parts: list[np.ndarray] = []
+            def ptr_run(run: list[bytes]) -> None:
+                # shared marshal (lifetime contract documented there);
+                # the run's frames stay referenced via `batches`
+                ptr_parts.append(native.frame_ptrs_u64(run))
+
             for _w, row, lens, fr, predecided in batches:
                 target = rowinfo.get(row)
                 ok = False
@@ -685,8 +775,21 @@ class WireDataPlane:
                     # already took their verdict when first drained
                     peer_wire = self.daemon.wires.get_by_key(*target)
                     ok = peer_wire is not None and not peer_wire.peer_ip
-                m = len(fr)
-                flat_frames.extend(fr)
+                m = len(lens)
+                # frame pointers: FrameSeg windows are base+offset
+                # vector adds (no per-frame Python objects); runs of
+                # plain bytes marshal through one c_char_p array
+                run: list[bytes] = []
+                for p in fr:
+                    if type(p) is FrameSeg:
+                        if run:
+                            ptr_run(run)
+                            run = []
+                        ptr_parts.append(p.ptrs())
+                    else:
+                        run.append(p)
+                if run:
+                    ptr_run(run)
                 lens_parts.append(np.asarray(lens, np.uint64))
                 elig_parts.append(
                     np.full(m, 1 if ok else 0, np.uint8))
@@ -694,28 +797,32 @@ class WireDataPlane:
                     np.full(m, 1 if row in shaped_rows else 0, np.uint8))
                 cnt_parts.append(
                     np.full(m, 0 if predecided else 1, np.uint8))
-            decide, class_stats = ft.decide_classify_batch(
-                flat_frames,
+            decide, class_stats = ft.decide_classify_ptrs(
+                np.concatenate(ptr_parts),
+                np.concatenate(lens_parts),
                 np.concatenate(elig_parts),
                 np.concatenate(shp_parts),
-                np.concatenate(cnt_parts),
-                lens=np.concatenate(lens_parts))
+                np.concatenate(cnt_parts))
             if class_stats:
                 self.daemon.frame_stats.update(class_stats)
             if decide.any():
                 pos = 0
                 kept_batches = []
                 for w, row, lens, fr, pd in batches:
-                    m = len(fr)
+                    m = len(lens)
                     d = decide[pos:pos + m]
                     pos += m
                     if d.any():
-                        by = [f for f, dd in zip(fr, d) if dd]
+                        # rare path: a batch with bypassing frames is
+                        # materialized to split it per frame
+                        ff = flatten_frames(fr)
+                        by = [f for f, dd in zip(ff, d) if dd]
                         self.bypassed += len(by)
                         # latency ≈ 0: delivered in the same tick
                         self.daemon.deliver_egress_bulk(*rowinfo[row], by)
-                        kl = [ln for ln, dd in zip(lens, d) if not dd]
-                        kf = [f for f, dd in zip(fr, d) if not dd]
+                        kl = [int(ln) for ln, dd in zip(lens, d)
+                              if not dd]
+                        kf = [f for f, dd in zip(ff, d) if not dd]
                         if kf:
                             kept_batches.append((w, row, kl, kf, pd))
                     else:
@@ -728,7 +835,8 @@ class WireDataPlane:
             for _w, _row, lens, fr, predecided in batches:
                 if not predecided:
                     self.daemon.frame_stats.update(
-                        self.daemon._classify(fr, lens))
+                        self.daemon._classify(flatten_frames(fr), lens))
+        self.stage_s["decide"] += time.perf_counter() - t_decide0
         if not batches:
             return 0
 
@@ -746,9 +854,10 @@ class WireDataPlane:
         cap = self.seq_slots
         for i in seq_group:
             w, row, lens, fr, pd = batches[i]
-            if len(fr) > cap:
-                self._holdback[w.wire_id] = (w, lens[cap:], fr[cap:])
-                batches[i] = (w, row, lens[:cap], fr[:cap], pd)
+            if len(lens) > cap:
+                fr_head, fr_tail = _split_parts(fr, cap)
+                self._holdback[w.wire_id] = (w, lens[cap:], fr_tail)
+                batches[i] = (w, row, lens[:cap], fr_head, pd)
         if self._holdback:
             # deferred work exists: the runner must tick again promptly
             # rather than sleep out the period
@@ -787,20 +896,21 @@ class WireDataPlane:
             # padded [R, K] batch arrays; row_idx pads with E (gathers
             # clamp harmlessly, write-back scatters drop)
             R = len(group)
-            K = max(len(batches[i][3]) for i in group)
+            K = max(len(batches[i][2]) for i in group)
             Rp, Kp = pad_rows(R), pad_slots(K)
             row_idx = np.full(Rp, E, np.int32)
             sizes = np.zeros((Rp, Kp), np.float32)
             valid = np.zeros((Rp, Kp), bool)
             for r, i in enumerate(group):
-                _w, row, lens, fr, _pd = batches[i]
-                m = len(fr)
+                _w, row, lens, _fr, _pd = batches[i]
+                m = len(lens)
                 row_idx[r] = row
                 sizes[r, :m] = lens
                 valid[r, :m] = True
             return row_idx, sizes, valid
 
         self._key, sub = jax.random.split(self._key)
+        t_kernel0 = time.perf_counter()
         state_after = state
         group_results = []  # (group, res ShapeResult np, sizes, valid, row_idx)
         if seq_group:
@@ -820,6 +930,8 @@ class WireDataPlane:
             group_results.append((ind_group, jax.tree.map(np.asarray, res),
                                   sizes, valid, row_idx))
 
+        self.stage_s["kernel"] += time.perf_counter() - t_kernel0
+        t_sched0 = time.perf_counter()
         # -- write back dynamic columns under the lock ----------------
         with engine._lock:
             cur = engine._state
@@ -862,19 +974,25 @@ class WireDataPlane:
             deliv = res.delivered
             depart = res.depart_us
             for r, i in enumerate(group):
-                _w, row, _lens, fr, _pd = batches[i]
+                _w, row, lens_i, fr, _pd = batches[i]
                 target = rowinfo.get(row)
-                m = len(fr)
+                m = len(lens_i)
                 drow = deliv[r, :m]
                 nd = int(drow.sum())
                 shaped += nd
                 self.dropped += m - nd
                 if nd == 0 or target is None:
                     continue
+                has_segs = any(type(p) is FrameSeg for p in fr)
                 if nd == m:
-                    sel_frames = fr
+                    # whole batch survives: a segment batch defers
+                    # materialization to release/export (frames stay in
+                    # their transport blob until delivery needs them)
+                    sel_frames = _LazyFrames(fr) if has_segs else fr
                     sel_dep = depart[r, :m]
                 else:
+                    if has_segs:
+                        fr = flatten_frames(fr)
                     idxs = np.nonzero(drow)[0]
                     sel_frames = [fr[j] for j in idxs.tolist()]
                     sel_dep = depart[r, idxs]
@@ -883,11 +1001,14 @@ class WireDataPlane:
                     dls = base_us + sel_dep.astype(np.float64)
                     # ONE pending entry for the whole batch; deadlines
                     # mirrored host-side so frames stay exportable
-                    # (checkpointing). sel_frames must be a private
-                    # list: release None's slots out in place.
+                    # (checkpointing). The frames slot must be private
+                    # (a list copy or a _LazyFrames): release None's
+                    # slots out in place after materialization.
                     self._bseq += 1
-                    pending[self._bseq] = [pk, uid, list(sel_frames),
-                                           dls, nd]
+                    pending[self._bseq] = [
+                        pk, uid,
+                        sel_frames if type(sel_frames) is _LazyFrames
+                        else list(sel_frames), dls, nd]
                     deadline_parts.append(dls)
                     token_parts.append(
                         (np.uint64(self._bseq << _TOK_BITS)
@@ -898,6 +1019,8 @@ class WireDataPlane:
                     toks = range(s0 + 1, s0 + nd + 1)
                     rel = (now_s
                            + sel_dep.astype(np.float64) / 1e6).tolist()
+                    if type(sel_frames) is _LazyFrames:
+                        sel_frames = sel_frames.materialize()
                     for t_rel, tok, f in zip(rel, toks, sel_frames):
                         heapq.heappush(self._heap,
                                        (t_rel, tok, pk, uid, f))
@@ -906,6 +1029,7 @@ class WireDataPlane:
         if deadline_parts:
             self._wheel.schedule_batch(np.concatenate(deadline_parts),
                                        np.concatenate(token_parts))
+        self.stage_s["schedule"] += time.perf_counter() - t_sched0
         return shaped
 
     def _accumulate_rows(self, row_idx, res, sizes, valid) -> None:
@@ -980,16 +1104,23 @@ class WireDataPlane:
                     entry = pending[int(bids[a])]
                     cur_list = setd((entry[0], entry[1]), [])
                     frames_l = entry[2]
+                    lazy = type(frames_l) is _LazyFrames
                     n = b - a
-                    if n == entry[4] == len(frames_l) and \
+                    if n == entry[4] \
+                            and (lazy or n == len(frames_l)) and \
                             int(idxs[a]) == 0 and int(idxs[b - 1]) == n - 1 \
                             and (n <= 2 or bool(
                                 (np.diff(idxs[a:b].astype(np.int64))
                                  == 1).all())):
-                        # full batch, token order == index order
-                        cur_list.extend(frames_l)
+                        # full batch, token order == index order (a lazy
+                        # entry can only be whole: any earlier partial
+                        # release would have materialized it)
+                        cur_list.extend(frames_l.materialize() if lazy
+                                        else frames_l)
                         del pending[int(bids[a])]
                         continue
+                    if lazy:
+                        frames_l = entry[2] = frames_l.materialize()
                     for i in idxs[a:b].tolist():
                         cur_list.append(frames_l[i])
                         frames_l[i] = None

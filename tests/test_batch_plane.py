@@ -586,3 +586,141 @@ def test_live_plane_soak_smoke():
     assert len(r["windows_frames_per_s"]) >= 2
     assert r["sustained_frames_per_s"] > 0
     assert all(w > 0 for w in r["windows_frames_per_s"])
+
+
+# -- zero-copy segment ingress (round 5) --------------------------------
+#
+# Bulk-transport frames stay FrameSeg windows over the raw PacketBatch
+# blob from gRPC ingress through the native decide call; bytes objects
+# appear only at delivery. These tests pin the invariants the
+# representation must preserve: frame-exact len() semantics, FIFO across
+# mixed entries, seq-cap splitting by window index, exactly-once
+# classification, and checkpoint export of still-lazy in-flight batches.
+
+
+def _seg_for(wire_id: int, frames: list[bytes]):
+    """Serialize frames into a PacketBatch blob and ingest it through
+    the daemon's raw-bytes bulk path, as the gRPC server does."""
+    from kubedtn_tpu.wire import proto as pb
+
+    return pb.PacketBatch(packets=[
+        pb.Packet(remot_intf_id=wire_id, frame=f) for f in frames
+    ]).SerializeToString()
+
+
+def test_segment_ingest_len_and_fifo_with_mixed_entries():
+    """len(wire.ingress) counts FRAMES whatever the representation, and
+    a drain interleaving direct bytes appends with segment entries
+    preserves arrival order end to end."""
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire.server import FrameSeg
+
+    daemon, engine, win, wout = _daemon_with_pairs(pairs=1)
+    plane = WireDataPlane(daemon, dt_us=2_000.0)
+    wa, wb = win[0], wout[0]
+
+    first = [bytes([i]) * 60 for i in range(5)]
+    mid = [bytes([0x10 + i]) * 60 for i in range(7)]
+    last = [bytes([0x20 + i]) * 60 for i in range(3)]
+    for f in first:
+        wa.ingress.append(f)
+    for _wid, group in daemon._bulk_groups(_seg_for(wa.wire_id, mid),
+                                           want_segs=True):
+        assert type(group) is FrameSeg and len(group) == 7
+        wa.ingress.append(group)
+    for f in last:
+        wa.ingress.append(f)
+    assert len(wa.ingress) == 15  # frames, not entries
+    assert wa.ingress.entries() == 9
+
+    t = 10.0
+    plane.tick(now_s=t)
+    for _ in range(5):
+        t += 0.002
+        plane.tick(now_s=t)
+    assert len(wa.ingress) == 0
+    got = list(wb.egress)
+    assert got == first + mid + last  # FIFO across representations
+
+
+def test_segment_seq_cap_splits_window_exactly_once():
+    """A segment bigger than seq_slots on a TBF row splits by window
+    index: the head shapes this tick, the residue holds back (never
+    re-queued to ingress), every frame classifies exactly once, and all
+    frames deliver in order."""
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon, FrameSeg
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=8)
+    store.create(Topology(name="a", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="b", uid=1,
+             properties=LinkProperties(rate="1Gbit"))])))
+    store.create(Topology(name="b", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="a", uid=1,
+             properties=LinkProperties(rate="1Gbit"))])))
+    engine.setup_pod("a")
+    engine.setup_pod("b")
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=1_000.0)
+    plane.seq_slots = 16
+    wa = daemon._add_wire(pb.WireDef(local_pod_name="a",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    wb = daemon._add_wire(pb.WireDef(local_pod_name="b",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    frames = [bytes([i]) * 60 for i in range(50)]
+    for _wid, group in daemon._bulk_groups(_seg_for(wa.wire_id, frames),
+                                           want_segs=True):
+        wa.ingress.append(group)
+    assert wa.ingress.entries() == 1 and len(wa.ingress) == 50
+
+    shaped = plane.tick(now_s=4.0)
+    assert shaped == 16                      # capped at seq_slots
+    assert len(wa.ingress) == 0              # drain took the whole seg
+    hb = plane._holdback[wa.wire_id]
+    assert len(hb[1]) == 34                  # residue lens
+    assert type(hb[2][0]) is FrameSeg        # residue stays zero-copy
+    assert bytes(hb[2][0].materialize()[0]) == frames[16]
+    if daemon.frame_stats:
+        assert sum(daemon.frame_stats.values()) == 50  # exactly once
+    total = shaped
+    for k in range(1, 8):
+        total += plane.tick(now_s=4.0 + 0.001 * k)
+    assert total == 50
+    assert not plane._holdback
+    # 1Gbit on 60B frames: everything delivers, in order
+    for _ in range(30):
+        plane.tick(now_s=4.1)
+    assert list(wb.egress) == frames
+
+
+def test_segment_pending_exports_in_flight_frames():
+    """export_pending sees frames still held lazily in their transport
+    blob: the checkpoint path materializes them without disturbing the
+    release accounting."""
+    from kubedtn_tpu.runtime import WireDataPlane, _LazyFrames
+
+    daemon, engine, win, wout = _daemon_with_pairs(pairs=1,
+                                                   latency="50ms")
+    plane = WireDataPlane(daemon, dt_us=2_000.0)
+    wa, wb = win[0], wout[0]
+    frames = [bytes([i]) * 80 for i in range(20)]
+    for _wid, group in daemon._bulk_groups(_seg_for(wa.wire_id, frames),
+                                           want_segs=True):
+        wa.ingress.append(group)
+    plane.tick(now_s=7.0)
+    assert any(type(e[2]) is _LazyFrames
+               for e in plane._pending.values())
+    pend = plane.export_pending()
+    assert sorted(f for _pk, _uid, f, _rem in pend) == sorted(frames)
+    assert all(rem > 0 for *_x, rem in pend)  # still in flight
+    # export materialized in place; release still delivers exactly once
+    t = 7.0
+    while len(wb.egress) < 20 and t < 8.0:
+        t += 0.002
+        plane.tick(now_s=t)
+    assert list(wb.egress) == frames
