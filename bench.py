@@ -518,7 +518,8 @@ def main() -> None:
             k: r[k] for k in ("seconds", "sustained_frames_per_s",
                               "worst_window_frames_per_s", "flatness",
                               "windows_frames_per_s",
-                              "end_ingress_backlog", "dropped",
+                              "end_ingress_backlog", "gc_pause_s",
+                              "host_steal_s", "dropped",
                               "tick_errors")
         }
 

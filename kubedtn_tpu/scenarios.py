@@ -864,6 +864,30 @@ def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
                 c += 1
         return c
 
+    # Attribute window dips: cumulative GC pause inside this process
+    # and CPU steal from the hypervisor (a shared 1-core host can
+    # simply lose the core for a while — that is the machine, not
+    # the plane). Both are recorded per run so a bad window is
+    # diagnosable from the bench JSON alone.
+    import gc as _gc
+
+    gc_s = [0.0]
+    _t0 = [0.0]
+
+    def _gc_cb(phase, info):  # noqa: ARG001
+        if phase == "start":
+            _t0[0] = time.perf_counter()
+        else:
+            gc_s[0] += time.perf_counter() - _t0[0]
+
+    def _steal() -> float:
+        try:
+            with open("/proc/stat") as f:
+                parts = f.readline().split()
+            return float(parts[8]) / os.sysconf("SC_CLK_TCK")
+        except (OSError, IndexError, ValueError):
+            return 0.0
+
     try:
         # window 0 opens at the FIRST delivery so injector startup
         # (~1-2s of interpreter+grpc) never counts against the plane.
@@ -882,6 +906,8 @@ def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
                 raise RuntimeError(
                     "soak saw no delivery within 60s (injector alive)")
             time.sleep(0.01)
+        _gc.callbacks.append(_gc_cb)
+        steal0 = _steal()
         windows: list[float] = []
         t_end = time.monotonic() + seconds
         while time.monotonic() < t_end:
@@ -893,11 +919,19 @@ def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
             time.sleep(window_s)
             got = drain_count()
             windows.append(got / (time.monotonic() - w0))
+        steal_s = _steal() - steal0
         # unbounded ingress means a too-fast injector shows up as
         # BACKLOG, not as a rate dip — record it so "flat" can't hide
         # buildup the delivered-rate windows never see
         backlog = sum(len(w.ingress) for w in wires_in)
     finally:
+        # the callback is process-global: an exception mid-soak (dead
+        # injector) must not leave it running for the rest of the
+        # process — bench.py retries scenarios in-process
+        try:
+            _gc.callbacks.remove(_gc_cb)
+        except ValueError:
+            pass
         proc.kill()
         try:
             proc.wait(timeout=5)
@@ -917,6 +951,8 @@ def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
         "worst_window_frames_per_s": round(rates[0], 1) if rates else 0.0,
         "flatness": round(rates[0] / med, 3) if med else 0.0,
         "end_ingress_backlog": int(backlog),
+        "gc_pause_s": round(gc_s[0], 3),
+        "host_steal_s": round(steal_s, 2),
         "dropped": plane.dropped,
         "tick_errors": plane.tick_errors,
         "wall_s": round(time.perf_counter() - t0, 3),
