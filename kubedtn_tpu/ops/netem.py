@@ -386,8 +386,17 @@ def slot_independent_rows(props):
     plane's fast path. Works on numpy or jax arrays."""
     import kubedtn_tpu.ops.edge_state as es
 
-    return ((props[:, es.P_RATE_BPS] == 0)
-            & (props[:, es.P_LATENCY_CORR] == 0)
+    return (props[:, es.P_RATE_BPS] == 0) & _iid_random_rows(props)
+
+
+def _iid_random_rows(props):
+    """Rows whose netem randomness is iid across a batch: every AR(1)
+    correlation is zero and reorder (the gap counter's only consumer)
+    is off. Shared predicate of slot_independent_rows (AND rate == 0)
+    and tbf_batch_rows (AND rate > 0)."""
+    import kubedtn_tpu.ops.edge_state as es
+
+    return ((props[:, es.P_LATENCY_CORR] == 0)
             & (props[:, es.P_LOSS_CORR] == 0)
             & (props[:, es.P_DUPLICATE_CORR] == 0)
             & (props[:, es.P_CORRUPT_CORR] == 0)
@@ -450,6 +459,162 @@ def shape_slots_indep_nodonate(state: EdgeState, row_idx: jax.Array,
 
         _shape_slots_ind = jax.jit(_ind)
     return _shape_slots_ind(state, row_idx, sizes, valid, key)
+
+
+def tbf_batch_rows(props):
+    """Rows whose whole drained batch can take the EXACT max-plus TBF
+    kernel (shape_slots_tbf_nodonate): a real rate limit but no OTHER
+    cross-slot state — zero AR(1) correlations and no reorder (the gap
+    counter's only consumer). Disjoint from slot_independent_rows
+    (which requires rate == 0); the remaining complement (correlations
+    or reorder present) keeps the sequential scan. Works on numpy or
+    jax arrays."""
+    import kubedtn_tpu.ops.edge_state as es
+
+    return (props[:, es.P_RATE_BPS] > 0) & _iid_random_rows(props)
+
+
+_shape_slots_tbf = None
+
+# -inf surrogate for the (max, +) semiring: true -inf would produce
+# inf - inf = nan under the affine adds; -1e30 absorbs every real
+# operand (|values| < 1e10) and stays finite in f32
+_MP_NEG = -1e30
+
+
+def shape_slots_tbf_nodonate(state: EdgeState, row_idx: jax.Array,
+                             sizes: jax.Array, valid: jax.Array,
+                             key: jax.Array):
+    """Shape K slots on R gathered TBF rows in ONE dispatch with an
+    EXACT token bucket — no sequential scan, no per-tick slot cap.
+
+    The classic network-calculus credit transform makes tbf_packet's
+    recurrence LINEAR in the (max, +) semiring: with
+    V = t_depart - tokens/rate (the instant the bucket would have been
+    empty, extrapolating backwards at the fill rate) and per-packet
+    service time q = size/rate, burst credit b = burst/rate (both µs),
+
+        start_i  = max(t_ready_i, depart_{i-1})
+        V_i      = max(start_i - b, V_{i-1}) + q_i
+        depart_i = max(start_i, V_i)
+
+    collapses to an affine max-plus map x_i = A_i x_{i-1} ⊕ c_i on
+    x = (depart, V) with
+
+        A_i = [[max(0, q_i-b), q_i], [q_i-b, q_i]]
+        c_i = [t_ready_i + max(0, q_i-b), t_ready_i + q_i - b]
+
+    (both clamps — the burst ceiling via `start - b` and the
+    non-negative token floor via depart >= start — are absorbed by the
+    max's). Affine max-plus maps compose associatively, so the whole
+    batch runs as ONE jax.lax.associative_scan of 2x2 map compositions
+    — O(log K) depth on device. Slots that never reach the bucket
+    (netem loss, padding, inactive rows) carry the identity map.
+
+    The ONE thing the affine form cannot express is the 50ms
+    queue-limit drop (tc's `latency` on the TBF child,
+    reference common/qdisc.go:115-123): a dropped packet consumes no
+    tokens, which breaks linearity. Rows where the no-drop run flags
+    any queue drop are reported in `fallback` and must be re-shaped by
+    the sequential scan — exact always, fast in the provisioned case.
+    (The no-drop run overestimates every depart, and agrees with the
+    true sequence exactly up to the first true drop, so a true drop is
+    always flagged; false positives only cost the fallback.)
+
+    Returns (res ShapeResult[R, K], tok_row f32[R], dep_row f32[R],
+    delta_count i32[R], has_accept bool[R], fallback bool[R]) — the
+    caller writes tokens=tok_row, t_last=backlog_until=dep_row and
+    pkt_count += delta_count for rows with has_accept & ~fallback, and
+    reroutes fallback rows to shape_slots_nodonate.
+    """
+    global _shape_slots_tbf
+    if _shape_slots_tbf is None:
+        def _tbf(state, row_idx, sizes, valid, key):
+            R, K = sizes.shape
+            # drawn [K, R, NU] then transposed: the SAME stream
+            # shape_slots_nodonate draws for a given (key, R, K), which
+            # is what the parity tests compare against. (The runtime's
+            # fallback re-shape uses a different key and packing — the
+            # detection run's netem outcomes are discarded, not reused.)
+            u = jnp.moveaxis(
+                jax.random.uniform(key, (K, R, NU), dtype=jnp.float32),
+                0, 1)
+            props = state.props[row_idx]
+            active = state.active[row_idx]
+            # netem stage, elementwise over [R, K]: every AR(1) rho is
+            # zero in this class, so corr state passes through and slots
+            # draw iid — same independence the indep kernel relies on
+            over_slots = jax.vmap(netem_packet,
+                                  in_axes=(None, None, None, 0))
+            over_rows = jax.vmap(over_slots, in_axes=(0, 0, 0, 0))
+            (delay, loss, dup, corrupt, reorder, _corr, _cnt) = over_rows(
+                props, state.corr[row_idx], state.pkt_count[row_idx], u)
+            act = valid & active[:, None]
+            live = act & ~loss           # slots that reach the bucket
+            t_ready = delay              # t_arrival == 0 (tick epoch)
+
+            rate = props[:, P_RATE_BPS]
+            r_us = (rate / 8e6)[:, None]             # bytes per µs
+            q = sizes / r_us                         # service time, µs
+            b = (burst_bytes(rate)[:, None] / r_us)  # burst credit, µs
+            neg = jnp.float32(_MP_NEG)
+            qb = q - b
+            qb0 = jnp.maximum(qb, 0.0)
+            a11 = jnp.where(live, qb0, 0.0)
+            a12 = jnp.where(live, q, neg)
+            a21 = jnp.where(live, qb, neg)
+            a22 = jnp.where(live, q, 0.0)
+            c1 = jnp.where(live, t_ready + qb0, neg)
+            c2 = jnp.where(live, t_ready + qb, neg)
+
+            def combine(x, y):
+                # y ∘ x (x applied first: scan runs slot 0 → K-1)
+                xa11, xa12, xa21, xa22, xc1, xc2 = x
+                ya11, ya12, ya21, ya22, yc1, yc2 = y
+                return (
+                    jnp.maximum(ya11 + xa11, ya12 + xa21),
+                    jnp.maximum(ya11 + xa12, ya12 + xa22),
+                    jnp.maximum(ya21 + xa11, ya22 + xa21),
+                    jnp.maximum(ya21 + xa12, ya22 + xa22),
+                    jnp.maximum(jnp.maximum(ya11 + xc1, ya12 + xc2),
+                                yc1),
+                    jnp.maximum(jnp.maximum(ya21 + xc1, ya22 + xc2),
+                                yc2),
+                )
+
+            pa11, pa12, pa21, pa22, pc1, pc2 = jax.lax.associative_scan(
+                combine, (a11, a12, a21, a22, c1, c2), axis=1)
+            x1_0 = state.backlog_until[row_idx][:, None]   # next_free
+            x2_0 = (state.t_last[row_idx]
+                    - state.tokens[row_idx]
+                    / (rate / 8e6))[:, None]               # V_0
+            dep = jnp.maximum(jnp.maximum(pa11 + x1_0, pa12 + x2_0),
+                              pc1)                         # [R, K]
+            v = jnp.maximum(jnp.maximum(pa21 + x1_0, pa22 + x2_0),
+                            pc2)
+
+            drop_q = live & (dep - t_ready > TBF_QUEUE_LATENCY_US)
+            fallback = drop_q.any(axis=1)
+            delivered = live & ~drop_q
+            inf = jnp.float32(jnp.inf)
+            res = ShapeResult(
+                depart_us=jnp.where(delivered, dep, inf),
+                delivered=delivered,
+                dropped_loss=loss & act,
+                dropped_queue=drop_q,
+                corrupted=corrupt & delivered,
+                duplicated=dup & delivered,
+                reordered=reorder & delivered,
+            )
+            dep_row = dep[:, -1]
+            tok_row = jnp.clip((dep_row - v[:, -1]) * (rate / 8e6),
+                               0.0, burst_bytes(rate))
+            delta = live.sum(axis=1).astype(state.pkt_count.dtype)
+            has_accept = live.any(axis=1)
+            return (res, tok_row, dep_row, delta, has_accept, fallback)
+
+        _shape_slots_tbf = jax.jit(_tbf)
+    return _shape_slots_tbf(state, row_idx, sizes, valid, key)
 
 
 _shape_slots_nd = None

@@ -23,12 +23,16 @@ O(frames), and device work is at most two dispatches:
   is disabled forever (redir_disable semantics, reference
   bpf/lib/redir_disable.c:44-48; the guard attaches wherever qdiscs
   exist, common/qdisc.go:285-287).
-- **Two-kernel shaping split**: rows whose packet decisions share no
+- **Three-kernel shaping split**: rows whose packet decisions share no
   cross-slot state — no TBF, no AR(1) correlations, no reorder
   (netem.slot_independent_rows) — shape ALL their drained frames in one
   elementwise kernel over [busy rows × slots]
-  (netem.shape_slots_indep_nodonate); rows with sequential state keep
-  exact kernel semantics via a gathered lax.scan
+  (netem.shape_slots_indep_nodonate). Rate-limited rows WITHOUT other
+  cross-slot state (netem.tbf_batch_rows) also shape whole batches in
+  one dispatch: the token bucket is max-plus linear, so the exact TBF
+  runs as an associative scan (netem.shape_slots_tbf_nodonate); a
+  batch that trips the 50ms TBF queue drop falls back to the scan
+  path. Only rows with correlations/reorder keep the gathered lax.scan
   (netem.shape_slots_nodonate), capped at `seq_slots` per tick; the
   residue waits in the plane's holdback buffer and shapes first next
   tick (each frame classifies and takes its bypass verdict exactly
@@ -342,9 +346,11 @@ class WireDataPlane:
         self.dt_us = dt_us
         # per-wire drain budget per tick. Slot-independent rows (no TBF,
         # no correlations, no reorder — netem.slot_independent_rows)
-        # shape all of it in one elementwise kernel; rows with cross-slot
-        # state are capped at seq_slots per tick (the lax.scan length)
-        # and keep the residue queued in order. The budget only BINDS
+        # and plain rate-limited rows (netem.tbf_batch_rows, exact
+        # bucket via max-plus associative scan) shape all of it in one
+        # dispatch; only correlated/reordering rows are capped at
+        # seq_slots per tick (the lax.scan length) and keep the residue
+        # queued in order. The budget only BINDS
         # under saturation (light-load drains take whatever is queued),
         # where bigger batches amortize per-tick fixed costs — queueing
         # delay dominates delivery precision there anyway.
@@ -845,27 +851,24 @@ class WireDataPlane:
                               count=len(batches))
         props_rows = np.asarray(state.props[jnp.asarray(rows_np)])
         indep = np.asarray(netem.slot_independent_rows(props_rows), bool)
-        seq_group = [i for i in range(len(batches)) if not indep[i]]
+        tbfb = np.asarray(netem.tbf_batch_rows(props_rows), bool)
+        # Predecided (holdback-residue) TBF batches go STRAIGHT to the
+        # scan: a TBF row only ever has holdback because its batch
+        # already tripped the 50ms-drop fallback, so re-dispatching the
+        # max-plus kernel each residue tick would be a full-batch
+        # dispatch whose result is discarded ~every time. Fresh traffic
+        # (holdback drained) tries the fast path again.
+        seq_group = [i for i in range(len(batches))
+                     if not indep[i] and (not tbfb[i] or batches[i][4])]
+        tbf_group = [i for i in range(len(batches))
+                     if tbfb[i] and not batches[i][4]]
         ind_group = [i for i in range(len(batches)) if indep[i]]
-        # sequential rows bound the scan length: the residue waits in
-        # the plane's holdback buffer (classified/decided exactly once)
-        # and shapes first next tick; its wire is excluded from the next
-        # drain so the buffer never exceeds one drain's worth
-        cap = self.seq_slots
-        for i in seq_group:
-            w, row, lens, fr, pd = batches[i]
-            if len(lens) > cap:
-                fr_head, fr_tail = _split_parts(fr, cap)
-                self._holdback[w.wire_id] = (w, lens[cap:], fr_tail)
-                batches[i] = (w, row, lens[:cap], fr_head, pd)
-        if self._holdback:
-            # deferred work exists: the runner must tick again promptly
-            # rather than sleep out the period
-            self._wake.set()
 
         # -- advance the persistent shaping clocks ---------------------
         # by the wall time since the last shaped batch (the role
-        # sim.py's per-step roll_epoch plays in virtual-time mode)
+        # sim.py's per-step roll_epoch plays in virtual-time mode).
+        # Runs BEFORE the TBF max-plus kernel: its bucket math reads the
+        # rolled clocks like every other kernel.
         if self._last_shaped_s is not None:
             elapsed_us = max(0.0, (now_s - self._last_shaped_s) * 1e6)
             if elapsed_us > 0.0:
@@ -913,6 +916,68 @@ class WireDataPlane:
         t_kernel0 = time.perf_counter()
         state_after = state
         group_results = []  # (group, res ShapeResult np, sizes, valid, row_idx)
+        if tbf_group:
+            # rate-limited rows WITHOUT other cross-slot state: exact
+            # token bucket over the whole batch via the max-plus
+            # associative scan — no seq_slots cap. Rows whose batch
+            # hits the 50ms TBF queue drop fall back to the sequential
+            # scan below (the affine form can't skip a dropped
+            # packet's token charge); their results here are discarded.
+            row_idx, sizes, valid = build(tbf_group)
+            tkey = jax.random.fold_in(sub, 2)
+            res, tok_row, dep_row, delta, hacc, fbk = \
+                netem.shape_slots_tbf_nodonate(
+                    state_after, jnp.asarray(row_idx),
+                    jnp.asarray(sizes), jnp.asarray(valid), tkey)
+            fbk_np = np.asarray(fbk)[:len(tbf_group)]
+            keep_r = [r for r in range(len(tbf_group)) if not fbk_np[r]]
+            if len(keep_r) < len(tbf_group):
+                seq_group = seq_group + [tbf_group[r]
+                                         for r in range(len(tbf_group))
+                                         if fbk_np[r]]
+                seq_group.sort()
+            if keep_r:
+                kept_rows = row_idx[keep_r]
+                ha = np.asarray(hacc)[keep_r]
+                acc = [kept_rows[j] for j in range(len(keep_r))
+                       if ha[j]]
+                if acc:
+                    accj = jnp.asarray(np.asarray(acc, np.int32))
+                    pick = jnp.asarray(
+                        [keep_r[j] for j in range(len(keep_r))
+                         if ha[j]], jnp.int32)
+                    state_after = dataclasses.replace(
+                        state_after,
+                        tokens=state_after.tokens.at[accj].set(
+                            tok_row[pick], mode="drop"),
+                        t_last=state_after.t_last.at[accj].set(
+                            dep_row[pick], mode="drop"),
+                        backlog_until=state_after.backlog_until
+                        .at[accj].set(dep_row[pick], mode="drop"),
+                        pkt_count=state_after.pkt_count.at[accj].add(
+                            delta[pick], mode="drop"))
+                res_np = jax.tree.map(np.asarray, res)
+                res_sel = jax.tree.map(lambda a: a[keep_r], res_np)
+                group_results.append(
+                    ([tbf_group[r] for r in keep_r], res_sel,
+                     sizes[keep_r], valid[keep_r], kept_rows))
+
+        # sequential rows bound the scan length: the residue waits in
+        # the plane's holdback buffer (classified/decided exactly once)
+        # and shapes first next tick; its wire is excluded from the next
+        # drain so the buffer never exceeds one drain's worth
+        cap = self.seq_slots
+        for i in seq_group:
+            w, row, lens, fr, pd = batches[i]
+            if len(lens) > cap:
+                fr_head, fr_tail = _split_parts(fr, cap)
+                self._holdback[w.wire_id] = (w, lens[cap:], fr_tail)
+                batches[i] = (w, row, lens[:cap], fr_head, pd)
+        if self._holdback:
+            # deferred work exists: the runner must tick again promptly
+            # rather than sleep out the period
+            self._wake.set()
+
         if seq_group:
             row_idx, sizes, valid = build(seq_group)
             state_after, res = netem.shape_slots_nodonate(

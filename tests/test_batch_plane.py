@@ -383,12 +383,14 @@ def test_seq_slots_cap_holds_residue_in_order():
 
     store = TopologyStore()
     engine = SimEngine(store, capacity=16)
+    seq_props = LinkProperties(rate="10Gbit", duplicate="0",
+                               duplicate_corr="10")  # corr -> scan class
     store.create(Topology(name="a", spec=TopologySpec(links=[
         Link(local_intf="eth1", peer_intf="eth1", peer_pod="b", uid=1,
-             properties=LinkProperties(rate="10Gbit"))])))
+             properties=seq_props)])))
     store.create(Topology(name="b", spec=TopologySpec(links=[
         Link(local_intf="eth1", peer_intf="eth1", peer_pod="a", uid=1,
-             properties=LinkProperties(rate="10Gbit"))])))
+             properties=seq_props)])))
     engine.setup_pod("a")
     engine.setup_pod("b")
     Reconciler(store, engine).drain()
@@ -451,12 +453,14 @@ def test_holdback_requeue_on_vanished_row_preserves_invariant():
 
     store = TopologyStore()
     engine = SimEngine(store, capacity=16)
+    seq_props = LinkProperties(rate="10Gbit", duplicate="0",
+                               duplicate_corr="10")  # corr -> scan class
     link_ab = Link(local_intf="eth1", peer_intf="eth1", peer_pod="b",
-                   uid=1, properties=LinkProperties(rate="10Gbit"))
+                   uid=1, properties=seq_props)
     store.create(Topology(name="a", spec=TopologySpec(links=[link_ab])))
     store.create(Topology(name="b", spec=TopologySpec(links=[
         Link(local_intf="eth1", peer_intf="eth1", peer_pod="a", uid=1,
-             properties=LinkProperties(rate="10Gbit"))])))
+             properties=seq_props)])))
     engine.setup_pod("a")
     engine.setup_pod("b")
     Reconciler(store, engine).drain()
@@ -511,12 +515,14 @@ def test_holdback_requeue_on_deregistered_wire_is_counted():
 
     store = TopologyStore()
     engine = SimEngine(store, capacity=16)
+    seq_props = LinkProperties(rate="10Gbit", duplicate="0",
+                               duplicate_corr="10")  # corr -> scan class
     link_ab = Link(local_intf="eth1", peer_intf="eth1", peer_pod="b",
-                   uid=1, properties=LinkProperties(rate="10Gbit"))
+                   uid=1, properties=seq_props)
     store.create(Topology(name="a", spec=TopologySpec(links=[link_ab])))
     store.create(Topology(name="b", spec=TopologySpec(links=[
         Link(local_intf="eth1", peer_intf="eth1", peer_pod="a", uid=1,
-             properties=LinkProperties(rate="10Gbit"))])))
+             properties=seq_props)])))
     engine.setup_pod("a")
     engine.setup_pod("b")
     Reconciler(store, engine).drain()
@@ -654,12 +660,14 @@ def test_segment_seq_cap_splits_window_exactly_once():
 
     store = TopologyStore()
     engine = SimEngine(store, capacity=8)
+    seq_props = LinkProperties(rate="1Gbit", duplicate="0",
+                               duplicate_corr="10")  # corr -> scan class
     store.create(Topology(name="a", spec=TopologySpec(links=[
         Link(local_intf="eth1", peer_intf="eth1", peer_pod="b", uid=1,
-             properties=LinkProperties(rate="1Gbit"))])))
+             properties=seq_props)])))
     store.create(Topology(name="b", spec=TopologySpec(links=[
         Link(local_intf="eth1", peer_intf="eth1", peer_pod="a", uid=1,
-             properties=LinkProperties(rate="1Gbit"))])))
+             properties=seq_props)])))
     engine.setup_pod("a")
     engine.setup_pod("b")
     Reconciler(store, engine).drain()
@@ -724,3 +732,211 @@ def test_segment_pending_exports_in_flight_frames():
         t += 0.002
         plane.tick(now_s=t)
     assert list(wb.egress) == frames
+
+
+# -- exact max-plus TBF batch kernel (round 5) --------------------------
+#
+# Rate-limited rows without other cross-slot state shape their WHOLE
+# drained batch in one associative-scan dispatch
+# (netem.shape_slots_tbf_nodonate) — the token bucket is max-plus
+# linear in (depart, V = depart - tokens/rate) coordinates. These tests
+# pin exact parity with the sequential scan, the overload fallback (the
+# affine form cannot skip a dropped packet's token charge), and the
+# end-to-end effect: TBF wires escape the seq_slots per-tick ceiling.
+
+
+def _tbf_state(E=16, seed=7):
+    rng = np.random.default_rng(seed)
+    props = np.zeros((E, es.NPROP), np.float32)
+    props[:, es.P_RATE_BPS] = rng.choice([2e7, 1e8, 1e9], E)
+    props[:, es.P_LATENCY_US] = rng.integers(0, 20_000, E)
+    props[:, es.P_JITTER_US] = rng.choice([0, 1000, 3000], E)
+    props[:, es.P_LOSS] = rng.choice([0, 0, 5, 20], E)
+    props[:, es.P_DUPLICATE] = rng.choice([0, 0, 10], E)
+    props[:, es.P_CORRUPT_PROB] = rng.choice([0, 5], E)
+    state = es.init_state(E)
+    return dataclasses.replace(
+        state, active=jnp.ones(E, bool), props=jnp.asarray(props),
+        tokens=jnp.asarray(rng.uniform(0, 5e4, E).astype(np.float32)),
+        t_last=jnp.asarray(rng.uniform(-1e4, 0, E).astype(np.float32)),
+        backlog_until=jnp.asarray(
+            rng.uniform(0, 1e4, E).astype(np.float32)),
+        pkt_count=jnp.asarray(rng.integers(0, 5, E), jnp.int32),
+        corr=jnp.asarray(rng.random((E, es.NCORR)).astype(np.float32)),
+    ), props
+
+
+def test_tbf_batch_rows_classification():
+    _, props = _tbf_state()
+    assert bool(np.asarray(netem.tbf_batch_rows(props)).all())
+    # disjoint from slot-independent (rate > 0 there means NOT indep)
+    assert not np.asarray(netem.slot_independent_rows(props)).any()
+    # any correlation or reorder drops a row out of the class
+    for col in (es.P_LATENCY_CORR, es.P_LOSS_CORR, es.P_DUPLICATE_CORR,
+                es.P_CORRUPT_CORR, es.P_REORDER_CORR, es.P_REORDER_PROB):
+        p = props.copy()
+        p[0, col] = 10.0
+        assert not bool(np.asarray(netem.tbf_batch_rows(p))[0])
+    p = props.copy()
+    p[0, es.P_RATE_BPS] = 0.0
+    assert not bool(np.asarray(netem.tbf_batch_rows(p))[0])
+
+
+@pytest.mark.parametrize("seed,K", [(7, 64), (11, 128), (13, 37)])
+def test_tbf_maxplus_matches_sequential_scan(seed, K):
+    """No-drop rows: the max-plus kernel and the lax.scan produce the
+    SAME flags (exact) and departs/state (f32-close) from the same PRNG
+    stream."""
+    state, _props = _tbf_state(seed=seed)
+    rng = np.random.default_rng(seed + 1)
+    R = 8
+    row_idx = jnp.asarray(rng.choice(16, R, replace=False), jnp.int32)
+    sizes = jnp.asarray(rng.uniform(60, 1500, (R, K)), jnp.float32)
+    valid = jnp.asarray(rng.random((R, K)) < 0.95)
+    key = jax.random.PRNGKey(seed)
+    res_t, tok, dep, delta, hacc, fb = netem.shape_slots_tbf_nodonate(
+        state, row_idx, sizes, valid, key)
+    st2, res_s = netem.shape_slots_nodonate(state, row_idx, sizes,
+                                            valid, key)
+    ok = ~np.asarray(fb)
+    assert ok.any()  # provisioned rows exist at these rates/sizes
+    for f in dataclasses.fields(netem.ShapeResult):
+        a = np.asarray(getattr(res_t, f.name))[ok]
+        b = np.asarray(getattr(res_s, f.name))[ok]
+        if a.dtype == bool:
+            np.testing.assert_array_equal(a, b, err_msg=f.name)
+        else:
+            m = np.isfinite(b)
+            assert (np.isfinite(a) == m).all(), f.name
+            np.testing.assert_allclose(a[m], b[m], rtol=1e-4, atol=0.5,
+                                       err_msg=f.name)
+    ri = np.asarray(row_idx)[ok]
+    np.testing.assert_allclose(np.asarray(tok)[ok],
+                               np.asarray(st2.tokens)[ri],
+                               rtol=1e-4, atol=1.0)
+    np.testing.assert_allclose(np.asarray(dep)[ok],
+                               np.asarray(st2.t_last)[ri],
+                               rtol=1e-4, atol=0.5)
+    np.testing.assert_allclose(np.asarray(dep)[ok],
+                               np.asarray(st2.backlog_until)[ri],
+                               rtol=1e-4, atol=0.5)
+    want = np.asarray(state.pkt_count)[ri] + np.asarray(delta)[ok]
+    np.testing.assert_array_equal(want, np.asarray(st2.pkt_count)[ri])
+
+
+def test_tbf_maxplus_flags_overloaded_rows_for_fallback():
+    """Any 50ms-queue drop in the batch marks the row fallback; the
+    sequential scan confirms those rows really drop."""
+    E = 4
+    props = np.zeros((E, es.NPROP), np.float32)
+    props[:, es.P_RATE_BPS] = [1e6, 1e6, 1e9, 1e9]
+    state = es.init_state(E)
+    state = dataclasses.replace(state, active=jnp.ones(E, bool),
+                                props=jnp.asarray(props))
+    row_idx = jnp.arange(4, dtype=jnp.int32)
+    sizes = jnp.full((4, 64), 1500.0, jnp.float32)
+    valid = jnp.ones((4, 64), bool)
+    key = jax.random.PRNGKey(0)
+    *_x, fb = netem.shape_slots_tbf_nodonate(state, row_idx, sizes,
+                                             valid, key)
+    _st, res_s = netem.shape_slots_nodonate(state, row_idx, sizes,
+                                            valid, key)
+    scan_drops = np.asarray(res_s.dropped_queue).any(axis=1)
+    np.testing.assert_array_equal(np.asarray(fb), scan_drops)
+    assert np.asarray(fb)[:2].all() and not np.asarray(fb)[2:].any()
+
+
+def test_tbf_wire_shapes_whole_batch_in_one_tick():
+    """End to end: a rate-limited wire (no correlations) shapes frames
+    far beyond seq_slots in ONE tick — the ceiling the round-4 verdict
+    documented for ALL shaped wires now applies only to
+    correlated/reordering rows — and delivery order and TBF spacing
+    hold."""
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=8)
+    props = LinkProperties(rate="1Gbit")
+    store.create(Topology(name="a", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="b", uid=1,
+             properties=props)])))
+    store.create(Topology(name="b", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="a", uid=1,
+             properties=props)])))
+    engine.setup_pod("a")
+    engine.setup_pod("b")
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=1_000.0)
+    plane.seq_slots = 16
+    wa = daemon._add_wire(pb.WireDef(local_pod_name="a",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    wb = daemon._add_wire(pb.WireDef(local_pod_name="b",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    frames = [bytes([i % 251]) * 1000 for i in range(200)]
+    wa.ingress.extend(frames)
+    shaped = plane.tick(now_s=5.0)
+    assert shaped == 200           # whole batch, one tick, NO seq cap
+    assert not plane._holdback
+    # 1Gbit on 1000B frames: 8µs spacing after the burst; everything
+    # delivers within a couple of ms of virtual time, in order
+    t = 5.0
+    for k in range(1, 6):
+        t += 0.002
+        plane.tick(now_s=t)
+    assert list(wb.egress) == frames
+    assert plane.dropped == 0
+
+
+def test_tbf_wire_overload_falls_back_to_exact_scan():
+    """An overloaded TBF wire (queue drops) reroutes through the
+    sequential scan mid-tick: seq_slots caps apply, drops are counted,
+    and the frames that DO deliver arrive in order."""
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=8)
+    props = LinkProperties(rate="1Mbit")   # 12ms per 1500B frame
+    store.create(Topology(name="a", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="b", uid=1,
+             properties=props)])))
+    store.create(Topology(name="b", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="a", uid=1,
+             properties=props)])))
+    engine.setup_pod("a")
+    engine.setup_pod("b")
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=1_000.0)
+    plane.seq_slots = 16
+    wa = daemon._add_wire(pb.WireDef(local_pod_name="a",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    wb = daemon._add_wire(pb.WireDef(local_pod_name="b",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    frames = [bytes([i % 251]) * 1500 for i in range(50)]
+    wa.ingress.extend(frames)
+    shaped = plane.tick(now_s=3.0)
+    # fallback engaged: the scan saw only the first seq_slots frames
+    # (shaped counts DELIVERED frames — queue drops take the rest of
+    # the window), and the residue beyond the cap is held back
+    assert 0 < shaped < 16
+    assert wa.wire_id in plane._holdback
+    assert len(plane._holdback[wa.wire_id][1]) == 34
+    t = 3.0
+    for k in range(60):
+        t += 0.001
+        plane.tick(now_s=t)
+    assert not plane._holdback
+    # 50ms TBF queue limit at 12ms/frame: ~4-6 accepted, rest dropped
+    delivered = [bytes(f) for f in wb.egress]
+    assert 0 < len(delivered) < 20
+    assert plane.dropped == 50 - len(delivered)
+    assert delivered == frames[:len(delivered)]
