@@ -685,11 +685,13 @@ print(f"{time.perf_counter() - t0:.3f}", flush=True)
 
 
 def _live_plane_setup(pairs: int, latency: str, dt_us: float,
-                      prefix: str):
+                      prefix: str, rate: str = ""):
     """Shared topology/daemon/server/wire setup for the live-plane
     scenarios (per-round benchmark and continuous soak): `pairs` shaped
     pod pairs on a real gRPC daemon with the real-time runner started.
-    Returns (daemon, server, port, plane, wires_in, wires_out)."""
+    `rate` switches the wires from latency shaping to a TBF rate limit
+    (the max-plus batch-kernel class). Returns (daemon, server, port,
+    plane, wires_in, wires_out)."""
     from kubedtn_tpu.api.types import Link, Topology, TopologySpec
     from kubedtn_tpu.runtime import WireDataPlane
     from kubedtn_tpu.wire import proto as pb
@@ -697,7 +699,8 @@ def _live_plane_setup(pairs: int, latency: str, dt_us: float,
 
     store = TopologyStore()
     engine = SimEngine(store, capacity=4 * pairs + 8)
-    props = LinkProperties(latency=latency)
+    props = (LinkProperties(rate=rate) if rate
+             else LinkProperties(latency=latency))
     for i in range(pairs):
         a, b = f"{prefix}-a{i}", f"{prefix}-b{i}"
         store.create(Topology(name=a, spec=TopologySpec(links=[
@@ -820,9 +823,33 @@ def live_plane(pairs: int = 8, frames_per_wire: int = 40_000,
     }
 
 
+def _warm_drain_buckets(plane, wires_in, timeout_s: float = 40.0):
+    """Compile the plane's drain-size jit buckets BEFORE load starts:
+    push exactly K frames per pad_slots rung (for the all-wires R
+    bucket and the one-wire R bucket) and wait for each to drain. A
+    measured window must never straddle a first-compile — and the rate
+    probe in the settle loop can't guarantee that: a steady rate proves
+    the CURRENT bucket is compiled, not the smaller one a mid-run load
+    dip would drain into. Cold-cache cost is the compiles themselves
+    (persistent-cached thereafter); warm cost is a handful of fast
+    ticks."""
+    ladder = [k for k in (4, 16, 64, 256, 1024, 4096)
+              if k <= plane.max_slots]
+    frame = b"\x00" * 60
+    for targets in ([wires_in[0]], wires_in):
+        for k in ladder:
+            for w in targets:
+                w.ingress.extend([frame] * k)
+            deadline = time.monotonic() + timeout_s
+            while any(len(w.ingress) for w in targets) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+
+
 def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
                     latency: str = "5ms", dt_us: float = 2_000.0,
-                    window_s: float = 2.5):
+                    window_s: float = 2.5, rate: str = "",
+                    settle_s: float = 90.0):
     """SUSTAINED live-plane throughput under continuous load — the
     honest counterpart of live_plane's per-round numbers. One injector
     subprocess streams InjectBulk without a frame budget for
@@ -841,7 +868,8 @@ def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
 
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     daemon, server, port, plane, wires_in, wires_out = _live_plane_setup(
-        pairs, latency, dt_us, "sk")
+        pairs, latency, dt_us, "sk", rate=rate)
+    _warm_drain_buckets(plane, wires_in)
     wid_list = ",".join(str(w.wire_id) for w in wires_in)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     t0 = time.perf_counter()
@@ -906,6 +934,30 @@ def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
                 raise RuntimeError(
                     "soak saw no delivery within 60s (injector alive)")
             time.sleep(0.01)
+        # settle: drain until the delivery rate stabilizes (two
+        # consecutive 1s probes within 30%) before windows open — the
+        # first drains under load compile the batch-kernel shapes
+        # (seconds each on a cold jit cache; the max-plus TBF scan is
+        # the slowest), and a window that straddles a compile measures
+        # the compiler, not the plane. Warm/persistent-cache runs exit
+        # in ~2s; settle_s caps the wait for cold processes.
+        t_settle_max = time.monotonic() + settle_s
+        prev_rate = -1.0
+        settle_used = 0.0
+        t_s0 = time.monotonic()
+        while time.monotonic() < t_settle_max:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"soak injector died during settle "
+                    f"rc={proc.returncode}")
+            p0 = time.monotonic()
+            time.sleep(1.0)
+            r = drain_count() / (time.monotonic() - p0)
+            if r > 0 and prev_rate > 0 and \
+                    min(r, prev_rate) / max(r, prev_rate) > 0.7:
+                break
+            prev_rate = r
+        settle_used = round(time.monotonic() - t_s0, 1)
         _gc.callbacks.append(_gc_cb)
         steal0 = _steal()
         windows: list[float] = []
@@ -944,6 +996,8 @@ def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
     return {
         "scenario": "live_plane_soak",
         "pairs": pairs,
+        "shaping": f"rate={rate}" if rate else f"latency={latency}",
+        "settle_s": settle_used,
         "seconds": seconds,
         "window_s": window_s,
         "windows_frames_per_s": [round(w, 1) for w in windows],
