@@ -833,7 +833,7 @@ def _warm_drain_buckets(plane, wires_in, timeout_s: float = 40.0):
     dip would drain into. Cold-cache cost is the compiles themselves
     (persistent-cached thereafter); warm cost is a handful of fast
     ticks."""
-    ladder = [k for k in (4, 16, 64, 256, 1024, 4096)
+    ladder = [k for k in (1, 4, 16, 64, 256, 1024, 4096)
               if k <= plane.max_slots]
     frame = b"\x00" * 60
     for targets in ([wires_in[0]], wires_in):
@@ -917,6 +917,11 @@ def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
             return 0.0
 
     try:
+        # flush the bucket warm-up's deliveries FIRST: the gate below
+        # must see the INJECTOR's frames, not warm residue — otherwise
+        # an alive-but-misdelivering injector banks an all-zero record
+        time.sleep(0.3)
+        drain_count()
         # window 0 opens at the FIRST delivery so injector startup
         # (~1-2s of interpreter+grpc) never counts against the plane.
         # A dead injector (stderr is discarded) must fail FAST and
@@ -943,7 +948,6 @@ def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
         # in ~2s; settle_s caps the wait for cold processes.
         t_settle_max = time.monotonic() + settle_s
         prev_rate = -1.0
-        settle_used = 0.0
         t_s0 = time.monotonic()
         while time.monotonic() < t_settle_max:
             if proc.poll() is not None:
