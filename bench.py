@@ -287,41 +287,53 @@ def bench_shape_step(extras: dict) -> None:
         timed(lambda st, s, h, t, k: shaping.shape_step(
             st, s, h, t, k, interpret=False), "shape_pallas_pkts_per_s")
 
-        # persistent-tiled + on-core PRNG variant: the layout transposes
-        # and the host-side threefry that bounded the drop-in kernel's
-        # margin (round-3 VERDICT) are hoisted out of the loop entirely
+        # persistent-tiled + on-core PRNG variant (one step per call)
+        # and the FUSED multi-step form (S steps per pallas_call, state
+        # crossing steps in-kernel — the one-step variant still pays
+        # the full state HBM round-trip per step; the fused one only
+        # writes the depart+flags it actually produces, see
+        # ARCHITECTURE.md roofline note). ONE warm/time/median harness
+        # for both so the figures stay methodology-comparable.
         act_i32 = state.active.astype(jnp.int32)
 
-        @functools.partial(jax.jit, donate_argnums=0, static_argnums=1)
-        def run_tiled(ts, iters):
-            sizes_t = shaping.tile_vec(sizes, ts)
-            act_t = shaping.tile_vec(act_i32, ts)
-            t_arr_t = shaping.tile_vec(t0s, ts)
+        def timed_tiled(steps_per_call: int, label: str):
+            @functools.partial(jax.jit, donate_argnums=0,
+                               static_argnums=1)
+            def run(ts, iters):
+                sizes_t = shaping.tile_vec(sizes, ts)
+                act_t = shaping.tile_vec(act_i32, ts)
+                t_arr_t = shaping.tile_vec(t0s, ts)
 
-            def body(ts, i):
-                ts, _d, _f = shaping.shape_step_tiled.__wrapped__(
-                    ts, sizes_t, act_t, t_arr_t, i, interpret=False)
-                return ts, ()
+                def body(ts, i):
+                    ts, _d, _f = shaping.shape_steps_tiled.__wrapped__(
+                        ts, sizes_t, act_t, t_arr_t, i, steps_per_call,
+                        interpret=False)
+                    return ts, ()
 
-            ts, _ = jax.lax.scan(body, ts, jnp.arange(iters))
-            return ts
+                ts, _ = jax.lax.scan(body, ts, jnp.arange(iters))
+                return ts
 
-        samples = []
-        for _ in range(3):
-            ts = shaping.tile_state(jax.tree.map(lambda x: x.copy(),
-                                                 state))
-            ts = run_tiled(ts, SHAPE_ITERS)
-            jax.block_until_ready(ts.tokens)
-            t0 = time.perf_counter()
-            ts = run_tiled(ts, SHAPE_ITERS)
-            jax.block_until_ready(ts.tokens)
-            samples.append(time.perf_counter() - t0)
-        dt = statistics.median(samples)
-        extras["shape_pallas_tiled_pkts_per_s"] = round(
-            n_active * SHAPE_ITERS / dt, 1)
+            iters = max(1, SHAPE_ITERS // steps_per_call)
+            samples = []
+            for _ in range(3):
+                ts = shaping.tile_state(jax.tree.map(
+                    lambda x: x.copy(), state))
+                ts = run(ts, iters)
+                jax.block_until_ready(ts.tokens)
+                t0 = time.perf_counter()
+                ts = run(ts, iters)
+                jax.block_until_ready(ts.tokens)
+                samples.append(time.perf_counter() - t0)
+            dt = statistics.median(samples)
+            extras[label] = round(
+                n_active * steps_per_call * iters / dt, 1)
+
+        timed_tiled(1, "shape_pallas_tiled_pkts_per_s")
+        timed_tiled(10, "shape_pallas_fused_pkts_per_s")
     else:
         extras["shape_pallas_pkts_per_s"] = None
         extras["shape_pallas_tiled_pkts_per_s"] = None
+        extras["shape_pallas_fused_pkts_per_s"] = None
         extras["shape_pallas_note"] = "skipped: non-TPU backend"
 
 

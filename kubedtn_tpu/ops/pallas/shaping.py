@@ -60,14 +60,14 @@ def _crandom(u, last, rho):
     return val, new_last
 
 
-def _shape_tile_math(u, props_ref, corr_ref, tokens_ref, t_last_ref,
-                     backlog_ref, count_ref, sizes_ref, t_arr_ref,
-                     act_ref, depart_ref, flags_ref, tokens_out,
-                     t_last_out, backlog_out, corr_out, count_out):
-    """One edge tile ([BR, 128] lanes) through the full qdisc chain.
-    `u` is a length-NU sequence of uniform tiles — read from an input
-    slab (drop-in/interpret path) or generated in-kernel (tiled TPU
-    path)."""
+def _tile_step_values(u, props_ref, st, size, t_arr, act):
+    """One edge tile ([BR, 128] lanes) through the full qdisc chain, as
+    a PURE function of values — the single definition every Pallas
+    kernel variant wraps. `u` is a length-NU sequence of uniform tiles;
+    `st` is the mutable state as values: (tokens, t_last, next_free,
+    (c_delay, c_loss, c_dup, c_reorder, c_corrupt), cnt). props are
+    read from the ref (loop-invariant in multi-step kernels). Returns
+    (depart, flags, st')."""
     pct = 1.0 / 100.0
 
     latency = props_ref[es.P_LATENCY_US]
@@ -84,13 +84,8 @@ def _shape_tile_math(u, props_ref, corr_ref, tokens_ref, t_last_ref,
     corrupt = props_ref[es.P_CORRUPT_PROB]
     cor_rho = props_ref[es.P_CORRUPT_CORR] * pct
 
-    c_delay = corr_ref[es.C_DELAY]
-    c_loss = corr_ref[es.C_LOSS]
-    c_dup = corr_ref[es.C_DUP]
-    c_reo = corr_ref[es.C_REORDER]
-    c_cor = corr_ref[es.C_CORRUPT]
-
-    cnt = count_ref[...]
+    tokens, t_last, next_free, corr5, cnt = st
+    c_delay, c_loss, c_dup, c_reo, c_cor = corr5
     cnt_f = cnt.astype(jnp.float32)
 
     # -- netem stage (kernel enqueue order; see netem.netem_packet) ----
@@ -126,11 +121,7 @@ def _shape_tile_math(u, props_ref, corr_ref, tokens_ref, t_last_ref,
     new_cnt = jnp.where(do_reorder, 0, jnp.where(survives, cnt + 1, cnt))
 
     # -- TBF stage (see netem.tbf_packet) ------------------------------
-    tokens = tokens_ref[...]
-    t_last = t_last_ref[...]
-    next_free = backlog_ref[...]
-    size = sizes_ref[...]
-    t_ready = t_arr_ref[...] + delay
+    t_ready = t_arr + delay
 
     rate_on = rate > 0.0
     rate_b_us = rate / 8e6
@@ -157,7 +148,6 @@ def _shape_tile_math(u, props_ref, corr_ref, tokens_ref, t_last_ref,
     delivered = ~dropped & ~drop_q
 
     # -- masking + packed outputs --------------------------------------
-    act = act_ref[...] > 0
     inf = jnp.float32(jnp.inf)
     delivered &= act
     dropped &= act
@@ -166,8 +156,8 @@ def _shape_tile_math(u, props_ref, corr_ref, tokens_ref, t_last_ref,
     duplicated = duplicated & delivered
     do_reorder = do_reorder & delivered
 
-    depart_ref[...] = jnp.where(delivered, t_depart, inf)
-    flags_ref[...] = (
+    depart_v = jnp.where(delivered, t_depart, inf)
+    flags_v = (
         delivered.astype(jnp.int32) * FLAG_DELIVERED
         + dropped.astype(jnp.int32) * FLAG_DROP_LOSS
         + drop_q.astype(jnp.int32) * FLAG_DROP_QUEUE
@@ -175,15 +165,56 @@ def _shape_tile_math(u, props_ref, corr_ref, tokens_ref, t_last_ref,
         + duplicated.astype(jnp.int32) * FLAG_DUPLICATED
         + do_reorder.astype(jnp.int32) * FLAG_REORDERED
     )
-    tokens_out[...] = jnp.where(act, new_tokens, tokens)
-    t_last_out[...] = jnp.where(act, new_t_last, t_last)
-    backlog_out[...] = jnp.where(act, new_next_free, next_free)
-    count_out[...] = jnp.where(act, new_cnt, cnt)
-    corr_out[es.C_DELAY] = jnp.where(act, del_state, c_delay)
-    corr_out[es.C_LOSS] = jnp.where(act, loss_state, c_loss)
-    corr_out[es.C_DUP] = jnp.where(act, dup_state, c_dup)
-    corr_out[es.C_REORDER] = jnp.where(act, reo_state, c_reo)
-    corr_out[es.C_CORRUPT] = jnp.where(act, cor_state, c_cor)
+    st_new = (
+        jnp.where(act, new_tokens, tokens),
+        jnp.where(act, new_t_last, t_last),
+        jnp.where(act, new_next_free, next_free),
+        (jnp.where(act, del_state, c_delay),
+         jnp.where(act, loss_state, c_loss),
+         jnp.where(act, dup_state, c_dup),
+         jnp.where(act, reo_state, c_reo),
+         jnp.where(act, cor_state, c_cor)),
+        jnp.where(act, new_cnt, cnt),
+    )
+    return depart_v, flags_v, st_new
+
+
+def _read_state(corr_ref, tokens_ref, t_last_ref, backlog_ref, count_ref):
+    return (tokens_ref[...], t_last_ref[...], backlog_ref[...],
+            (corr_ref[es.C_DELAY], corr_ref[es.C_LOSS],
+             corr_ref[es.C_DUP], corr_ref[es.C_REORDER],
+             corr_ref[es.C_CORRUPT]), count_ref[...])
+
+
+def _write_state(st, tokens_out, t_last_out, backlog_out, corr_out,
+                 count_out):
+    tokens, t_last, next_free, corr5, cnt = st
+    tokens_out[...] = tokens
+    t_last_out[...] = t_last
+    backlog_out[...] = next_free
+    corr_out[es.C_DELAY] = corr5[0]
+    corr_out[es.C_LOSS] = corr5[1]
+    corr_out[es.C_DUP] = corr5[2]
+    corr_out[es.C_REORDER] = corr5[3]
+    corr_out[es.C_CORRUPT] = corr5[4]
+    count_out[...] = cnt
+
+
+def _shape_tile_math(u, props_ref, corr_ref, tokens_ref, t_last_ref,
+                     backlog_ref, count_ref, sizes_ref, t_arr_ref,
+                     act_ref, depart_ref, flags_ref, tokens_out,
+                     t_last_out, backlog_out, corr_out, count_out):
+    """Single-step ref wrapper over _tile_step_values (the drop-in and
+    one-step tiled kernels)."""
+    st = _read_state(corr_ref, tokens_ref, t_last_ref, backlog_ref,
+                     count_ref)
+    depart, flags, st = _tile_step_values(
+        u, props_ref, st, sizes_ref[...], t_arr_ref[...],
+        act_ref[...] > 0)
+    depart_ref[...] = depart
+    flags_ref[...] = flags
+    _write_state(st, tokens_out, t_last_out, backlog_out, corr_out,
+                 count_out)
 
 
 def _shape_kernel(props_ref, corr_ref, u_ref, tokens_ref, t_last_ref,
@@ -216,26 +247,58 @@ def _bits_to_uniform(bits: jax.Array) -> jax.Array:
     return sb.astype(jnp.float32) * (2.0 ** -24)
 
 
-def _shape_kernel_prng(seed_ref, props_ref, corr_ref, tokens_ref,
-                       t_last_ref, backlog_ref, count_ref, sizes_ref,
-                       t_arr_ref, act_ref, depart_ref, flags_ref,
-                       tokens_out, t_last_out, backlog_out, corr_out,
-                       count_out):
-    """Tiled-TPU kernel: uniforms come from the on-core PRNG
-    (pltpu.prng_seed / prng_random_bits) — no [E, NU] HBM
-    materialization and no re-tiling of the random stream. Seeded per
-    (step seed, grid tile) so results are deterministic per seed and
-    independent across tiles. 24-bit mantissa uniforms in [0, 1), the
-    same distribution the threefry path feeds the kernel."""
+def _shape_kernel_steps(u_ref, props_ref, corr_ref, tokens_ref,
+                        t_last_ref, backlog_ref, count_ref, sizes_ref,
+                        t_arr_ref, act_ref, depart_ref, flags_ref,
+                        tokens_out, t_last_out, backlog_out, corr_out,
+                        count_out, *, steps):
+    """S shaping steps fused in ONE kernel invocation: the mutable state
+    crosses steps in REGISTERS/VMEM, so per step the only HBM traffic is
+    the [br,128] depart+flags outputs — the ~144 B/edge/step state
+    round-trip of the one-step kernels collapses to ~8 B. External
+    uniforms arrive as an [S*NU, br, 128] slab (interpret/parity path);
+    sizes/t_arr/act are held constant across the fused steps (the
+    steady-state loop's contract)."""
+    size = sizes_ref[...]
+    t_arr = t_arr_ref[...]
+    act = act_ref[...] > 0
+    st = _read_state(corr_ref, tokens_ref, t_last_ref, backlog_ref,
+                     count_ref)
+    for s in range(steps):  # static unroll: S is a compile-time constant
+        u = tuple(u_ref[s * netem.NU + k] for k in range(netem.NU))
+        depart, flags, st = _tile_step_values(u, props_ref, st, size,
+                                              t_arr, act)
+        depart_ref[s] = depart
+        flags_ref[s] = flags
+    _write_state(st, tokens_out, t_last_out, backlog_out, corr_out,
+                 count_out)
+
+
+def _shape_kernel_steps_prng(seed_ref, props_ref, corr_ref, tokens_ref,
+                             t_last_ref, backlog_ref, count_ref,
+                             sizes_ref, t_arr_ref, act_ref, depart_ref,
+                             flags_ref, tokens_out, t_last_out,
+                             backlog_out, corr_out, count_out, *, steps):
+    """Multi-step kernel with on-core PRNG: seeded once per (seed,
+    tile), drawing a fresh [NU, br, 128] block per step — S steps cost
+    zero HBM random traffic and zero host threefry."""
     br, lane = tokens_ref.shape
+    size = sizes_ref[...]
+    t_arr = t_arr_ref[...]
+    act = act_ref[...] > 0
+    st = _read_state(corr_ref, tokens_ref, t_last_ref, backlog_ref,
+                     count_ref)
     pltpu.prng_seed(seed_ref[0], pl.program_id(0))
-    bits = pltpu.prng_random_bits((netem.NU, br, lane))
-    u_all = _bits_to_uniform(bits)
-    u = tuple(u_all[k] for k in range(netem.NU))
-    _shape_tile_math(u, props_ref, corr_ref, tokens_ref, t_last_ref,
-                     backlog_ref, count_ref, sizes_ref, t_arr_ref,
-                     act_ref, depart_ref, flags_ref, tokens_out,
-                     t_last_out, backlog_out, corr_out, count_out)
+    for s in range(steps):
+        bits = pltpu.prng_random_bits((netem.NU, br, lane))
+        u_all = _bits_to_uniform(bits)
+        u = tuple(u_all[k] for k in range(netem.NU))
+        depart, flags, st = _tile_step_values(u, props_ref, st, size,
+                                              t_arr, act)
+        depart_ref[s] = depart
+        flags_ref[s] = flags
+    _write_state(st, tokens_out, t_last_out, backlog_out, corr_out,
+                 count_out)
 
 
 def _pad_rows(x: jax.Array, e_pad: int) -> jax.Array:
@@ -444,7 +507,8 @@ def shape_step_tiled(tstate: TiledShapeState, sizes_t: jax.Array,
                      act_t: jax.Array, t_arr_t: jax.Array,
                      seed, u_t: jax.Array | None = None, *,
                      interpret: bool | None = None):
-    """One shaping step entirely in kernel layout.
+    """One shaping step entirely in kernel layout — the steps=1 case of
+    shape_steps_tiled (one definition of the pallas scaffolding).
 
     DONATES tstate: the tiled buffers are reused in place, so a steady-
     state loop does zero layout work and zero host-side PRNG — uniforms
@@ -456,6 +520,34 @@ def shape_step_tiled(tstate: TiledShapeState, sizes_t: jax.Array,
     Returns (tstate', depart [R,128], flags int32 [R,128]) — flags as in
     FLAG_*; callers slice the first `capacity` lanes after untiling.
     """
+    new_tstate, depart, flags = shape_steps_tiled.__wrapped__(
+        tstate, sizes_t, act_t, t_arr_t, seed, 1, u_t,
+        interpret=interpret)
+    return new_tstate, depart[0], flags[0]
+
+
+@functools.partial(jax.jit, donate_argnums=0,
+                   static_argnames=("steps", "interpret"))
+def shape_steps_tiled(tstate: TiledShapeState, sizes_t: jax.Array,
+                      act_t: jax.Array, t_arr_t: jax.Array, seed,
+                      steps: int, u_t: jax.Array | None = None, *,
+                      interpret: bool | None = None):
+    """`steps` shaping steps FUSED into one pallas_call — the mutable
+    state crosses steps inside the kernel (registers/VMEM), so the
+    one-step variants' per-step HBM state round-trip (~144 B/edge)
+    collapses to the ~8 B/edge/step of depart+flags actually produced.
+    This is the bandwidth form of the roofline note: with layout AND
+    state traffic hoisted, per-step cost approaches the output floor.
+
+    DONATES tstate. sizes/act/t_arrival are held constant across the
+    fused steps (the steady-state batched plane's contract; vary them
+    at fusion boundaries). On-core PRNG draws a fresh block per step
+    from one (seed, tile) seeding; pass `u_t` [steps*NU, R, 128] for
+    external uniforms (required under interpret, used by parity tests
+    — step s reads rows [s*NU, (s+1)*NU)).
+
+    Returns (tstate', depart [steps, R, 128], flags i32 [steps, R,
+    128])."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if interpret and u_t is None:
@@ -475,33 +567,37 @@ def shape_step_tiled(tstate: TiledShapeState, sizes_t: jax.Array,
 
     f32 = jnp.float32
     out_shapes = (
-        jax.ShapeDtypeStruct((R, LANE), f32),          # depart
-        jax.ShapeDtypeStruct((R, LANE), jnp.int32),    # flags
-        jax.ShapeDtypeStruct((R, LANE), f32),          # tokens
-        jax.ShapeDtypeStruct((R, LANE), f32),          # t_last
-        jax.ShapeDtypeStruct((R, LANE), f32),          # backlog
-        jax.ShapeDtypeStruct((es.NCORR, R, LANE), f32),  # corr
-        jax.ShapeDtypeStruct((R, LANE), jnp.int32),    # pkt_count
+        jax.ShapeDtypeStruct((steps, R, LANE), f32),       # depart
+        jax.ShapeDtypeStruct((steps, R, LANE), jnp.int32),  # flags
+        jax.ShapeDtypeStruct((R, LANE), f32),              # tokens
+        jax.ShapeDtypeStruct((R, LANE), f32),              # t_last
+        jax.ShapeDtypeStruct((R, LANE), f32),              # backlog
+        jax.ShapeDtypeStruct((es.NCORR, R, LANE), f32),    # corr
+        jax.ShapeDtypeStruct((R, LANE), jnp.int32),        # pkt_count
     )
-    out_specs = (vec(), vec(), vec(), vec(), vec(), slab(es.NCORR), vec())
+    out_specs = (slab(steps), slab(steps), vec(), vec(), vec(),
+                 slab(es.NCORR), vec())
 
     if u_t is not None:
+        kern = functools.partial(_shape_kernel_steps, steps=steps)
         (depart, flags, tokens, t_last, backlog, corr,
          count) = pl.pallas_call(
-            _shape_kernel,
+            kern,
             grid=grid,
-            in_specs=[slab(es.NPROP), slab(es.NCORR), slab(netem.NU),
-                      vec(), vec(), vec(), vec(), vec(), vec(), vec()],
+            in_specs=[slab(steps * netem.NU), slab(es.NPROP),
+                      slab(es.NCORR), vec(), vec(), vec(), vec(),
+                      vec(), vec(), vec()],
             out_specs=out_specs,
             out_shape=out_shapes,
             interpret=interpret,
-        )(tstate.props, tstate.corr, u_t, tstate.tokens, tstate.t_last,
+        )(u_t, tstate.props, tstate.corr, tstate.tokens, tstate.t_last,
           tstate.backlog, tstate.count, sizes_t, t_arr_t, act_t)
     else:
+        kern = functools.partial(_shape_kernel_steps_prng, steps=steps)
         seed_arr = jnp.asarray(seed, jnp.int32).reshape((1,))
         (depart, flags, tokens, t_last, backlog, corr,
          count) = pl.pallas_call(
-            _shape_kernel_prng,
+            kern,
             grid=grid,
             in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                       slab(es.NPROP), slab(es.NCORR),
@@ -510,8 +606,8 @@ def shape_step_tiled(tstate: TiledShapeState, sizes_t: jax.Array,
             out_shape=out_shapes,
             interpret=interpret,
         )(seed_arr, tstate.props, tstate.corr, tstate.tokens,
-          tstate.t_last, tstate.backlog, tstate.count, sizes_t, t_arr_t,
-          act_t)
+          tstate.t_last, tstate.backlog, tstate.count, sizes_t,
+          t_arr_t, act_t)
 
     new_tstate = dataclasses.replace(
         tstate, corr=corr, tokens=tokens, t_last=t_last, backlog=backlog,

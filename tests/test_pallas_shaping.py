@@ -288,3 +288,68 @@ def test_tiled_prng_on_chip():
     delivered = (fl & shaping.FLAG_DELIVERED).astype(bool)
     dep = np.asarray(depart)
     assert np.isfinite(dep[delivered]).all()
+
+    # fused multi-step with on-core PRNG (state crosses steps in-kernel)
+    ts3, depS, flS = shaping.shape_steps_tiled(
+        ts2, sizes_t, act_t, t_arr_t, 11, 8, interpret=False)
+    jax.block_until_ready(ts3.tokens)
+    assert bool(jnp.isfinite(ts3.tokens).all())
+    flS = np.asarray(flS)
+    assert flS.shape[0] == 8 and flS.min() >= 0 and flS.max() < 64
+    dS = np.asarray(depS)
+    dl = (flS & shaping.FLAG_DELIVERED).astype(bool)
+    assert np.isfinite(dS[dl]).all()
+    # per-step PRNG streams differ (fresh block per step)
+    assert not (flS[0] == flS[1]).all()
+
+
+@pytest.mark.parametrize("S", [2, 4])
+def test_fused_multistep_matches_sequential(S):
+    """shape_steps_tiled (S steps fused in one pallas_call, state
+    carried in-kernel) must equal S sequential shape_step_tiled calls
+    given the same per-step uniforms — exact flags, f32-exact departs
+    and state."""
+    state = random_state(1024, seed=9)
+    rng = np.random.default_rng(2)
+    sizes = jnp.asarray(rng.uniform(64, 1500, 1024), jnp.float32)
+    ts0 = shaping.tile_state(dcopy(state))
+    sz = shaping.tile_vec(sizes, ts0)
+    ac = shaping.tile_vec(state.active.astype(jnp.int32), ts0)
+    ta = shaping.tile_vec(jnp.zeros(1024, jnp.float32), ts0)
+    e_pad = ts0.tokens.shape[0] * shaping.LANE
+    us = [shaping._tiles(
+        jax.random.uniform(jax.random.PRNGKey(100 + s),
+                           (1024, netem.NU), dtype=jnp.float32), e_pad)
+        for s in range(S)]
+
+    ts_seq = shaping.tile_state(dcopy(state))
+    deps, fls = [], []
+    for s in range(S):
+        ts_seq, d, f = shaping.shape_step_tiled(ts_seq, sz, ac, ta, 0,
+                                                us[s], interpret=True)
+        deps.append(np.asarray(d))
+        fls.append(np.asarray(f))
+
+    ts_fus, dS, fS = shaping.shape_steps_tiled(
+        ts0, sz, ac, ta, 0, S, jnp.concatenate(us, axis=0),
+        interpret=True)
+    dS, fS = np.asarray(dS), np.asarray(fS)
+    for s in range(S):
+        np.testing.assert_array_equal(fS[s], fls[s],
+                                      err_msg=f"flags step {s}")
+        np.testing.assert_allclose(dS[s], deps[s], rtol=1e-6, atol=1e-3,
+                                   err_msg=f"depart step {s}")
+    for name in ("tokens", "t_last", "backlog", "count", "corr"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(ts_fus, name)),
+            np.asarray(getattr(ts_seq, name)),
+            rtol=1e-6, atol=1e-3, err_msg=name)
+
+
+def test_fused_multistep_prng_requires_uniforms_under_interpret():
+    state = random_state(1024, seed=2)
+    tstate = shaping.tile_state(state)
+    z = shaping.tile_vec(jnp.zeros((state.capacity,), jnp.float32), tstate)
+    a = shaping.tile_vec(jnp.zeros((state.capacity,), jnp.int32), tstate)
+    with pytest.raises(ValueError, match="interpret mode"):
+        shaping.shape_steps_tiled(tstate, z, a, z, 7, 4, interpret=True)
