@@ -1056,3 +1056,75 @@ def test_bulk_groups_malformed_blob_falls_back_to_protobuf():
     # an EMPTY PacketBatch is valid and yields nothing
     empty = pb.PacketBatch().SerializeToString()
     assert list(daemon._bulk_groups(empty, want_segs=True)) == []
+
+
+def test_three_kernel_classes_interleave_under_live_load():
+    """One plane, three wire classes — latency-only (elementwise
+    kernel), plain rate limit (max-plus TBF kernel), rate+correlation
+    (seq scan, seq_slots-capped) — all carrying traffic in the SAME
+    ticks: every class delivers completely and in order, the seq class
+    alone trips the holdback machinery, and counters account for every
+    frame exactly once."""
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    spec = {
+        "lat": LinkProperties(latency="2ms"),
+        "tbf": LinkProperties(rate="1Gbit"),
+        "seq": LinkProperties(rate="1Gbit", duplicate="0",
+                              duplicate_corr="10"),
+    }
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    for j, (tag, props) in enumerate(spec.items(), start=1):
+        a, b = f"{tag}a", f"{tag}b"
+        store.create(Topology(name=a, spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=b,
+                 uid=j, properties=props)])))
+        store.create(Topology(name=b, spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=a,
+                 uid=j, properties=props)])))
+        engine.setup_pod(a)
+        engine.setup_pod(b)
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=1_000.0)
+    plane.seq_slots = 16
+    wires = {}
+    for j, tag in enumerate(spec, start=1):
+        wires[tag] = (
+            daemon._add_wire(pb.WireDef(local_pod_name=f"{tag}a",
+                                        kube_ns="default", link_uid=j,
+                                        intf_name_in_pod="eth1")),
+            daemon._add_wire(pb.WireDef(local_pod_name=f"{tag}b",
+                                        kube_ns="default", link_uid=j,
+                                        intf_name_in_pod="eth1")))
+    N = 120
+    frames = {tag: [bytes([j]) + bytes([i % 251]) * 199
+                    for i in range(N)]
+              for j, tag in enumerate(spec, start=1)}
+    # bulk-ingest all three classes as segments in the same window
+    for tag in spec:
+        blob = _seg_for(wires[tag][0].wire_id, frames[tag])
+        for wid, group in daemon._bulk_groups(blob, want_segs=True):
+            daemon.wires.get_by_id(wid).ingress.append(group)
+
+    t = 8.0
+    shaped_first = plane.tick(now_s=t)
+    # the seq wire is capped at 16 this tick; lat+tbf deliver all N
+    # each and nothing drops at these rates — the count is exact
+    assert shaped_first == 2 * N + plane.seq_slots
+    assert wires["seq"][0].wire_id in plane._holdback
+    assert wires["lat"][0].wire_id not in plane._holdback
+    assert wires["tbf"][0].wire_id not in plane._holdback
+    for k in range(40):
+        t += 0.001
+        plane.tick(now_s=t)
+    for tag in spec:
+        got = list(wires[tag][1].egress)
+        assert got == frames[tag], f"{tag}: loss or reorder"
+    assert plane.dropped == 0 and plane.tick_errors == 0
+    assert not plane._holdback
+    if daemon.frame_stats:
+        assert sum(daemon.frame_stats.values()) == 3 * N
