@@ -1128,3 +1128,70 @@ def test_three_kernel_classes_interleave_under_live_load():
     assert not plane._holdback
     if daemon.frame_stats:
         assert sum(daemon.frame_stats.values()) == 3 * N
+
+
+@pytest.mark.skipif(not native.have_native(), reason="no native lib")
+def test_segment_requeue_on_vanished_row_before_decide():
+    """A SEGMENT drained in the same tick its row vanished (compact or
+    delete between drain and the locked re-resolve) re-queues onto
+    wire.ingress as entries — frames not yet counted or decided, so the
+    exactly-once invariant allows the re-drain — and delivers fully
+    once the link re-realizes, in order, counted once."""
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon, FrameSeg
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    link_ab = Link(local_intf="eth1", peer_intf="eth1", peer_pod="b",
+                   uid=1, properties=LinkProperties(latency="1ms"))
+    store.create(Topology(name="a", spec=TopologySpec(links=[link_ab])))
+    store.create(Topology(name="b", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="a", uid=1,
+             properties=LinkProperties(latency="1ms"))])))
+    engine.setup_pod("a")
+    engine.setup_pod("b")
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=1_000.0)
+    wa = daemon._add_wire(pb.WireDef(local_pod_name="a",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    wb = daemon._add_wire(pb.WireDef(local_pod_name="b",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    frames = [bytes([i]) * 80 for i in range(30)]
+    for _wid, group in daemon._bulk_groups(_seg_for(wa.wire_id, frames),
+                                           want_segs=True):
+        wa.ingress.append(group)
+
+    # delete the link AFTER the drain hands the segment to the tick but
+    # BEFORE the locked row re-resolution (the compact()-race window)
+    topo_a = store.get("default", "a")
+    orig_drain = daemon.drain_ingress
+
+    def hooked(*a, **k):
+        out = orig_drain(*a, **k)
+        if out:
+            assert engine.del_links(topo_a, [link_ab])
+        return out
+
+    daemon.drain_ingress = hooked
+    assert plane.tick(now_s=6.0) == 0
+    daemon.drain_ingress = orig_drain
+    # segment re-queued intact: frames stay 30, entries stay segments
+    assert len(wa.ingress) == 30
+    assert any(type(e) is FrameSeg for e in list(wa.ingress))
+    if daemon.frame_stats:
+        assert sum(daemon.frame_stats.values()) == 0  # not counted yet
+
+    assert engine.add_links(topo_a, [link_ab])
+    t = 6.0
+    total = 0
+    for k in range(1, 8):
+        t += 0.001
+        total += plane.tick(now_s=t)
+    assert total == 30
+    assert list(wb.egress) == frames
+    if daemon.frame_stats:
+        assert sum(daemon.frame_stats.values()) == 30  # exactly once
