@@ -32,6 +32,32 @@ from kubedtn_tpu.wire import proto as pb
 DEFAULT_PORT = 51111  # reference common/constants.go:9
 
 
+_KDT_EXT = None
+_KDT_EXT_TRIED = False
+
+
+def _kdt_ext():
+    """The optional CPython extension (native/kdt_ext.c) — built by the
+    same `make -C native` the ctypes library uses; None (with the
+    pure-Python paths taking over) when headers/toolchain are absent."""
+    global _KDT_EXT, _KDT_EXT_TRIED
+    if not _KDT_EXT_TRIED:
+        _KDT_EXT_TRIED = True
+        try:
+            from kubedtn_tpu import native as _nat
+
+            _nat._load()  # runs make, which also builds the extension
+        except Exception:
+            pass
+        try:
+            from kubedtn_tpu import kdt_ext as _ext
+
+            _KDT_EXT = _ext
+        except Exception:
+            _KDT_EXT = None
+    return _KDT_EXT
+
+
 class FrameSeg:
     """Zero-copy window of frames inside ONE serialized PacketBatch blob.
 
@@ -89,7 +115,13 @@ class FrameSeg:
 
     def materialize(self) -> list[bytes]:
         """The window's frames as individual bytes objects (delivery,
-        checkpoint, capture)."""
+        checkpoint, capture). One C loop when the kdt_ext extension is
+        available — materialization is the live plane's dominant
+        release-stage cost once ingress is zero-copy."""
+        ext = _kdt_ext()
+        if ext is not None:
+            return ext.slice_frames(self.blob, self.offs, self.lens,
+                                    self.lo, self.hi)
         b = self.blob
         return [b[o:o + ln] for o, ln in
                 zip(self.offs[self.lo:self.hi].tolist(),

@@ -1,0 +1,87 @@
+/* kdt_ext — CPython fast paths the plain-C-ABI library cannot provide:
+ * building Python objects in C. The rest of the native tier
+ * (kubedtn_native.cc) stays Python-free so it loads via ctypes anywhere;
+ * this module is OPTIONAL and every caller keeps a pure-Python fallback
+ * (wire/server.py FrameSeg.materialize).
+ *
+ * slice_frames(blob, offs, lens, lo, hi) -> list[bytes]
+ *
+ * The segment-delivery hot path: one C loop of
+ * PyBytes_FromStringAndSize per frame instead of a Python slice loop —
+ * frame materialization is the live plane's dominant release cost once
+ * everything upstream is zero-copy (the role the kernel's skb clone
+ * plays at delivery in the reference's veth path).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+
+static PyObject *
+slice_frames(PyObject *self, PyObject *args)
+{
+    PyObject *blob;
+    Py_buffer offs, lens;
+    Py_ssize_t lo, hi;
+
+    (void)self;
+    if (!PyArg_ParseTuple(args, "Sy*y*nn", &blob, &offs, &lens, &lo, &hi))
+        return NULL;
+
+    PyObject *out = NULL;
+    const char *base = PyBytes_AS_STRING(blob);
+    const uint64_t blen = (uint64_t)PyBytes_GET_SIZE(blob);
+    const uint64_t *off_p = (const uint64_t *)offs.buf;
+    const uint64_t *len_p = (const uint64_t *)lens.buf;
+    const Py_ssize_t n_off = offs.len / (Py_ssize_t)sizeof(uint64_t);
+    const Py_ssize_t n_len = lens.len / (Py_ssize_t)sizeof(uint64_t);
+
+    if (lo < 0 || hi < lo || hi > n_off || hi > n_len) {
+        PyErr_SetString(PyExc_ValueError,
+                        "slice_frames: window outside offset/len arrays");
+        goto done;
+    }
+    out = PyList_New(hi - lo);
+    if (out == NULL)
+        goto done;
+    for (Py_ssize_t i = lo; i < hi; i++) {
+        const uint64_t o = off_p[i];
+        const uint64_t n = len_p[i];
+        if (o > blen || n > blen - o) {
+            Py_CLEAR(out);
+            PyErr_SetString(PyExc_ValueError,
+                            "slice_frames: frame window outside blob");
+            goto done;
+        }
+        PyObject *item =
+            PyBytes_FromStringAndSize(base + o, (Py_ssize_t)n);
+        if (item == NULL) {
+            Py_CLEAR(out);
+            goto done;
+        }
+        PyList_SET_ITEM(out, i - lo, item);
+    }
+done:
+    PyBuffer_Release(&offs);
+    PyBuffer_Release(&lens);
+    return out;
+}
+
+static PyMethodDef kdt_ext_methods[] = {
+    {"slice_frames", slice_frames, METH_VARARGS,
+     "slice_frames(blob, offs_u64, lens_u64, lo, hi) -> list[bytes]\n"
+     "Materialize frames [lo, hi) of a segment from its transport blob "
+     "in one C loop."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef kdt_ext_module = {
+    PyModuleDef_HEAD_INIT, "kdt_ext",
+    "CPython fast paths for the kubedtn_tpu data plane.", -1,
+    kdt_ext_methods, NULL, NULL, NULL, NULL,
+};
+
+PyMODINIT_FUNC
+PyInit_kdt_ext(void)
+{
+    return PyModule_Create(&kdt_ext_module);
+}
