@@ -654,7 +654,7 @@ import jax; jax.config.update("jax_platforms", "cpu")
 import grpc
 port, wids, n_per = sys.argv[1], sys.argv[2], int(sys.argv[3])
 repo = sys.argv[4]
-chunk = 256
+chunk = int(sys.argv[5])
 sys.path.insert(0, repo)
 from kubedtn_tpu.wire import proto as pb
 wids = [int(w) for w in wids.split(",")]
@@ -682,6 +682,14 @@ t0 = time.perf_counter()
 call(gen())
 print(f"{time.perf_counter() - t0:.3f}", flush=True)
 """
+
+
+# frames per PacketBatch message from the load-generator subprocess; the
+# round accounting in live_plane rounds budgets UP to whole chunks, so
+# the three consumers must share this one constant (512 ≈ 107KB
+# messages: halves the per-message gRPC cost of the old 256 on the
+# shared bench core — soak went 650k → 807k frames/s)
+INJECTOR_CHUNK = 512
 
 
 def _live_plane_setup(pairs: int, latency: str, dt_us: float,
@@ -765,11 +773,11 @@ def live_plane(pairs: int = 8, frames_per_wire: int = 40_000,
     def run_round(n_per: int) -> tuple[float, int, float]:
         for w in wires_out:
             w.egress.clear()
-        # chunked injector rounds n_per UP to whole 256-frame batches
-        total = pairs * (-(-n_per // 256) * 256)
+        # chunked injector rounds n_per UP to whole INJECTOR_CHUNK batches
+        total = pairs * (-(-n_per // INJECTOR_CHUNK) * INJECTOR_CHUNK)
         proc = subprocess.Popen(
             [_sys.executable, "-c", _INJECTOR_SRC, str(port), wid_list,
-             str(n_per), repo_root],
+             str(n_per), repo_root, str(INJECTOR_CHUNK)],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
             env=env)
         # the measured window opens at the FIRST delivery, so the
@@ -804,7 +812,8 @@ def live_plane(pairs: int = 8, frames_per_wire: int = 40_000,
     plane.stop()
     server.stop(0)
     inject_rates = [
-        round(pairs * (-(-frames_per_wire // 256) * 256) / r[2], 1)
+        round(pairs * (-(-frames_per_wire // INJECTOR_CHUNK)
+                       * INJECTOR_CHUNK) / r[2], 1)
         for r in results if r[2] > 0]
     return {
         "scenario": "live_plane",
@@ -875,7 +884,7 @@ def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
     t0 = time.perf_counter()
     proc = subprocess.Popen(
         [_sys.executable, "-c", _INJECTOR_SRC, str(port), wid_list,
-         "-1", repo_root],
+         "-1", repo_root, str(INJECTOR_CHUNK)],
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
 
     def drain_count() -> int:
