@@ -459,7 +459,10 @@ class TimingWheel:
                  levels: int = 4) -> None:
         self._lib = _load()
         self._h = self._lib.kdt_tw_new(tick_us, bits, levels)
-        self._out = (ctypes.c_uint64 * 4096)()
+        # advance() drain buffer: one saturated live-plane tick releases
+        # ~tens of thousands of tokens, and each refill is a native call
+        # plus a frombuffer copy — size it so a typical tick drains in one
+        self._out = (ctypes.c_uint64 * 32768)()
 
     def close(self) -> None:
         if self._h:
