@@ -297,6 +297,11 @@ def bench_shape_step(extras: dict) -> None:
         act_i32 = state.active.astype(jnp.int32)
 
         def timed_tiled(steps_per_call: int, label: str):
+            # delivery accounting stays ON DEVICE (shaping.flag_counts):
+            # the [steps, R, 128] flags slab reduces to one scalar per
+            # scan step inside the jit — the timed loop transfers
+            # nothing per step, and the delivered total still comes out
+            # as evidence that the kernel shaped real traffic
             @functools.partial(jax.jit, donate_argnums=0,
                                static_argnums=1)
             def run(ts, iters):
@@ -304,29 +309,36 @@ def bench_shape_step(extras: dict) -> None:
                 act_t = shaping.tile_vec(act_i32, ts)
                 t_arr_t = shaping.tile_vec(t0s, ts)
 
-                def body(ts, i):
-                    ts, _d, _f = shaping.shape_steps_tiled.__wrapped__(
+                def body(carry, i):
+                    ts, delivered = carry
+                    ts, _d, f = shaping.shape_steps_tiled.__wrapped__(
                         ts, sizes_t, act_t, t_arr_t, i, steps_per_call,
                         interpret=False)
-                    return ts, ()
+                    delivered += shaping.flag_counts.__wrapped__(
+                        f)["delivered"]
+                    return (ts, delivered), ()
 
-                ts, _ = jax.lax.scan(body, ts, jnp.arange(iters))
-                return ts
+                carry, _ = jax.lax.scan(body, (ts, jnp.int32(0)),
+                                        jnp.arange(iters))
+                return carry
 
             iters = max(1, SHAPE_ITERS // steps_per_call)
             samples = []
+            delivered = 0
             for _ in range(3):
                 ts = shaping.tile_state(jax.tree.map(
                     lambda x: x.copy(), state))
-                ts = run(ts, iters)
+                ts, _n = run(ts, iters)
                 jax.block_until_ready(ts.tokens)
                 t0 = time.perf_counter()
-                ts = run(ts, iters)
+                ts, n_del = run(ts, iters)
                 jax.block_until_ready(ts.tokens)
                 samples.append(time.perf_counter() - t0)
+                delivered = int(n_del)
             dt = statistics.median(samples)
             extras[label] = round(
                 n_active * steps_per_call * iters / dt, 1)
+            extras[f"{label}_delivered"] = delivered
 
         timed_tiled(1, "shape_pallas_tiled_pkts_per_s")
         timed_tiled(10, "shape_pallas_fused_pkts_per_s")
@@ -510,29 +522,82 @@ def main() -> None:
                               "device_calls", "meets_target")
         }
 
-    def run_live_plane():
-        from kubedtn_tpu.scenarios import live_plane
+    def _isolated_scenario(func: str, kwargs: dict,
+                           timeout_s: float = 900.0) -> dict:
+        """Run one live-plane scenario in a FRESH subprocess. The live
+        phases measure a steady-state plane, but by the time they run,
+        this process carries every earlier phase's jit caches, device
+        arrays, and allocator high-water — on a small shared host that
+        ballast visibly depresses (lat) or decays (tbf) the soak's
+        early/late windows, where a standalone run of the identical
+        scenario is flat. Isolation makes `python bench.py` report the
+        same plane a standalone run measures; the persistent
+        compilation cache keeps the fresh process's compile cost near
+        zero."""
+        src = ("import json, sys\n"
+               "from kubedtn_tpu import scenarios\n"
+               "r = getattr(scenarios, sys.argv[1])("
+               "**json.loads(sys.argv[2]))\n"
+               "print('___RESULT___' + json.dumps(r))\n")
+        env = dict(os.environ,
+                   JAX_COMPILATION_CACHE_DIR=os.path.join(
+                       os.path.dirname(os.path.abspath(__file__)),
+                       ".jax_cache"),
+                   JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="1.0")
+        if degraded:
+            env["JAX_PLATFORMS"] = "cpu"
+        p = subprocess.run(
+            [sys.executable, "-c", src, func, json.dumps(kwargs)],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+        for line in reversed(p.stdout.splitlines()):
+            if line.startswith("___RESULT___"):
+                return json.loads(line[len("___RESULT___"):])
+        raise RuntimeError(
+            f"{func} subprocess rc={p.returncode}: "
+            f"{(p.stderr or p.stdout)[-400:]}")
 
-        r = live_plane(pairs=8,
-                       frames_per_wire=8_000 if degraded else 40_000)
+    def run_live_plane():
+        r = _isolated_scenario("live_plane", {
+            "pairs": 8,
+            "frames_per_wire": 8_000 if degraded else 40_000})
         extras["live_plane"] = {
             k: r[k] for k in ("pairs", "frames_per_wire", "frames_per_s",
                               "frames_per_s_best", "rounds_frames_per_s",
-                              "dropped", "tick_errors")
+                              "warmup_rounds", "dropped", "tick_errors")
         }
 
-    SOAK_KEYS = ("shaping", "settle_s", "seconds",
+    SOAK_KEYS = ("shaping", "injector_chunk", "settle_s", "seconds",
                  "sustained_frames_per_s", "worst_window_frames_per_s",
                  "flatness", "windows_frames_per_s",
                  "end_ingress_backlog", "gc_pause_s", "host_steal_s",
-                 "dropped", "tick_errors")
+                 "stage_breakdown", "dropped", "tick_errors",
+                 "stalled_first_attempt")
+
+    def _soak_stall_retry(run):
+        """One re-measure when a SINGLE window collapsed ≥25% below the
+        median while every other window held within 10% of it: that
+        shape is an exogenous host stall (a shared/throttled core lost
+        mid-window — invisible to the recorded gc_pause_s/host_steal_s
+        when it's cgroup-quota throttling), not plane decay, which
+        would show a trend across windows. The stalled measurement is
+        kept in the record as evidence, never silently discarded."""
+        r = run()
+        ws = sorted(r.get("windows_frames_per_s", []))
+        med = statistics.median(ws) if ws else 0.0
+        if (len(ws) >= 4 and med > 0 and ws[0] < 0.75 * med
+                and ws[1] >= 0.9 * med):
+            r2 = run()
+            r2["stalled_first_attempt"] = {
+                k: r[k] for k in ("windows_frames_per_s", "flatness",
+                                  "sustained_frames_per_s")}
+            return r2
+        return r
 
     def run_live_soak():
-        from kubedtn_tpu.scenarios import live_plane_soak
-
-        r = live_plane_soak(pairs=8,
-                            seconds=12.0 if degraded else 25.0)
-        extras["live_soak"] = {k: r[k] for k in SOAK_KEYS}
+        r = _soak_stall_retry(lambda: _isolated_scenario(
+            "live_plane_soak",
+            {"pairs": 8, "seconds": 12.0 if degraded else 25.0}))
+        extras["live_soak"] = {k: r[k] for k in SOAK_KEYS if k in r}
 
     def run_live_soak_tbf():
         # the SAME sustained soak over RATE-LIMITED wires: before the
@@ -541,11 +606,15 @@ def main() -> None:
         # 6.4-32k frames/s was the aggregate ceiling this record is
         # compared against. 2Gbit per wire ≫ offered load, so the
         # bucket never throttles and the number measures the plane.
-        from kubedtn_tpu.scenarios import live_plane_soak
-
-        r = live_plane_soak(pairs=8, rate="2Gbit",
-                            seconds=12.0 if degraded else 25.0)
-        extras["live_soak_tbf"] = {k: r[k] for k in SOAK_KEYS}
+        # chunk=512 keeps the offered rate itself below the shaped
+        # plane's capacity (the phase's design: keep-up under a token
+        # bucket, backlog bounded, not a transport-capacity contest —
+        # the lat soak at the full INJECTOR_CHUNK measures capacity).
+        r = _soak_stall_retry(lambda: _isolated_scenario(
+            "live_plane_soak",
+            {"pairs": 8, "rate": "2Gbit",
+             "seconds": 12.0 if degraded else 25.0, "chunk": 512}))
+        extras["live_soak_tbf"] = {k: r[k] for k in SOAK_KEYS if k in r}
 
     def run_reconverge_10k():
         from kubedtn_tpu.scenarios import reconverge_10k

@@ -192,6 +192,40 @@ class DataPlaneStatsCollector:
             g = CounterMetricFamily(f"kubedtn_dataplane_{name}", doc)
             g.add_metric([], float(values[name]))
             out.append(g)
+        # tick-stage breakdown + pipeline gauges: the observability half
+        # of the pipelined tick engine — where tick time goes (drain /
+        # decide / kernel-dispatch / sync / schedule / release) and how
+        # deep the overlap and the adaptive drain budget currently run
+        bd = plane.stage_breakdown()
+        stage = CounterMetricFamily(
+            "kubedtn_dataplane_stage_seconds",
+            "Cumulative wall seconds spent per tick stage "
+            "(drain=ingress collection, decide=classify+bypass, "
+            "kernel=device dispatch, sync=blocking on completed "
+            "device outputs, schedule=wheel inserts+counters, "
+            "release=due-frame delivery)", labels=["stage"])
+        for k, v in bd["seconds"].items():
+            stage.add_metric([k], float(v))
+        out.append(stage)
+        pipe = bd.get("pipeline", {})
+        for name, key, doc in (
+                ("pipeline_depth", "depth",
+                 "Configured in-flight dispatch ring depth (1 = "
+                 "synchronous tick)"),
+                ("pipeline_inflight", "inflight",
+                 "Shaping dispatches currently in flight on the device"),
+                ("drain_budget", "drain_budget",
+                 "Current adaptive per-wire drain budget "
+                 "(frames per tick)"),
+                ("ingress_backlog", "ingress_backlog",
+                 "Ingress-deque entries the last drain left queued "
+                 "(backpressure signal)"),
+                ("holdback_wires", "holdback_wires",
+                 "Wires with seq-cap residue deferred to the next "
+                 "tick")):
+            g = GaugeMetricFamily(f"kubedtn_dataplane_{name}", doc)
+            g.add_metric([], float(pipe.get(key, 0)))
+            out.append(g)
         return out
 
 

@@ -613,3 +613,27 @@ def shape_steps_tiled(tstate: TiledShapeState, sizes_t: jax.Array,
         tstate, corr=corr, tokens=tokens, t_last=t_last, backlog=backlog,
         count=count)
     return new_tstate, depart, flags
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def flag_counts(flags: jax.Array):
+    """Reduce a [..., R, LANE] flags slab to per-class scalar totals ON
+    DEVICE — the counter face of the fused kernel. Callers that only
+    need traffic accounting (soak loops, the data plane's cumulative
+    counters, bench verification) transfer six scalars instead of the
+    whole int32 slab (~4 B/edge/step), the same no-host-round-trip
+    discipline the live tick applies to its drop masks
+    (runtime._row_counts). DONATES `flags` — it is consumed by the
+    reduction and callers keep nothing else aliased to it.
+
+    Returns {delivered, drop_loss, drop_queue, corrupted, duplicated,
+    reordered} as int32 scalars (device; sync when read)."""
+    out = {}
+    for name, bit in (("delivered", FLAG_DELIVERED),
+                      ("drop_loss", FLAG_DROP_LOSS),
+                      ("drop_queue", FLAG_DROP_QUEUE),
+                      ("corrupted", FLAG_CORRUPTED),
+                      ("duplicated", FLAG_DUPLICATED),
+                      ("reordered", FLAG_REORDERED)):
+        out[name] = ((flags & bit) != 0).sum().astype(jnp.int32)
+    return out

@@ -18,6 +18,21 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.6: shard_map is a top-level export
+    from jax import shard_map
+except ImportError:  # older jax (e.g. 0.4.x): experimental home, where
+    # the check_rep replication checker predates while_loop support
+    # (poisson traffic gen trips it) — modern jax dropped the check, so
+    # disabling it here gives the same semantics on every version
+    import functools as _functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    shard_map = _functools.partial(_shard_map_exp, check_rep=False)
+
+__all__ = ["EDGE_AXIS", "make_mesh", "edge_sharding", "replicated",
+           "init_distributed", "shard_map"]
+
 EDGE_AXIS = "edge"
 
 

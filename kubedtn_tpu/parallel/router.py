@@ -30,14 +30,13 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kubedtn_tpu.models.traffic import TrafficSpec, generate
 from kubedtn_tpu.ops import netem
 from kubedtn_tpu.ops.edge_state import EdgeState
 from kubedtn_tpu.ops.queues import insert_inflight, pop_due, shape_packets
-from kubedtn_tpu.parallel.mesh import EDGE_AXIS
+from kubedtn_tpu.parallel.mesh import EDGE_AXIS, shard_map
 from kubedtn_tpu.router import RouterState, _group_into_lanes
 from kubedtn_tpu.sim import SimState, _add, init_sim
 

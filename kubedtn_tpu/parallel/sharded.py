@@ -22,12 +22,11 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kubedtn_tpu.ops import edge_state as es
 from kubedtn_tpu.ops import netem
-from kubedtn_tpu.parallel.mesh import EDGE_AXIS
+from kubedtn_tpu.parallel.mesh import EDGE_AXIS, shard_map
 
 
 @dataclasses.dataclass(frozen=True)

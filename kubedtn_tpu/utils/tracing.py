@@ -117,6 +117,20 @@ class Tracer:
             self._spans.clear()
 
 
+def stage_shares(stage_s: dict[str, float]) -> dict:
+    """Cumulative per-stage seconds → {seconds, share} readout: the
+    shape every stage-timing consumer (the data plane's
+    stage_breakdown(), the metrics exporter) reports. share is each
+    stage's fraction of total ACCOUNTED time, 0.0 when nothing has been
+    timed yet."""
+    total = sum(stage_s.values())
+    return {
+        "seconds": {k: round(v, 4) for k, v in stage_s.items()},
+        "share": {k: (round(v / total, 3) if total > 0 else 0.0)
+                  for k, v in stage_s.items()},
+    }
+
+
 # process-wide default
 _default = Tracer()
 

@@ -377,6 +377,14 @@ class Daemon:
         # egress flush then falls back to per-frame SendToStream for that
         # peer permanently (runtime._flush_remote)
         self.peer_bulk_ok: dict[str, bool] = {}
+        # ingress-deque entries the last drain_ingress left queued but
+        # COULD drain next call (budget residue only — unrealized wires
+        # wait on the control plane and holdback-skipped wires on the
+        # plane's own buffer, so neither belongs in a signal that makes
+        # the runner shed its sleep or grow its batch). Entry-
+        # denominated like INGRESS_HIGH_WATER (a bulk FrameSeg entry
+        # holds up to ~256 frames), which keeps the gauge O(1) per wire.
+        self.last_drain_backlog = 0
         # optional pcap tap (utils/pcap.CaptureManager) — the
         # observability stand-in for the reference's per-wire libpcap
         # handles (grpcwire.go:398-409); None = zero cost
@@ -767,10 +775,17 @@ class Daemon:
         under the engine lock before shaping (compact() may renumber rows
         between this drain and the snapshot). Wire ids in `skip` are left
         untouched but stay hot — the data plane excludes wires whose
-        previous drain is still in its holdback buffer."""
+        previous drain is still in its holdback buffer.
+
+        `last_drain_backlog` is left holding the entry count this drain
+        had to leave behind but could take next call (budget residue
+        only — the backpressure input of the plane's adaptive batching
+        and sleep-shedding; unrealized-wire and holdback-skipped queues
+        are excluded because ticking harder cannot drain them)."""
         with self._hot_lock:
             hot, self._hot = self._hot, set()
-        out = []
+        out: list = []
+        backlog = 0
         for wire_id in hot:
             if skip is not None and wire_id in skip:
                 with self._hot_lock:
@@ -817,6 +832,7 @@ class Daemon:
                     budget -= 1
             if q:
                 self._remark(wire)  # residue beyond this tick's budget
+                backlog += len(q)
             if parts:
                 # per-protocol counting happens at the DECIDE stage (the
                 # data plane fuses it into the bypass-verdict native
@@ -835,6 +851,7 @@ class Daemon:
                     # list, the shape tests and embedders rely on
                     lens = lens_parts
                 out.append((wire, row, lens, parts))
+        self.last_drain_backlog = backlog
         return out
 
     def deliver_egress_bulk(self, pod_key: str, uid: int,

@@ -893,9 +893,12 @@ def test_tbf_wire_shapes_whole_batch_in_one_tick():
 
 
 def test_tbf_wire_overload_falls_back_to_exact_scan():
-    """An overloaded TBF wire (queue drops) reroutes through the
-    sequential scan mid-tick: seq_slots caps apply, drops are counted,
-    and the frames that DO deliver arrive in order."""
+    """An overloaded TBF wire (queue drops) breaks the max-plus
+    kernel's linearity; _complete re-shapes the affected rows' WHOLE
+    batches with the exact sequential scan (pipelined-engine contract,
+    ARCHITECTURE.md "Pipelined data plane") — every frame is decided in
+    its own tick with no holdback residue, drops are counted, and the
+    frames that DO deliver arrive in order."""
     from kubedtn_tpu.runtime import WireDataPlane
     from kubedtn_tpu.wire import proto as pb
     from kubedtn_tpu.wire.server import Daemon
@@ -924,17 +927,15 @@ def test_tbf_wire_overload_falls_back_to_exact_scan():
     frames = [bytes([i % 251]) * 1500 for i in range(50)]
     wa.ingress.extend(frames)
     shaped = plane.tick(now_s=3.0)
-    # fallback engaged: the scan saw only the first seq_slots frames
-    # (shaped counts DELIVERED frames — queue drops take the rest of
-    # the window), and the residue beyond the cap is held back
-    assert 0 < shaped < 16
-    assert wa.wire_id in plane._holdback
-    assert len(plane._holdback[wa.wire_id][1]) == 34
+    # fallback engaged: the exact scan decided ALL 50 frames this tick
+    # (shaped counts DELIVERED frames — the 50ms queue limit drops the
+    # rest), so nothing waits in holdback for later ticks
+    assert 0 < shaped < 20
+    assert not plane._holdback
     t = 3.0
     for k in range(60):
         t += 0.001
         plane.tick(now_s=t)
-    assert not plane._holdback
     # 50ms TBF queue limit at 12ms/frame: ~4-6 accepted, rest dropped
     delivered = [bytes(f) for f in wb.egress]
     assert 0 < len(delivered) < 20
