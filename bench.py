@@ -616,6 +616,24 @@ def main() -> None:
              "seconds": 12.0 if degraded else 25.0, "chunk": 512}))
         extras["live_soak_tbf"] = {k: r[k] for k in SOAK_KEYS if k in r}
 
+    def run_chaos_soak():
+        # fault-domain evidence: peer flapping at 1 Hz under live load
+        # must lose ZERO frames (breaker + bounded outage buffer +
+        # retry), complete >=1 full breaker recovery cycle, and keep
+        # tick_errors at 0 — the robustness counterpart of the
+        # throughput soaks above
+        r = _isolated_scenario("chaos_soak", {
+            "pairs": 4, "seconds": 6.0 if degraded else 12.0,
+            "offered_frames_per_s": 8_000 if degraded else 20_000})
+        extras["chaos_soak"] = {
+            k: r[k] for k in (
+                "pairs", "seconds", "flap_hz", "offered_frames_per_s",
+                "frames_fed", "frames_delivered", "frames_lost",
+                "windows_frames_per_s",
+                "sustained_under_flap_frames_per_s", "breaker_cycles",
+                "peer_retries", "peer_buffer_dropped", "tick_errors",
+                "forward_errors", "degrade_level_end") if k in r}
+
     def run_reconverge_10k():
         from kubedtn_tpu.scenarios import reconverge_10k
 
@@ -676,6 +694,7 @@ def main() -> None:
     phase("live_plane", run_live_plane)
     phase("live_soak", run_live_soak)
     phase("live_soak_tbf", run_live_soak_tbf)
+    phase("chaos_soak", run_chaos_soak)
     phase("reconverge_10k", run_reconverge_10k)
 
     try:

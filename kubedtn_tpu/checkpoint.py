@@ -18,17 +18,35 @@ both halves for the TPU build:
   distinction as kernel qdiscs surviving a daemon restart vs being
   reinstalled.
 
+Crash consistency (round 7): `save` stages the whole checkpoint in a
+temp directory beside the target — manifest carrying a sha256 per data
+file — fsyncs it, then swaps it into place with atomic renames
+(old → `<path>.prev`, tmp → path). A `kill -9` at ANY instant leaves
+either the new complete checkpoint, the previous complete one (found at
+path or recovered from `.prev`), or nothing valid — never a torn mix of
+generations; rewriting the directory wholesale also means a re-save can
+never leak an earlier generation's `pending_frames.npz`/`sim_state.npz`
+into a later restore. `load`/`load_pending`/`load_sim` verify the
+checksums and raise TYPED errors (`CheckpointCorruptError`) on any
+damage; `load_or_rebuild` turns that into the reference's reconstruction
+fallback instead of dying mid-restore.
+
 Layout of a checkpoint directory:
-  manifest.json   — versioned metadata + engine registries + store records
+  manifest.json   — versioned metadata + engine registries + store
+                    records + per-file sha256 checksums
   edge_state.npz  — EdgeState arrays
   sim_state.npz   — optional SimState arrays (inflight/counters/traffic)
+  pending_frames.npz — optional in-flight delay-line frames
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
+import re
+import shutil
 
 import jax.numpy as jnp
 import numpy as np
@@ -38,7 +56,26 @@ from kubedtn_tpu.ops import edge_state as es
 from kubedtn_tpu.topology.engine import SimEngine
 from kubedtn_tpu.topology.store import TopologyStore
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2  # 2: per-file checksums + atomic directory swap
+
+_PREV_SUFFIX = ".prev"
+_TMP_PREFIX = ".ckpt-tmp-"
+
+
+class CheckpointError(Exception):
+    """A checkpoint could not be used (missing, wrong version, ...)."""
+
+
+class CheckpointMissingError(CheckpointError):
+    """No checkpoint exists at the path (a fresh daemon's first start)
+    — distinct from damage or an unsupported format, which callers must
+    surface rather than silently cold-start over."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A checkpoint exists but is damaged: truncated/unparseable
+    manifest, unreadable npz, or a checksum mismatch. The documented
+    recovery is `rebuild_engine` from the store (`load_or_rebuild`)."""
 
 
 # -- store serialization ----------------------------------------------
@@ -98,6 +135,124 @@ def rebuild_engine(store: TopologyStore, capacity: int = 1024,
     return engine
 
 
+# -- crash-consistent directory plumbing ------------------------------
+
+def _pid_alive(pid: int) -> bool:
+    """Is a process with this pid running (signal-0 probe)?"""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except OSError:
+        return True  # unknown: err on the side of not deleting
+    return True
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _fsync_path(path: str) -> None:
+    """Best-effort fsync of a file or directory (crash durability; not
+    every filesystem supports directory fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _read_manifest(dirpath: str) -> dict:
+    """Parse + structurally validate one directory's manifest, mapping
+    every damage mode to a typed error."""
+    mpath = os.path.join(dirpath, "manifest.json")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except FileNotFoundError as e:
+        try:
+            nonempty = bool(os.listdir(dirpath))
+        except OSError:
+            nonempty = False
+        if nonempty:
+            # data files without a manifest is DAMAGE (a partial
+            # restore or manual deletion), not a fresh start — callers
+            # must surface it, never silently cold-start over it
+            raise CheckpointCorruptError(
+                f"checkpoint directory {dirpath} has data files but no "
+                f"manifest") from e
+        raise CheckpointMissingError(
+            f"no checkpoint manifest at {mpath}") from e
+    except (json.JSONDecodeError, UnicodeDecodeError, OSError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable checkpoint manifest {mpath}: {e}") from e
+    if not isinstance(manifest, dict) or "format_version" not in manifest:
+        raise CheckpointCorruptError(
+            f"checkpoint manifest {mpath} lacks format_version")
+    if manifest["format_version"] not in (1, FORMAT_VERSION):
+        raise CheckpointError(
+            f"unsupported checkpoint version {manifest['format_version']}")
+    return manifest
+
+
+def _resolve_dir(path: str) -> tuple[str, dict]:
+    """The directory actually holding the newest COMPLETE checkpoint for
+    `path`: `path` itself when its manifest is valid, else the
+    `<path>.prev` a crash between save()'s two renames left behind.
+    Deterministic, read-only — load/load_pending/load_sim all resolve
+    through here, so a fallback restore reads one coherent generation."""
+    try:
+        return path, _read_manifest(path)
+    except CheckpointError as primary:
+        prev = path + _PREV_SUFFIX
+        try:
+            manifest = _read_manifest(prev)
+        except CheckpointError:
+            raise primary from None
+        return prev, manifest
+
+
+def _verify_checksum(dirpath: str, manifest: dict, fname: str) -> None:
+    """Raise CheckpointCorruptError when `fname` does not match the
+    manifest's recorded sha256 (v1 manifests carry none — skipped)."""
+    want = manifest.get("checksums", {}).get(fname)
+    if want is None:
+        return
+    fpath = os.path.join(dirpath, fname)
+    try:
+        got = _sha256_file(fpath)
+    except OSError as e:
+        raise CheckpointCorruptError(
+            f"unreadable checkpoint file {fpath}: {e}") from e
+    if got != want:
+        raise CheckpointCorruptError(
+            f"checksum mismatch for {fpath}: manifest {want[:12]}…, "
+            f"file {got[:12]}…")
+
+
+def _load_npz(dirpath: str, manifest: dict, fname: str):
+    """Checksum-verified np.load with npz damage mapped to the typed
+    error (np.load raises half a dozen exception types on truncation)."""
+    _verify_checksum(dirpath, manifest, fname)
+    fpath = os.path.join(dirpath, fname)
+    try:
+        return np.load(fpath)
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"damaged checkpoint array file {fpath}: {e}") from e
+
+
 # -- checkpoint save/load ---------------------------------------------
 
 def _arrays_to_npz(path: str, obj) -> None:
@@ -108,91 +263,193 @@ def _arrays_to_npz(path: str, obj) -> None:
 
 def save(path: str, store: TopologyStore, engine: SimEngine,
          sim=None, dataplane=None) -> None:
-    """Write a checkpoint directory (created if needed). With
-    `dataplane`, in-flight delay-line frames are persisted too
-    (save_pending) so a restarted daemon completes their remaining
-    delays."""
-    os.makedirs(path, exist_ok=True)
-    if dataplane is not None:
-        if getattr(dataplane, "running", False):
-            # a live runner can release exported frames (duplicate on
-            # restore) or shape new ones after the export (lost): the
-            # checkpoint must be a consistent point-in-time cut
-            raise RuntimeError(
-                "stop() the data plane before checkpointing its pending "
-                "frames")
-        save_pending(path, dataplane)
-    else:
-        # a reused checkpoint directory must not keep an earlier save's
-        # pending file: restoring it would re-deliver long-gone frames
-        stale = os.path.join(path, "pending_frames.npz")
-        if os.path.exists(stale):
-            os.remove(stale)
-    manifest = {
-        "format_version": FORMAT_VERSION,
-        "node_ip": engine.node_ip,
-        "capacity": engine.state.capacity,
-        "store": store_records(store),
-        "engine": {
-            "pod_ids": engine._pod_ids,
-            "rows": [[k[0], k[1], v] for k, v in engine._rows.items()],
-            "peer": [[k[0], k[1], v[0], v[1]]
-                     for k, v in engine._peer.items()],
-            "free": engine._free,
-            "alive": sorted(engine._topology_manager),
-        },
-        "has_sim": sim is not None,
-    }
-    with open(os.path.join(path, "manifest.json"), "w") as f:
-        json.dump(manifest, f)
-    _arrays_to_npz(os.path.join(path, "edge_state.npz"), engine.state)
-    if sim is not None:
-        flat = {}
-        for name in ("inflight", "counters", "traffic"):
-            sub = getattr(sim, name)
-            for fld in dataclasses.fields(sub):
-                flat[f"{name}.{fld.name}"] = np.asarray(getattr(sub, fld.name))
-        flat["clock_us"] = np.asarray(sim.clock_us)
-        np.savez_compressed(os.path.join(path, "sim_state.npz"), **flat)
+    """Write a checkpoint directory ATOMICALLY: stage everything in a
+    temp directory beside `path`, record per-file sha256 checksums in
+    the manifest, fsync, then swap into place with renames. A crash at
+    any point leaves the previous complete checkpoint restorable (at
+    `path` or `<path>.prev`); a reused directory can never leak stale
+    `pending_frames.npz`/`sim_state.npz` from an earlier save because
+    the directory is replaced wholesale. With `dataplane`, in-flight
+    delay-line frames are persisted too (save_pending) so a restarted
+    daemon completes their remaining delays."""
+    if dataplane is not None and getattr(dataplane, "running", False):
+        # a live runner can release exported frames (duplicate on
+        # restore) or shape new ones after the export (lost): the
+        # checkpoint must be a consistent point-in-time cut
+        raise RuntimeError(
+            "stop() the data plane before checkpointing its pending "
+            "frames")
+    path = os.path.abspath(path)
+    _CKPT_FILES = {"manifest.json", "edge_state.npz", "sim_state.npz",
+                   "pending_frames.npz"}
+    if (os.path.isdir(path) and os.listdir(path)
+            and not os.path.exists(os.path.join(path, "manifest.json"))
+            and not set(os.listdir(path)) <= _CKPT_FILES):
+        # a manifest-less dir of ONLY checkpoint files is damaged debris
+        # this save may replace; anything else is presumably the user's
+        # and must not be clobbered
+        raise CheckpointError(
+            f"refusing to replace {path}: non-empty directory without a "
+            f"checkpoint manifest")
+    parent = os.path.dirname(path)
+    os.makedirs(parent, exist_ok=True)
+    # sweep staging dirs leaked by CRASHED saves: a crash-looping
+    # daemon must not accumulate one full checkpoint copy per kill
+    # until the volume fills. Exact `<prefix><basename>-<pid>` match
+    # only (a bare prefix match would also hit a sibling checkpoint
+    # named `<basename>-x`), and a pid that is still alive is another
+    # process's LIVE staging — never touched.
+    pat = re.compile(
+        re.escape(f"{_TMP_PREFIX}{os.path.basename(path)}-") + r"(\d+)$")
+    for entry in os.listdir(parent):
+        m = pat.fullmatch(entry)
+        if m is None:
+            continue
+        pid = int(m.group(1))
+        if pid != os.getpid() and _pid_alive(pid):
+            continue
+        shutil.rmtree(os.path.join(parent, entry), ignore_errors=True)
+    tmp = os.path.join(parent,
+                       f"{_TMP_PREFIX}{os.path.basename(path)}-{os.getpid()}")
+    os.makedirs(tmp)
+    try:
+        if dataplane is not None:
+            save_pending(tmp, dataplane)
+        _arrays_to_npz(os.path.join(tmp, "edge_state.npz"), engine.state)
+        if sim is not None:
+            flat = {}
+            for name in ("inflight", "counters", "traffic"):
+                sub = getattr(sim, name)
+                for fld in dataclasses.fields(sub):
+                    flat[f"{name}.{fld.name}"] = np.asarray(
+                        getattr(sub, fld.name))
+            flat["clock_us"] = np.asarray(sim.clock_us)
+            np.savez_compressed(os.path.join(tmp, "sim_state.npz"), **flat)
+        checksums = {
+            fname: _sha256_file(os.path.join(tmp, fname))
+            for fname in sorted(os.listdir(tmp))
+        }
+        manifest = {
+            "format_version": FORMAT_VERSION,
+            "node_ip": engine.node_ip,
+            "capacity": engine.state.capacity,
+            "store": store_records(store),
+            "engine": {
+                "pod_ids": engine._pod_ids,
+                "rows": [[k[0], k[1], v] for k, v in engine._rows.items()],
+                "peer": [[k[0], k[1], v[0], v[1]]
+                         for k, v in engine._peer.items()],
+                "free": engine._free,
+                "alive": sorted(engine._topology_manager),
+            },
+            "has_sim": sim is not None,
+            "checksums": checksums,
+        }
+        mpath = os.path.join(tmp, "manifest.json")
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            try:
+                os.fsync(f.fileno())
+            except OSError:
+                pass
+        for fname in checksums:
+            _fsync_path(os.path.join(tmp, fname))
+        _fsync_path(tmp)
+        # -- atomic swap: each rename is atomic; between them `path` is
+        # briefly absent but `.prev` holds the previous complete
+        # generation, which load() falls back to. When `path` is ABSENT
+        # (recovering from a prior mid-save crash) a leftover `.prev` is
+        # the ONLY complete generation — it must survive until the new
+        # one is installed, so it is pruned only at the end.
+        prev = path + _PREV_SUFFIX
+        if os.path.isdir(path):
+            shutil.rmtree(prev, ignore_errors=True)  # superseded by path
+            os.rename(path, prev)
+        os.rename(tmp, path)
+        _fsync_path(parent)
+        shutil.rmtree(prev, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
 
 
 def load(path: str) -> tuple[TopologyStore, SimEngine]:
-    """Restore (store, engine) from a checkpoint directory."""
-    with open(os.path.join(path, "manifest.json")) as f:
-        manifest = json.load(f)
-    if manifest["format_version"] != FORMAT_VERSION:
-        raise ValueError(
-            f"unsupported checkpoint version {manifest['format_version']}")
+    """Restore (store, engine) from a checkpoint directory, verifying
+    checksums. Falls back to the `<path>.prev` generation a mid-save
+    crash may have left; raises `CheckpointError`/`CheckpointCorruptError`
+    (typed — see `load_or_rebuild` for the reconstruction fallback) when
+    neither generation is usable."""
+    path = os.path.abspath(path)
+    dirpath, manifest = _resolve_dir(path)
 
-    store = restore_store(manifest["store"])
-    engine = SimEngine(store, capacity=manifest["capacity"],
-                       node_ip=manifest["node_ip"])
+    try:
+        store = restore_store(manifest["store"])
+        engine = SimEngine(store, capacity=manifest["capacity"],
+                           node_ip=manifest["node_ip"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"malformed checkpoint manifest in {dirpath}: {e}") from e
 
-    with np.load(os.path.join(path, "edge_state.npz")) as z:
-        engine.state = es.EdgeState(
-            **{name: jnp.asarray(z[name]) for name in z.files})
-        # rebuild the host mirror the bypass guard consults: a restored
-        # shaped link must NOT read as unshaped (that would let same-node
-        # TCP flows skip its netem/TBF chain entirely)
-        shaped = np.flatnonzero(
-            z["active"] & np.asarray(z["props"]).any(axis=1))
+    with _load_npz(dirpath, manifest, "edge_state.npz") as z:
+        try:
+            engine.state = es.EdgeState(
+                **{name: jnp.asarray(z[name]) for name in z.files})
+            # rebuild the host mirror the bypass guard consults: a
+            # restored shaped link must NOT read as unshaped (that would
+            # let same-node TCP flows skip its netem/TBF chain entirely)
+            shaped = np.flatnonzero(
+                z["active"] & np.asarray(z["props"]).any(axis=1))
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"damaged edge_state.npz in {dirpath}: {e}") from e
         engine._shaped_rows = set(int(r) for r in shaped)
 
-    eng = manifest["engine"]
-    engine._pod_ids = dict(eng["pod_ids"])
-    engine._rows = {(p, int(u)): int(r) for p, u, r in eng["rows"]}
-    engine._row_owner = {r: k for k, r in engine._rows.items()}
-    engine._peer = {(p, int(u)): (pp, int(pu))
-                    for p, u, pp, pu in eng["peer"]}
-    engine._free = [int(x) for x in eng["free"]]
-    engine._topology_manager = set(eng["alive"])
+    try:
+        eng = manifest["engine"]
+        engine._pod_ids = dict(eng["pod_ids"])
+        engine._rows = {(p, int(u)): int(r) for p, u, r in eng["rows"]}
+        engine._row_owner = {r: k for k, r in engine._rows.items()}
+        engine._peer = {(p, int(u)): (pp, int(pu))
+                        for p, u, pp, pu in eng["peer"]}
+        engine._free = [int(x) for x in eng["free"]]
+        engine._topology_manager = set(eng["alive"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"malformed engine registries in {dirpath}: {e}") from e
     return store, engine
+
+
+def load_or_rebuild(path: str, store: TopologyStore | None = None,
+                    capacity: int = 1024, node_ip: str = "10.0.0.1"
+                    ) -> tuple[TopologyStore, SimEngine, str]:
+    """`load` with the documented corruption fallback: on any
+    CheckpointError, reconstruct via `rebuild_engine` from `store` (the
+    CR source of truth — the reference's restart rescan) instead of
+    raising mid-restore. Returns (store, engine, source) with source in
+    {"checkpoint", "rebuild"}; re-raises only when no fallback store was
+    provided."""
+    try:
+        s, e = load(path)
+        return s, e, "checkpoint"
+    except CheckpointError as err:
+        if store is None:
+            raise
+        from kubedtn_tpu.utils.logging import fields, get_logger
+
+        get_logger("checkpoint").warning(
+            "checkpoint unusable; rebuilding from store %s",
+            fields(path=path, error=f"{type(err).__name__}: {err}"))
+        return store, rebuild_engine(store, capacity=capacity,
+                                     node_ip=node_ip), "rebuild"
 
 
 def save_pending(path: str, dataplane) -> int:
     """Persist the data plane's in-flight frames (pickle-free npz) —
     the delay-line analogue of kernel qdisc queues surviving a daemon
-    restart in the reference. Returns the frame count."""
+    restart in the reference. Returns the frame count. (Called by
+    `save` against its staging directory; standalone callers lose the
+    atomic-swap guarantee.)"""
     entries = dataplane.export_pending()
     blob = b"".join(frame for _, _, frame, _ in entries)
     offs, lens, pos = [], [], 0
@@ -215,45 +472,80 @@ def save_pending(path: str, dataplane) -> int:
 
 def load_pending(path: str, dataplane, now_s: float | None = None) -> int:
     """Re-schedule checkpointed in-flight frames with their remaining
-    delays. Returns the restored count (0 when the checkpoint carried
-    no pending file)."""
-    p = os.path.join(path, "pending_frames.npz")
-    if not os.path.exists(p):
+    delays (checksum-verified, same-generation as `load`'s fallback
+    resolution). Returns the restored count — 0 when the checkpoint
+    carried no pending file OR no checkpoint exists at all (a fresh
+    daemon's first start); corruption and unsupported formats raise."""
+    try:
+        dirpath, manifest = _resolve_dir(os.path.abspath(path))
+    except CheckpointMissingError:
+        return 0  # no checkpoint at all: nothing pending
+    if not os.path.exists(os.path.join(dirpath, "pending_frames.npz")):
         return 0
-    with np.load(p) as z:
-        keys = bytes(z["pod_keys"]).decode().split("\n") if len(
-            z["pod_keys"]) else []
-        blob = bytes(z["blob"])
-        entries = [
-            (keys[i], int(z["uids"][i]),
-             blob[int(z["offsets"][i]):int(z["offsets"][i])
-                  + int(z["lengths"][i])],
-             float(z["remaining_us"][i]))
-            for i in range(len(z["uids"]))
-        ]
+    with _load_npz(dirpath, manifest, "pending_frames.npz") as z:
+        try:
+            keys = bytes(z["pod_keys"]).decode().split("\n") if len(
+                z["pod_keys"]) else []
+            blob = bytes(z["blob"])
+            entries = [
+                (keys[i], int(z["uids"][i]),
+                 blob[int(z["offsets"][i]):int(z["offsets"][i])
+                      + int(z["lengths"][i])],
+                 float(z["remaining_us"][i]))
+                for i in range(len(z["uids"]))
+            ]
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"damaged pending_frames.npz in {dirpath}: {e}") from e
     return dataplane.restore_pending(entries, now_s=now_s)
 
 
+def consume_pending(path: str) -> None:
+    """Remove the restored generation's pending_frames.npz (from the
+    SAME directory `load_pending` resolved) so a crash before the next
+    graceful checkpoint cannot re-deliver the same frames twice."""
+    try:
+        dirpath, _manifest = _resolve_dir(os.path.abspath(path))
+    except CheckpointError:
+        return  # nothing restorable: nothing to consume
+    p = os.path.join(dirpath, "pending_frames.npz")
+    if os.path.exists(p):
+        os.remove(p)
+
+
 def load_sim(path: str, engine: SimEngine):
-    """Restore the optional SimState against a restored engine."""
+    """Restore the optional SimState against a restored engine
+    (checksum-verified; a save without `sim` leaves no stale
+    sim_state.npz behind — the directory swap is wholesale). None when
+    the checkpoint carries no sim state or no checkpoint exists;
+    corruption and unsupported formats raise."""
     from kubedtn_tpu.models.traffic import TrafficState
     from kubedtn_tpu.ops.queues import EdgeCounters, InFlight
     from kubedtn_tpu.sim import SimState
 
-    p = os.path.join(path, "sim_state.npz")
-    if not os.path.exists(p):
+    try:
+        dirpath, manifest = _resolve_dir(os.path.abspath(path))
+    except CheckpointMissingError:
         return None
-    with np.load(p) as z:
-        def sub(cls, prefix):
-            return cls(**{
-                f.name: jnp.asarray(z[f"{prefix}.{f.name}"])
-                for f in dataclasses.fields(cls)
-            })
+    if not os.path.exists(os.path.join(dirpath, "sim_state.npz")):
+        return None
+    with _load_npz(dirpath, manifest, "sim_state.npz") as z:
+        try:
+            def sub(cls, prefix):
+                return cls(**{
+                    f.name: jnp.asarray(z[f"{prefix}.{f.name}"])
+                    for f in dataclasses.fields(cls)
+                })
 
-        return SimState(
-            edges=engine.state,
-            inflight=sub(InFlight, "inflight"),
-            counters=sub(EdgeCounters, "counters"),
-            traffic=sub(TrafficState, "traffic"),
-            clock_us=jnp.asarray(z["clock_us"]),
-        )
+            return SimState(
+                edges=engine.state,
+                inflight=sub(InFlight, "inflight"),
+                counters=sub(EdgeCounters, "counters"),
+                traffic=sub(TrafficState, "traffic"),
+                clock_us=jnp.asarray(z["clock_us"]),
+            )
+        except CheckpointCorruptError:
+            raise
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"damaged sim_state.npz in {dirpath}: {e}") from e

@@ -175,17 +175,32 @@ def cmd_daemon(args) -> int:
         args.metrics_port = _env_port("HTTP_ADDR", 51112)
 
     ckpt_dir = getattr(args, "checkpoint_dir", None)
+    store = engine = None
     if ckpt_dir:
         from kubedtn_tpu import checkpoint
-    if ckpt_dir and os.path.exists(os.path.join(ckpt_dir, "manifest.json")):
+
         # warm restart: topologies, realized links, and (below) the
-        # delay line's in-flight frames all come back
-        store, engine = checkpoint.load(ckpt_dir)
-        engine.node_ip = args.node_ip
-        log.info("restored from checkpoint %s", fields(
-            path=ckpt_dir, topologies=len(store.list()),
-            links=engine.num_active))
-    else:
+        # delay line's in-flight frames all come back. load() resolves
+        # the .prev generation a mid-save crash may have left; a
+        # CORRUPT checkpoint cold-starts with a warning (clients /
+        # the k8s bridge re-apply the CRs — the reconstruction path)
+        # instead of crash-looping the daemon.
+        try:
+            store, engine = checkpoint.load(ckpt_dir)
+            engine.node_ip = args.node_ip
+            log.info("restored from checkpoint %s", fields(
+                path=ckpt_dir, topologies=len(store.list()),
+                links=engine.num_active))
+        except checkpoint.CheckpointMissingError:
+            pass  # no checkpoint yet: first start
+        except checkpoint.CheckpointError:
+            # corrupt, unsupported version, ... — cold-start LOUDLY so a
+            # discarded warm state is never invisible (the next graceful
+            # save will replace the unusable directory)
+            log.exception("checkpoint unusable; cold-starting %s",
+                          fields(path=ckpt_dir))
+            store = engine = None
+    if store is None:
         store = TopologyStore()
         engine = SimEngine(store, node_ip=args.node_ip)
     daemon = Daemon(engine)
@@ -197,14 +212,23 @@ def cmd_daemon(args) -> int:
         log.info("capture on %s", fields(path=args.capture))
     dataplane = WireDataPlane(daemon)
     if ckpt_dir:
-        n_pending = checkpoint.load_pending(ckpt_dir, dataplane)
-        if n_pending:
-            log.info("restored in-flight frames %s", fields(n=n_pending))
-        # consume the pending file once restored: a crash before the next
-        # graceful checkpoint must NOT re-deliver these frames again
-        stale = os.path.join(ckpt_dir, "pending_frames.npz")
-        if os.path.exists(stale):
-            os.remove(stale)
+        try:
+            n_pending = checkpoint.load_pending(ckpt_dir, dataplane)
+        except checkpoint.CheckpointError:
+            # the file stays on disk: a transient read error (or a
+            # fixed binary) can still restore these frames on the next
+            # start — consuming here would destroy them unrestored
+            log.exception("pending-frame restore failed; continuing "
+                          "without %s", fields(path=ckpt_dir))
+        else:
+            if n_pending:
+                log.info("restored in-flight frames %s",
+                         fields(n=n_pending))
+            # consume the pending file once RESTORED (from the SAME
+            # generation load_pending resolved): a crash before the
+            # next graceful checkpoint must NOT re-deliver these
+            # frames again
+            checkpoint.consume_pending(ckpt_dir)
     registry, hist = make_registry(engine,
                                    sim_counters_fn=dataplane.counters_fn,
                                    dataplane=dataplane)

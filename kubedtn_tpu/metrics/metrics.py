@@ -168,6 +168,14 @@ class DataPlaneStatsCollector:
         ("bulk_unresolved",
          "Bulk-transport frames whose wire id resolved to no wire"),
         ("tick_errors", "Tick failures survived by the runner"),
+        ("peer_forward_retries",
+         "Transient peer-send retry attempts (all peers)"),
+        ("degradations",
+         "Supervisor down-steps of the tick degradation ladder"),
+        ("promotions",
+         "Supervisor re-promotions back up the degradation ladder"),
+        ("watchdog_stalls",
+         "Watchdog observations of a stalled runner heartbeat"),
     )
 
     def __init__(self, plane) -> None:
@@ -186,6 +194,10 @@ class DataPlaneStatsCollector:
             "peer_queue_dropped": plane.peer_queue_dropped,
             "bulk_unresolved": plane.daemon.bulk_unresolved,
             "tick_errors": plane.tick_errors,
+            "peer_forward_retries": plane.peer_retries,
+            "degradations": plane.degradations,
+            "promotions": plane.promotions,
+            "watchdog_stalls": plane.watchdog_stalls,
         }
         out = []
         for name, doc in self.SERIES:
@@ -222,10 +234,56 @@ class DataPlaneStatsCollector:
                  "(backpressure signal)"),
                 ("holdback_wires", "holdback_wires",
                  "Wires with seq-cap residue deferred to the next "
-                 "tick")):
+                 "tick"),
+                ("degrade_level", "degrade_level",
+                 "Degradation-ladder rung (0=full pipeline, 1=depth-1, "
+                 "2=synchronous un-fused)"),
+                ("effective_pipeline_depth", "effective_depth",
+                 "Pipeline depth actually in force after degradation")):
             g = GaugeMetricFamily(f"kubedtn_dataplane_{name}", doc)
             g.add_metric([], float(pipe.get(key, 0)))
             out.append(g)
+        # runner heartbeat age (fault supervision): absent runner = -1
+        hb = GaugeMetricFamily(
+            "kubedtn_dataplane_heartbeat_age_seconds",
+            "Seconds since the runner thread's last loop iteration "
+            "(-1 while no runner is live); the watchdog counts ages "
+            "beyond its timeout in kubedtn_dataplane_watchdog_stalls")
+        age = plane.heartbeat_age_s
+        hb.add_metric([], float(age) if age is not None else -1.0)
+        out.append(hb)
+        # per-peer circuit-breaker / retry / outage-buffer series — the
+        # fault-domain face of the per-peer egress senders
+        peers = plane.peer_fault_stats()
+        if peers:
+            state_g = GaugeMetricFamily(
+                "kubedtn_peer_breaker_state",
+                "Per-peer egress circuit-breaker state "
+                "(0=closed, 1=open, 2=half-open)", labels=["peer"])
+            opens_c = CounterMetricFamily(
+                "kubedtn_peer_breaker_opens",
+                "Cumulative breaker trips (closed/half-open -> open)",
+                labels=["peer"])
+            cycles_c = CounterMetricFamily(
+                "kubedtn_peer_breaker_cycles",
+                "Completed open -> half-open -> closed recovery cycles",
+                labels=["peer"])
+            retries_c = CounterMetricFamily(
+                "kubedtn_peer_forward_retry",
+                "Transient peer-send retry attempts", labels=["peer"])
+            buffered_g = GaugeMetricFamily(
+                "kubedtn_peer_outage_buffered",
+                "Frames held in the peer's bounded outage buffer "
+                "(queued + awaiting retry)", labels=["peer"])
+            for addr, s in peers.items():
+                lab = [addr]
+                state_g.add_metric(lab, float(s["state"]))
+                opens_c.add_metric(lab, float(s["opens"]))
+                cycles_c.add_metric(lab, float(s["cycles"]))
+                retries_c.add_metric(lab, float(s["retries"]))
+                buffered_g.add_metric(lab, float(s["buffered"]))
+            out.extend([state_g, opens_c, cycles_c, retries_c,
+                        buffered_g])
         return out
 
 

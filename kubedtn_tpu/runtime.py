@@ -51,9 +51,9 @@ O(frames), and device work is at most two dispatches:
   out near 25k MESSAGES/s, so the per-frame stream alone can never
   reach kernel rates; vs the reference's unary-per-frame hot loop,
   grpcwire.go:452). A peer that answers UNIMPLEMENTED (a
-  reference-built daemon) permanently falls back to per-frame
-  SendToStream. Ring overflow drops are counted in
-  `counters.dropped_ring`.
+  reference-built daemon) falls back to per-frame SendToStream until
+  the next breaker half-open probe re-tests the bulk path. Ring
+  overflow drops are counted in `counters.dropped_ring`.
 
 Delayed releases are held in the native hierarchical timing wheel
 (native/kubedtn_native.cc, via kubedtn_tpu.native.TimingWheel) — the role
@@ -87,6 +87,24 @@ Round 6 turns the tick into a SOFTWARE PIPELINE:
   saturation) and halves back toward adapt_min_slots when the backlog
   stays empty (tight per-frame latency); the runner sheds its period
   sleep entirely while drainable backlog remains.
+
+Round 7 adds the FAULT-DOMAIN layer (see fault.py, chaos.py,
+ARCHITECTURE.md "Failure domains & recovery"):
+
+- **Peer link resilience**: each per-peer sender retries transient
+  grpc errors with exponential backoff + jitter behind a per-peer
+  circuit breaker (closed → open → half-open probe), its bounded queue
+  doubling as an outage buffer — a short peer flap loses zero frames,
+  and the UNIMPLEMENTED stream-only latch is re-probed on every
+  breaker recovery instead of latching forever.
+- **Tick supervision**: the runner stamps a heartbeat a sidecar
+  watchdog monitors, and repeated tick failures step a degradation
+  ladder — configured depth → depth 1 → synchronous un-fused per-class
+  dispatches — re-promoting after a clean interval. Transitions cross
+  the flush() barrier, so delivery order stays byte-identical
+  (determinism suite). A failed dispatch REQUEUES its drained frames
+  (ingress front / holdback) before surfacing: tick faults degrade
+  throughput, never lose frames.
 """
 
 from __future__ import annotations
@@ -104,7 +122,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubedtn_tpu import native
+from kubedtn_tpu import fault, native
 from kubedtn_tpu.ops import netem
 from kubedtn_tpu.ops.queues import EdgeCounters, init_counters
 from kubedtn_tpu.wire.server import FrameSeg, flatten_frames
@@ -249,36 +267,95 @@ class _PeerSender:
     stalls its own wires (grpcwire.go:386-462); here the unit is the
     peer daemon (frames to one peer share a channel and a coalesced
     SendToBulk stream anyway). The tick thread enqueues and returns;
-    this thread does the blocking RPCs. Overflow beyond MAX_QUEUED
-    frames is dropped and counted (`dropped`) — the same bounded-memory
-    backpressure as the staging ring. Transport: coalesced SendToBulk,
-    falling back permanently to per-frame SendToStream for a peer that
-    answers UNIMPLEMENTED (a reference-built daemon); send errors are
-    counted in daemon.forward_errors, never fatal."""
+    this thread does the blocking RPCs.
+
+    Fault domain (round 7): a transient `grpc.RpcError` REQUEUES the
+    batch and retries with exponential backoff + jitter instead of
+    dropping it, behind a per-peer circuit breaker (closed → open after
+    consecutive failures → one half-open probe → closed). While the
+    breaker is open the queue doubles as a bounded OUTAGE BUFFER, so a
+    short peer flap loses zero frames; overflow beyond MAX_QUEUED frames
+    (queued + retry-pending) is dropped and counted (`dropped`) — the
+    same bounded-memory backpressure as the staging ring. Fatal codes
+    (schema/auth errors retrying cannot fix) drop the batch into
+    daemon.forward_errors as before. Transport: coalesced SendToBulk,
+    falling back to per-frame SendToStream for a peer that answers
+    UNIMPLEMENTED — re-probed (not latched forever) at every breaker
+    half-open probe, so an upgraded peer regains the bulk path. Breaker
+    state and retry counters export through metrics
+    (`kubedtn_peer_breaker_state` et al.)."""
 
     MAX_QUEUED = 262_144  # frames buffered per slow peer (~52MB at 200B)
+    # grpc codes no retry can fix: the batch is counted and dropped
+    _FATAL_CODES = frozenset({"INVALID_ARGUMENT", "NOT_FOUND",
+                              "PERMISSION_DENIED", "UNAUTHENTICATED",
+                              "UNIMPLEMENTED"})
+    # frames per RPC attempt: after an outage the buffer can hold 100k+
+    # frames, and one giant send can outlive ANY fixed deadline while a
+    # live peer is still ingesting the stream — the retry then
+    # re-delivers everything the peer already consumed (measured 2.4×
+    # duplication in the 12s chaos soak before slicing). Bounded slices
+    # advance through the buffer as each is acknowledged, so the
+    # at-least-once ambiguity of a mid-stream deadline is capped at one
+    # slice instead of the whole outage buffer.
+    SEND_SLICE = 8_192
+    # per-coalesced-chunk deadline allowance on top of the daemon's
+    # forward_timeout_s floor: a healthy-but-slow peer gets time
+    # proportional to the attempt's size instead of a spurious
+    # DEADLINE_EXCEEDED (the duplicate-cascade trigger)
+    PER_CHUNK_TIMEOUT_S = 0.02
+    # give-up bound per head slice: a slice that fails DETERMINISTICALLY
+    # with a nominally-transient code (RESOURCE_EXHAUSTED from an
+    # oversized message, INTERNAL from a peer handler bug) must not pin
+    # the buffer and wedge the peer's egress forever. Breaker cooldowns
+    # gate the attempt rate, so a genuinely dead peer takes ~10+ minutes
+    # of outage to exhaust this — flaps never come close.
+    MAX_SLICE_RETRIES = 64
+    # re-test a stream-only (UNIMPLEMENTED) latch this often even with
+    # no outage: a peer upgraded during a quiet window must regain the
+    # bulk path without waiting for a breaker cycle; a failed re-probe
+    # costs one immediate UNIMPLEMENTED answer per interval
+    BULK_REPROBE_S = 30.0
 
-    def __init__(self, daemon, addr: str) -> None:
+    def __init__(self, daemon, addr: str,
+                 breaker: fault.CircuitBreaker | None = None,
+                 backoff: fault.Backoff | None = None) -> None:
         self.daemon = daemon
         self.addr = addr
         self._batches: deque[list] = deque()
-        self._queued = 0
+        self._queued = 0       # frames waiting in _batches
+        self._pending = 0      # frames drained into the retry buffer
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._empty = threading.Event()
         self._empty.set()
         self._stopping = False
+        self._interrupt = threading.Event()  # cuts backoff/breaker waits
         self.dropped = 0
+        self.retries = 0     # transient-failure retry attempts
+        self.sent = 0        # frames delivered to the peer
+        self._bulk_reprobe_at = 0.0  # next idle re-test of the latch
+        self.breaker = (breaker if breaker is not None
+                        else fault.CircuitBreaker())
+        self._backoff = (backoff if backoff is not None
+                         else fault.Backoff())
+        self._warn = fault.RateLimitedLog(min_interval_s=1.0)
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"wire-egress-{addr}")
         self._thread.start()
 
+    @property
+    def buffered(self) -> int:
+        """Frames currently held (queued + awaiting retry) — the outage
+        buffer's fill level."""
+        return self._queued + self._pending
+
     def enqueue(self, packets: list) -> int:
         """Queue one tick's packets for this peer; never blocks. Returns
         how many were accepted (the rest are dropped and counted)."""
         with self._lock:
-            room = self.MAX_QUEUED - self._queued
+            room = self.MAX_QUEUED - self._queued - self._pending
             if room <= 0:
                 self.dropped += len(packets)
                 return 0
@@ -296,6 +373,7 @@ class _PeerSender:
     def request_stop(self) -> None:
         self._stopping = True
         self._wake.set()
+        self._interrupt.set()
 
     def join(self, deadline: float) -> None:
         # keep re-arming the wake until the thread exits: a single set()
@@ -311,59 +389,164 @@ class _PeerSender:
         self.request_stop()
         self.join(time.monotonic() + timeout_s)
 
+    def _drop_pending(self, packets: list, to_errors: bool,
+                      remaining: int = 0) -> None:
+        """Give up on (part of) the retry buffer: count the loss
+        (forward_errors for fatal codes, `dropped` for a shutdown with
+        the peer still down), record the `remaining` retry-buffer
+        frames the caller keeps, and release wait_empty callers only
+        when truly nothing is left."""
+        if to_errors:
+            self.daemon.count_forward_errors(len(packets))
+        with self._lock:
+            # `dropped` shares the lock with enqueue()'s increments —
+            # an unlocked read-modify-write here would lose counts
+            if not to_errors:
+                self.dropped += len(packets)
+            self._pending = remaining
+            if self._stopping:
+                # frames still queued behind the give-up are lost with
+                # the thread: counted, never silent
+                while self._batches:
+                    self.dropped += len(self._batches.popleft())
+                self._queued = 0
+            if not remaining and not self._batches:
+                self._empty.set()
+
     def _run(self) -> None:
         import grpc
 
+        from kubedtn_tpu.utils.logging import fields, get_logger
         from kubedtn_tpu.wire import proto as pb
 
+        log = get_logger("wire-egress")
         daemon = self.daemon
         addr = self.addr
         chunk = WireDataPlane.BULK_CHUNK
+        pending: list = []  # retry buffer: drained but not yet delivered
+        slice_attempts = 0  # failures of the CURRENT head slice
         while True:
-            self._wake.wait()
-            # drain the whole backlog into one send: frames queued while
-            # the peer was slow coalesce into fewer messages
-            packets: list = []
-            with self._lock:
-                self._wake.clear()
-                while self._batches:
-                    packets.extend(self._batches.popleft())
-                self._queued = 0
-                if not packets:
-                    self._empty.set()
-            if not packets:
+            if not pending:
+                self._wake.wait()
+                # drain the whole backlog into one send: frames queued
+                # while the peer was slow coalesce into fewer messages
+                with self._lock:
+                    self._wake.clear()
+                    while self._batches:
+                        pending.extend(self._batches.popleft())
+                    self._queued = 0
+                    self._pending = len(pending)
+                    if not pending:
+                        self._empty.set()
+                if not pending:
+                    if self._stopping:
+                        return
+                    continue
+            if not self.breaker.allow():
                 if self._stopping:
+                    # orderly shutdown must not hang on a dead peer's
+                    # cooldown: the buffered frames are lost and counted
+                    self._drop_pending(pending, to_errors=False)
                     return
+                # breaker OPEN: park until the half-open probe is due
+                # (or a stop request), without dropping anything — the
+                # queue is the bounded outage buffer
+                self._interrupt.wait(
+                    min(max(self.breaker.time_to_probe(), 0.005), 0.25))
+                self._interrupt.clear()
                 continue
+            if self.breaker.state == fault.HALF_OPEN:
+                # recovery probe: a restarted/upgraded peer may speak
+                # the coalesced bulk transport again — the stream-only
+                # latch is re-tested here, never held forever
+                daemon.reset_peer_bulk(addr)
+            elif (not daemon.peer_bulk_ok.get(addr, True)
+                    and time.monotonic() >= self._bulk_reprobe_at):
+                # periodic re-test with NO outage: an upgrade during a
+                # quiet window must not leave the peer latched to the
+                # per-frame stream until the next breaker cycle
+                daemon.reset_peer_bulk(addr)
+            sl = pending[:self.SEND_SLICE]
+            n_chunks = -(-len(sl) // chunk)
+            timeout = (daemon.forward_timeout_s
+                       + self.PER_CHUNK_TIMEOUT_S * n_chunks)
             try:
                 sent = False
                 if daemon.peer_bulk_ok.get(addr, True):
                     chunks = [
-                        pb.PacketBatch(packets=packets[i:i + chunk])
-                        for i in range(0, len(packets), chunk)]
+                        pb.PacketBatch(packets=sl[i:i + chunk])
+                        for i in range(0, len(sl), chunk)]
                     try:
                         daemon._peer_wire_client(addr).SendToBulk(
-                            iter(chunks),
-                            timeout=daemon.forward_timeout_s)
+                            iter(chunks), timeout=timeout)
                         sent = True
                     except grpc.RpcError as e:
                         if e.code() != grpc.StatusCode.UNIMPLEMENTED:
                             raise
-                        # reference-built peer: per-frame stream forever
+                        # reference-built peer: per-frame stream until
+                        # the next breaker probe (or periodic idle
+                        # re-probe) re-tests the bulk path
                         daemon.peer_bulk_ok[addr] = False
+                        self._bulk_reprobe_at = (time.monotonic()
+                                                 + self.BULK_REPROBE_S)
                 if not sent:
                     daemon._peer_wire_client(addr).SendToStream(
-                        iter(packets), timeout=daemon.forward_timeout_s)
-            except Exception:
-                # locked add: N sender threads (plus the per-frame
-                # forward path) update this counter concurrently
-                daemon.count_forward_errors(len(packets))
-            finally:
+                        iter(sl), timeout=timeout)
+            except Exception as e:
+                code = None
+                if isinstance(e, grpc.RpcError):
+                    try:
+                        code = e.code()
+                    except Exception:
+                        code = None
+                fatal = (not isinstance(e, grpc.RpcError)
+                         or (code is not None
+                             and code.name in self._FATAL_CODES))
+                self.breaker.record_failure()
+                fire, suppressed = self._warn.ready()
+                if fire:
+                    # the failing peer and its grpc status, rate-limited
+                    # — a flapping peer at tick cadence must not emit
+                    # hundreds of lines/s, but must never fail silently
+                    log.warning("peer send failed %s", fields(
+                        peer=addr,
+                        code=(code.name if code is not None
+                              else type(e).__name__),
+                        frames=len(sl), fatal=fatal,
+                        breaker=fault.STATE_NAMES[self.breaker.state],
+                        retries=self.retries, suppressed=suppressed))
+                slice_attempts += 1
+                if fatal or slice_attempts >= self.MAX_SLICE_RETRIES:
+                    # only the failing slice is dropped (fatal code, or
+                    # a deterministic failure that exhausted its retry
+                    # budget); the rest of the buffer still gets its
+                    # own attempts
+                    pending = pending[len(sl):]
+                    self._drop_pending(sl, to_errors=True,
+                                       remaining=len(pending))
+                    slice_attempts = 0
+                    self._backoff.reset()
+                    continue
+                if self._stopping:
+                    self._drop_pending(pending, to_errors=False)
+                    return
+                # transient: keep the slice, back off, try again
+                self.retries += 1
+                self._interrupt.wait(self._backoff.next_delay())
+                self._interrupt.clear()
+                continue
+            # slice delivered: advance through the buffer
+            self.breaker.record_success()
+            slice_attempts = 0
+            self._backoff.reset()
+            self.sent += len(sl)
+            pending = pending[len(sl):]
+            with self._lock:
+                self._pending = len(pending)
                 # "empty" means queue drained AND nothing in flight —
                 # wait_empty callers (tests, shutdown) need the RPC done
-                with self._lock:
-                    if not self._batches:
-                        self._empty.set()
+                if not pending and not self._batches:
+                    self._empty.set()
 
 
 class _GCTuner:
@@ -451,39 +634,28 @@ def _with_dyn(state, dyn):
         corr=dyn[3], pkt_count=dyn[4])
 
 
-@partial(jax.jit, static_argnames=("has_seq", "has_tbf", "has_ind",
-                                   "has_dyn"))
-def _fused_tick(state, dyn, key, elapsed_us, seq_args, tbf_args,
-                ind_args, *, has_seq, has_tbf, has_ind, has_dyn):
-    """One tick's whole device program in ONE dispatch: per-tick key
-    split, epoch roll, the three shaping-kernel classes (each over its
-    gathered [R, K] batch), the TBF accepted-row state write-back, and
-    the per-row counter reductions. `*_args` are (row_idx, sizes,
-    valid) triples or None; the static has_* flags pick the traced
-    branches (one executable per class mix, cached). `dyn` (when
-    has_dyn) overrides the dynamic columns with the previous in-flight
-    tick's chained outputs — possibly still computing; XLA sequences
-    the dependency without a host sync.
-
-    Returns (key', sub, dyn', outs) with outs[kind] =
-    (delivered [R,K], depart_us [R,K], loss [R], queue [R], corrupt [R]
-    [, fallback [R] for tbf]); `sub` seeds the completion-side TBF
-    fallback re-shape."""
-    if has_dyn:
-        state = _with_dyn(state, dyn)
-    key, sub = jax.random.split(key)
+def _roll_clocks(state, elapsed_us):
+    """Advance the persistent shaping clocks by the wall time since the
+    last dispatched shaping (identity when elapsed_us == 0): the token
+    buckets refill with real time before the batch shapes."""
     floor = jnp.float32(-1e7)
-    # advance the persistent shaping clocks by the wall time since the
-    # last dispatched shaping (identity when elapsed_us == 0): the token
-    # buckets refill with real time before this batch shapes
-    state = dataclasses.replace(
+    return dataclasses.replace(
         state,
         t_last=jnp.maximum(state.t_last - elapsed_us, floor),
         backlog_until=jnp.maximum(state.backlog_until - elapsed_us,
                                   floor))
-    outs = {}
-    if has_tbf:
-        rows, sizes, valid = tbf_args
+
+
+def _shape_class(state, kind: str, args, sub):
+    """One kernel class's shaping + dynamic-state write-back — the
+    SINGLE source of truth traced by both `_fused_tick` (all classes in
+    one dispatch) and `_class_tick` (the degradation ladder's un-fused
+    per-class dispatches): the two paths stay byte-identical by
+    construction, not by hand-synchronized copies. Returns
+    (state', out) with out = (delivered [R,K], depart_us [R,K],
+    loss [R], queue [R], corrupt [R] [, fallback [R] for tbf])."""
+    rows, sizes, valid = args
+    if kind == "tbf":
         res, tok_row, dep_row, delta, hacc, fbk = \
             netem.shape_slots_tbf_nodonate(state, rows, sizes, valid,
                                            jax.random.fold_in(sub, 2))
@@ -503,20 +675,65 @@ def _fused_tick(state, dyn, key, elapsed_us, seq_args, tbf_args,
                 keep(dep_row, state.backlog_until[rows]), mode="drop"),
             pkt_count=state.pkt_count.at[rows].add(
                 jnp.where(apply, delta, 0), mode="drop"))
-        outs["tbf"] = (res.delivered, res.depart_us, *_row_counts(res),
+        return state, (res.delivered, res.depart_us, *_row_counts(res),
                        fbk)
-    if has_seq:
-        rows, sizes, valid = seq_args
+    if kind == "seq":
         state, res = netem.shape_slots_nodonate(
             state, rows, sizes, valid, jax.random.fold_in(sub, 0))
-        outs["seq"] = (res.delivered, res.depart_us, *_row_counts(res))
+        return state, (res.delivered, res.depart_us, *_row_counts(res))
+    res, new_count = netem.shape_slots_indep_nodonate(
+        state, rows, sizes, valid, jax.random.fold_in(sub, 1))
+    state = dataclasses.replace(state, pkt_count=new_count)
+    return state, (res.delivered, res.depart_us, *_row_counts(res))
+
+
+@partial(jax.jit, static_argnames=("has_seq", "has_tbf", "has_ind",
+                                   "has_dyn"))
+def _fused_tick(state, dyn, key, elapsed_us, seq_args, tbf_args,
+                ind_args, *, has_seq, has_tbf, has_ind, has_dyn):
+    """One tick's whole device program in ONE dispatch: per-tick key
+    split, epoch roll, the three shaping-kernel classes (each over its
+    gathered [R, K] batch), the TBF accepted-row state write-back, and
+    the per-row counter reductions. `*_args` are (row_idx, sizes,
+    valid) triples or None; the static has_* flags pick the traced
+    branches (one executable per class mix, cached). `dyn` (when
+    has_dyn) overrides the dynamic columns with the previous in-flight
+    tick's chained outputs — possibly still computing; XLA sequences
+    the dependency without a host sync.
+
+    Returns (key', sub, dyn', outs) with outs[kind] as documented on
+    `_shape_class`; `sub` seeds the completion-side TBF fallback
+    re-shape."""
+    if has_dyn:
+        state = _with_dyn(state, dyn)
+    key, sub = jax.random.split(key)
+    state = _roll_clocks(state, elapsed_us)
+    outs = {}
+    if has_tbf:
+        state, outs["tbf"] = _shape_class(state, "tbf", tbf_args, sub)
+    if has_seq:
+        state, outs["seq"] = _shape_class(state, "seq", seq_args, sub)
     if has_ind:
-        rows, sizes, valid = ind_args
-        res, new_count = netem.shape_slots_indep_nodonate(
-            state, rows, sizes, valid, jax.random.fold_in(sub, 1))
-        state = dataclasses.replace(state, pkt_count=new_count)
-        outs["ind"] = (res.delivered, res.depart_us, *_row_counts(res))
+        state, outs["ind"] = _shape_class(state, "ind", ind_args, sub)
     return key, sub, _dyn_of(state), outs
+
+
+@partial(jax.jit, static_argnames=("kind", "has_dyn"))
+def _class_tick(state, dyn, sub, elapsed_us, args, *, kind, has_dyn):
+    """One kernel class's slice of `_fused_tick`, dispatched on its own
+    — the degradation ladder's synchronous un-fused mode (level 2). The
+    caller chains the classes in the fused program's order (tbf → seq →
+    ind) with `dyn` carrying each class's write-backs and the SAME
+    per-tick `sub` / per-class fold_in constants; both paths trace the
+    shared `_shape_class`, so the outputs stay byte-identical to the
+    fused dispatch (the determinism suite pins this). `elapsed_us` must
+    be the tick's clock roll on the first class and 0 on the rest (the
+    roll is idempotent at 0)."""
+    if has_dyn:
+        state = _with_dyn(state, dyn)
+    state = _roll_clocks(state, elapsed_us)
+    state, out = _shape_class(state, kind, args, sub)
+    return _dyn_of(state), out
 
 
 def _pad_rows(n: int) -> int:
@@ -745,6 +962,45 @@ class WireDataPlane:
         self._bl_win: deque[int] = deque(maxlen=4)
         self.last_backlog = 0  # drainable frames left after the last tick
         self._gc_held = False
+        # -- fault-domain supervision (round 7) ------------------------
+        # optional ChaosInjector (tests / bench chaos soak); consulted
+        # at the head of every dispatch when set
+        self.chaos = None
+        # dispatch-failure requeue bookkeeping: what the in-progress
+        # dispatch holds and whether its frames passed the decide stage
+        # (single tick thread under _tick_lock)
+        self._disp_items: list | None = None
+        self._disp_decided = False
+        # graceful-degradation ladder: 0 = configured pipeline depth,
+        # 1 = depth-1 (overlap off), 2 = synchronous un-fused per-class
+        # dispatches. The runner's supervisor steps DOWN one level after
+        # degrade_after consecutive tick failures and back UP after a
+        # clean promote_after_s; every transition crosses the flush()
+        # barrier so delivery order stays byte-identical (determinism
+        # suite).
+        self.degrade_level = 0
+        self.degrade_after = 3
+        self.promote_after_s = 5.0
+        self.degradations = 0   # cumulative down-steps
+        self.promotions = 0     # cumulative up-steps
+        self._consec_fail = 0
+        self._last_fail_s: float | None = None
+        self._last_transition_s: float | None = None
+        # heartbeat watchdog over the runner thread: the runner stamps
+        # _heartbeat_s every loop; a sidecar thread counts (and logs,
+        # rate-limited) stalls beyond watchdog_timeout_s
+        self.watchdog_timeout_s = 5.0
+        self.watchdog_stalls = 0
+        self._heartbeat_s: float | None = None
+        self._watchdog_thread: threading.Thread | None = None
+        self._watchdog_stop = threading.Event()
+        # the watchdog arms only after the runner's FIRST completed
+        # tick, and DISARMS for any tick that dispatches a jit bucket
+        # this plane has not traced before: compiles take tens of
+        # seconds on a cold cache — warm-up, not a stall. The runner
+        # re-arms after each completed tick.
+        self._watchdog_armed = False
+        self._seen_buckets: set = set()
 
     # -- bypass --------------------------------------------------------
 
@@ -815,6 +1071,24 @@ class WireDataPlane:
         with self._tick_lock:
             return self._tick_inner(now_s)
 
+    def _complete_or_requeue(self, job: _ShapeJob) -> int:
+        """_complete with the zero-frame-loss guarantee: a completion
+        failure (a device error surfacing at the sync point — the very
+        failure mode the degradation ladder exists for) requeues the
+        job's drained frames into the holdback buffer before
+        propagating. The frames re-shape on a later tick; under a
+        cascading device failure their relative order across failed
+        jobs is best-effort, but nothing is lost."""
+        try:
+            return self._complete(job)
+        except Exception:
+            # the sync point (np.asarray of the device outputs) fails
+            # before any wheel scheduling, so requeueing the whole job
+            # cannot double-schedule; the later failure points are pure
+            # host bookkeeping
+            self._requeue_failed(job.batches, True)
+            raise
+
     def flush(self) -> int:
         """Pipeline barrier: complete every in-flight shaping dispatch
         and return the frames shaped. Everything that reads or rewrites
@@ -825,7 +1099,8 @@ class WireDataPlane:
         with self._tick_lock:
             shaped = 0
             while self._inflight:
-                shaped += self._complete(self._inflight.popleft())
+                shaped += self._complete_or_requeue(
+                    self._inflight.popleft())
             # every write-back landed: the engine is current again, so
             # the next dispatch restarts the chain from engine state
             self._pipe_state = None
@@ -982,8 +1257,10 @@ class WireDataPlane:
         # Explicit-clock ticks always drain at max_slots (tests rely on
         # whole-batch single-tick drains) and run SYNCHRONOUS unless
         # pipeline_explicit_clock opts in; runner ticks use the adaptive
-        # budget and keep up to depth-1 dispatches in flight.
-        pipelined = self.pipeline_depth > 1 and (
+        # budget and keep up to depth-1 dispatches in flight. The
+        # degradation ladder caps the effective depth at 1 below level 0.
+        depth = self.effective_pipeline_depth
+        pipelined = depth > 1 and (
             not explicit or self.pipeline_explicit_clock)
         budget = self.max_slots if explicit else self._drain_budget
         t0 = time.perf_counter()
@@ -1007,16 +1284,17 @@ class WireDataPlane:
         # dispatched) drains the ring completely, so tail frames never
         # wait on traffic that may not come.
         shaped = 0
-        limit = (self.pipeline_depth - 1
+        limit = (depth - 1
                  if pipelined and dispatched else 0)
         while len(self._inflight) > limit:
-            shaped += self._complete(self._inflight.popleft())
+            shaped += self._complete_or_requeue(self._inflight.popleft())
         if self._need_resync and self._inflight:
             # a TBF fallback re-shape rewrote rows a newer in-flight
             # dispatch shaped against: drain the pipeline so the next
             # dispatch reads the corrected engine state
             while self._inflight:
-                shaped += self._complete(self._inflight.popleft())
+                shaped += self._complete_or_requeue(
+                    self._inflight.popleft())
         self._need_resync = False
         if not self._inflight:
             self._pipe_state = None
@@ -1060,6 +1338,8 @@ class WireDataPlane:
         out["ticks"] = self.ticks
         out["pipeline"] = {
             "depth": self.pipeline_depth,
+            "effective_depth": self.effective_pipeline_depth,
+            "degrade_level": self.degrade_level,
             "inflight": len(self._inflight),
             "drain_budget": self._drain_budget,
             "ingress_backlog": self.last_backlog,
@@ -1067,15 +1347,159 @@ class WireDataPlane:
         }
         return out
 
+    # -- fault-domain supervision --------------------------------------
+
+    @property
+    def effective_pipeline_depth(self) -> int:
+        """Configured depth at ladder level 0; 1 on any degraded rung."""
+        return self.pipeline_depth if self.degrade_level == 0 else 1
+
+    @property
+    def heartbeat_age_s(self) -> float | None:
+        """Seconds since the runner's last loop iteration (None while no
+        runner is live) — the watchdog's stall signal, exported for
+        metrics."""
+        hb = self._heartbeat_s
+        return None if hb is None else time.monotonic() - hb
+
+    def attach_chaos(self, injector) -> None:
+        """Wire a chaos.ChaosInjector into this plane's fault domains:
+        the per-peer egress RPCs and the dispatch hook."""
+        self.chaos = injector
+        injector.install_peer_faults(self.daemon)
+
+    def force_degrade(self, level: int) -> None:
+        """Step the degradation ladder to `level` (0 = full pipeline,
+        1 = depth-1, 2 = synchronous un-fused). Crosses the flush()
+        barrier under the tick lock, so the transition never splits an
+        in-flight dispatch — delivery order stays byte-identical to a
+        run pinned at either level (determinism suite)."""
+        level = max(0, min(2, int(level)))
+        with self._tick_lock:
+            if level == self.degrade_level:
+                return
+            self.flush()
+            if level > self.degrade_level:
+                self.degradations += 1
+            else:
+                self.promotions += 1
+            prev, self.degrade_level = self.degrade_level, level
+            self._last_transition_s = time.monotonic()
+        from kubedtn_tpu.utils.logging import fields, get_logger
+
+        get_logger("dataplane").warning(
+            "degradation ladder %s", fields(
+                from_level=prev, to_level=level,
+                effective_depth=self.effective_pipeline_depth,
+                tick_errors=self.tick_errors))
+
+    def _safe_supervise(self, ok: bool) -> None:
+        """_supervise that can never kill the runner: a ladder
+        transition crosses flush(), whose completions can re-raise the
+        very device error being supervised — an exception escaping here
+        inside the runner's `except` handler would end the thread (and
+        the data plane) silently. The transition retries on a later
+        tick; _complete_or_requeue already preserved the frames."""
+        try:
+            self._supervise(ok)
+        except Exception:
+            from kubedtn_tpu.utils.logging import fields, get_logger
+
+            get_logger("dataplane").exception(
+                "supervisor transition failed (continuing) %s",
+                fields(degrade_level=self.degrade_level,
+                       tick_errors=self.tick_errors))
+
+    def _supervise(self, ok: bool) -> None:
+        """Runner-loop supervisor: degrade_after consecutive tick
+        failures step the ladder down one rung (2 → 1 → synchronous
+        un-fused); a clean promote_after_s interval re-promotes one rung
+        at a time back toward the configured pipeline."""
+        now = time.monotonic()
+        if ok:
+            self._consec_fail = 0
+            if (self.degrade_level > 0
+                    and (self._last_fail_s is None
+                         or now - self._last_fail_s
+                         >= self.promote_after_s)
+                    and (self._last_transition_s is None
+                         or now - self._last_transition_s
+                         >= self.promote_after_s)):
+                self.force_degrade(self.degrade_level - 1)
+        else:
+            self._last_fail_s = now
+            self._consec_fail += 1
+            if (self._consec_fail >= self.degrade_after
+                    and self.degrade_level < 2):
+                self._consec_fail = 0
+                self.force_degrade(self.degrade_level + 1)
+
+    def peer_fault_stats(self) -> dict[str, dict]:
+        """Per-peer breaker / retry / outage-buffer snapshot (the
+        metrics exporter's feed). Snapshot via list(): the tick thread
+        inserts senders on first traffic to a new peer."""
+        out: dict[str, dict] = {}
+        for addr, s in list(self._peer_senders.items()):
+            b = s.breaker
+            out[addr] = {
+                "state": b.state,
+                "opens": b.opens,
+                "half_opens": b.half_opens,
+                "closes": b.closes,
+                "cycles": b.cycles,
+                "retries": s.retries,
+                "sent": s.sent,
+                "buffered": s.buffered,
+                "dropped": s.dropped,
+            }
+        return out
+
+    @property
+    def peer_retries(self) -> int:
+        """Transient peer-send retry attempts, summed over peers."""
+        return sum(s.retries for s in list(self._peer_senders.values()))
+
+    def _requeue_failed(self, items, decided: bool) -> None:
+        """Put a failed dispatch's frames back where the next tick will
+        shape them — a tick failure must degrade, never lose frames.
+        Already-decided frames (and holdback residue) go to the holdback
+        buffer so they keep their count-and-decide-exactly-once verdict;
+        fresh undecided frames return to the FRONT of their ingress
+        deque (still FIFO, they will classify on their next drain)."""
+        if not items:
+            return
+        for it in items:
+            if len(it) == 5:
+                wire, _row, lens, fr, pd = it
+            else:
+                wire, lens, fr, pd = it
+            if pd or decided:
+                prev = self._holdback.get(wire.wire_id)
+                if prev is not None:
+                    # these frames were drained before anything already
+                    # re-buffered this tick: prepend keeps FIFO
+                    self._holdback[wire.wire_id] = (
+                        wire, _cat_lens(lens, prev[1]),
+                        list(fr) + list(prev[2]))
+                else:
+                    self._holdback[wire.wire_id] = (wire, lens, list(fr))
+            else:
+                wire.ingress.extendleft(reversed(fr))
+        if self._holdback:
+            self._wake.set()
+
     def _dispatch(self, drained, now_s: float) -> _ShapeJob | None:
         """Front half of one tick's shaping: classify + bypass-decide on
         the host, then issue the whole tick's device program as ONE
-        async _fused_tick call. The returned _ShapeJob holds the device
+        async _fused_tick call (or per-class synchronous dispatches at
+        degradation level 2). The returned _ShapeJob holds the device
         outputs as futures — this path never blocks on the device, so
         tick N's drain/decide overlaps tick N-1's shaping. ONE native
         bypass decision for every frame, O(batches) host work;
-        write-back/scheduling/counters happen at _complete()."""
-        engine = self.engine
+        write-back/scheduling/counters happen at _complete(). Any
+        failure requeues the drained frames (ingress front / holdback)
+        before propagating, so a dispatch fault costs a tick, not the
+        frames."""
         # holdback (seq-cap residue from the previous tick) shapes FIRST,
         # ahead of freshly drained frames, and skips the bypass decision
         # — those frames were classified and decided when first drained
@@ -1086,6 +1510,22 @@ class WireDataPlane:
                 inputs.append((wire, lens, fr, True))
         for wire, _row, lens, frames_list in drained:
             inputs.append((wire, lens, frames_list, False))
+        self._disp_items = inputs
+        self._disp_decided = False
+        try:
+            return self._dispatch_inner(inputs, now_s)
+        except Exception:
+            self._requeue_failed(self._disp_items, self._disp_decided)
+            raise
+        finally:
+            self._disp_items = None
+
+    def _dispatch_inner(self, inputs, now_s: float) -> _ShapeJob | None:
+        if self.chaos is not None:
+            # deterministic fault injection (tests / chaos soak): raising
+            # here exercises the requeue path plus the supervisor
+            self.chaos.on_dispatch()
+        engine = self.engine
         # -- snapshot under the engine lock (no device work) --------
         with engine._lock:
             state = engine.state  # flushes pending control-plane ops
@@ -1124,7 +1564,7 @@ class WireDataPlane:
             if (self._pipe_state is not None
                     and self._pipe_state[0].shape[0] != E):
                 while self._inflight:
-                    self._complete(self._inflight.popleft())
+                    self._complete_or_requeue(self._inflight.popleft())
                 self._pipe_state = None
             # Rows the control plane re-initialized since the last
             # dispatch: older in-flight write-backs must not resurrect
@@ -1169,6 +1609,9 @@ class WireDataPlane:
                 wire.ingress.extendleft(reversed(frames_list))
         if not batches:
             return None
+        # vanished-row frames are requeued above; from here a failure
+        # requeues the surviving batches instead of the raw inputs
+        self._disp_items = batches
 
         # -- vectorized bypass decision OUTSIDE the engine lock --------
         # (eBPF sockops/redir semantics; no native flow table → no
@@ -1231,9 +1674,18 @@ class WireDataPlane:
                 np.concatenate(cnt_parts))
             if class_stats:
                 self.daemon.frame_stats.update(class_stats)
+            # every frame has now taken its exactly-once classify/count
+            # verdict: a later failure must requeue via holdback, never
+            # back through the decide stage
+            self._disp_decided = True
             if decide.any():
+                # split FIRST (pure host work), deliver after: a failure
+                # mid-delivery then requeues only the kept (shaped-path)
+                # batches — already-delivered bypass frames are never
+                # requeued for a duplicate delivery
                 pos = 0
                 kept_batches = []
+                deliveries = []
                 for w, row, lens, fr, pd in batches:
                     m = len(lens)
                     d = decide[pos:pos + m]
@@ -1243,9 +1695,7 @@ class WireDataPlane:
                         # materialized to split it per frame
                         ff = flatten_frames(fr)
                         by = [f for f, dd in zip(ff, d) if dd]
-                        self.bypassed += len(by)
-                        # latency ≈ 0: delivered in the same tick
-                        self.daemon.deliver_egress_bulk(*rowinfo[row], by)
+                        deliveries.append((rowinfo[row], by))
                         kl = [int(ln) for ln, dd in zip(lens, d)
                               if not dd]
                         kf = [f for f, dd in zip(ff, d) if not dd]
@@ -1254,6 +1704,18 @@ class WireDataPlane:
                     else:
                         kept_batches.append((w, row, lens, fr, pd))
                 batches = kept_batches
+                self._disp_items = batches
+                for target, by in deliveries:
+                    # latency ≈ 0: delivered in the same tick. Guarded
+                    # per batch: a capture-tap failure (disk full) must
+                    # not abort the dispatch — the egress extend happens
+                    # before the tap, so the frames are counted rather
+                    # than redelivered
+                    try:
+                        self.daemon.deliver_egress_bulk(*target, by)
+                        self.bypassed += len(by)
+                    except Exception:
+                        self.undeliverable += len(by)
         elif self.daemon._classify is not None:
             # flow table unavailable but the classifier is: keep
             # frame_stats flowing (same exactly-once point — first
@@ -1263,6 +1725,9 @@ class WireDataPlane:
                     self.daemon.frame_stats.update(
                         self.daemon._classify(flatten_frames(fr), lens))
         self.stage_s["decide"] += time.perf_counter() - t_decide0
+        # the non-flowtable classify branch is decided now too
+        self._disp_decided = True
+        self._disp_items = batches
         if not batches:
             return None
 
@@ -1322,14 +1787,46 @@ class WireDataPlane:
                             ("ind", ind_group)):
             if group:
                 args[kind] = _build_group(batches, group, E)
+        # a (class-mix, padded-shape) combination this plane has not
+        # dispatched before will trace+compile inside the jit call —
+        # disarm the watchdog for the duration (the runner re-arms when
+        # the tick completes) so a mid-run recompile is never counted
+        # as a stalled runner
+        bucket = (E, self._pipe_state is not None,
+                  self.degrade_level >= 2,
+                  tuple(sorted((kind, a[1].shape)
+                               for kind, a in args.items())))
+        if bucket not in self._seen_buckets:
+            self._seen_buckets.add(bucket)
+            self._watchdog_armed = False
         t_kernel0 = time.perf_counter()
-        key, sub, dyn_after, outs = _fused_tick(
-            state, self._pipe_state, self._key,
-            jnp.float32(elapsed_us),
-            args.get("seq"), args.get("tbf"), args.get("ind"),
-            has_seq=bool(seq_group), has_tbf=bool(tbf_group),
-            has_ind=bool(ind_group),
-            has_dyn=self._pipe_state is not None)
+        if self.degrade_level >= 2:
+            # synchronous un-fused ladder rung: one dispatch per kernel
+            # class, chained host-side in the fused program's order with
+            # the SAME key split / per-class fold_in — byte-identical
+            # outputs, no single fused executable in the path (the
+            # failure mode this rung exists to route around)
+            key, sub = jax.random.split(self._key)
+            dyn = self._pipe_state
+            el = jnp.float32(elapsed_us)
+            outs = {}
+            for kind in ("tbf", "seq", "ind"):
+                a = args.get(kind)
+                if a is None:
+                    continue
+                dyn, outs[kind] = _class_tick(
+                    state, dyn, sub, el, a, kind=kind,
+                    has_dyn=dyn is not None)
+                el = jnp.float32(0.0)  # the clock roll applies once
+            dyn_after = dyn
+        else:
+            key, sub, dyn_after, outs = _fused_tick(
+                state, self._pipe_state, self._key,
+                jnp.float32(elapsed_us),
+                args.get("seq"), args.get("tbf"), args.get("ind"),
+                has_seq=bool(seq_group), has_tbf=bool(tbf_group),
+                has_ind=bool(ind_group),
+                has_dyn=self._pipe_state is not None)
         self._key = key
         job.sub = sub
         job.dyn_after = dyn_after
@@ -1838,18 +2335,25 @@ class WireDataPlane:
             refreeze_at: float | None = time.monotonic() + 2.0
             while not self._stop.is_set():
                 t0 = time.monotonic()
+                self._heartbeat_s = t0  # watchdog liveness stamp
                 self._wake.clear()  # signals during the tick re-arm it
                 try:
                     # no explicit clock: the tick reads monotonic itself
                     # and stays distinguishable from synthetic-clock runs
                     self.tick()
+                    self._watchdog_armed = True  # warm-up compile done
                     last_error = None
+                    self._safe_supervise(True)
                 except Exception as e:
                     # a tick must never kill the data plane — but a
                     # persistent failure at dt_us cadence must not emit
                     # ~100 tracebacks/s either: full traceback only when
-                    # the error CHANGES, a counter carries the rest
+                    # the error CHANGES, a counter carries the rest.
+                    # The dispatch path requeued its frames before the
+                    # exception surfaced; the supervisor steps the
+                    # degradation ladder on repeated failures.
                     self.tick_errors += 1
+                    self._safe_supervise(False)
                     sig = f"{type(e).__name__}: {e}"
                     if sig != last_error:
                         last_error = sig
@@ -1887,17 +2391,69 @@ class WireDataPlane:
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="wire-dataplane")
         self._thread.start()
+        self._start_watchdog()
+
+    def _start_watchdog(self) -> None:
+        """Heartbeat watchdog over the runner thread: counts (and logs,
+        rate-limited) loop iterations stalled beyond watchdog_timeout_s
+        — a wedged fused dispatch or a deadlocked tick is visible in
+        `kubedtn_dataplane_watchdog_stalls` instead of silent."""
+        self._watchdog_stop.clear()
+
+        def watchdog():
+            from kubedtn_tpu.utils.logging import fields, get_logger
+
+            log = get_logger("dataplane")
+            warn = fault.RateLimitedLog(min_interval_s=5.0)
+            interval = max(0.05, min(1.0, self.watchdog_timeout_s / 4))
+            while not self._watchdog_stop.wait(interval):
+                if not self._watchdog_armed:
+                    continue  # first tick still compiling: warm-up
+                age = self.heartbeat_age_s
+                if age is not None and age > self.watchdog_timeout_s:
+                    self.watchdog_stalls += 1
+                    fire, suppressed = warn.ready()
+                    if fire:
+                        log.warning("data-plane runner stalled %s", fields(
+                            heartbeat_age_s=round(age, 3),
+                            stalls=self.watchdog_stalls,
+                            degrade_level=self.degrade_level,
+                            suppressed=suppressed))
+
+        self._watchdog_thread = threading.Thread(
+            target=watchdog, daemon=True, name="wire-dataplane-watchdog")
+        self._watchdog_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()  # unblock a sleeping runner
+        self._watchdog_stop.set()
+        if self._watchdog_thread is not None:
+            self._watchdog_thread.join(timeout=2)
+            self._watchdog_thread = None
+        wedged = False
         if self._thread is not None:
             self._thread.join(timeout=5)
+            wedged = self._thread.is_alive()
             self._thread = None
-        # pipeline barrier: the runner may have exited with dispatches
-        # still in flight — their frames must land in the delay line
-        # (and their counters accumulate) instead of vanishing
-        self.flush()
+        self._heartbeat_s = None
+        if wedged:
+            # the runner never exited (a dispatch wedged on the device):
+            # it still holds _tick_lock inside its tick, so the flush
+            # below would hang stop() forever — and with it the SIGTERM
+            # checkpoint path. Skip the barrier; the caller can still
+            # save what export_pending can reach once the lock frees.
+            from kubedtn_tpu.utils.logging import fields, get_logger
+
+            get_logger("dataplane").error(
+                "runner thread failed to stop; skipping pipeline "
+                "flush %s", fields(watchdog_stalls=self.watchdog_stalls))
+        else:
+            # pipeline barrier: the runner may have exited with
+            # dispatches still in flight — their frames must land in the
+            # delay line (and their counters accumulate) instead of
+            # vanishing
+            self.flush()
         if self._gc_held:
             self._gc_held = False
             _GCTuner.release()

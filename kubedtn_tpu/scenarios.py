@@ -1057,6 +1057,184 @@ def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
     }
 
 
+def chaos_soak(pairs: int = 4, seconds: float = 12.0,
+               flap_period_s: float = 1.0, duty_down: float = 0.5,
+               offered_frames_per_s: int = 20_000,
+               latency: str = "2ms", dt_us: float = 2_000.0,
+               window_s: float = 1.0, seed: int = 7,
+               drain_timeout_s: float = 90.0):
+    """Throughput-under-flap with ZERO frame loss: two real gRPC daemons
+    (A shapes and forwards cross-node, B receives pod-side), a paced
+    in-process injector feeding A, and the deterministic chaos injector
+    flapping the A→B peer link at `1/flap_period_s` Hz for `seconds`.
+    The fault-domain layer under test: A's per-peer sender must absorb
+    every outage in its breaker-guarded outage buffer and retry, so
+    after the flap ends and the breaker closes, every injected frame
+    arrives at B exactly once — `frames_lost == 0` — with the breaker
+    metrics showing at least one full open → half-open → closed cycle.
+    Windowed delivery rates expose throughput under flap (the analogue
+    of live_plane_soak's decay measurement, under induced faults)."""
+    import threading as _threading
+
+    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+    from kubedtn_tpu.chaos import ChaosInjector
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon, make_server
+
+    t0 = time.perf_counter()
+
+    def make_node():
+        store = TopologyStore()
+        engine = SimEngine(store, capacity=4 * pairs + 8)
+        daemon = Daemon(engine)
+        server, port = make_server(daemon, port=0, host="127.0.0.1",
+                                   log_rpcs=False)
+        server.start()
+        addr = f"127.0.0.1:{port}"
+        engine.node_ip = addr
+        return store, engine, daemon, server, addr
+
+    store_a, engine_a, daemon_a, server_a, addr_a = make_node()
+    store_b, engine_b, daemon_b, server_b, addr_b = make_node()
+    props = LinkProperties(latency=latency)
+    for store in (store_a, store_b):
+        for i in range(pairs):
+            ta = Topology(name=f"ca{i}", spec=TopologySpec(links=[
+                Link(local_intf="eth1", peer_intf="eth1",
+                     peer_pod=f"cb{i}", uid=i + 1, properties=props)]))
+            tb = Topology(name=f"cb{i}", spec=TopologySpec(links=[
+                Link(local_intf="eth1", peer_intf="eth1",
+                     peer_pod=f"ca{i}", uid=i + 1, properties=props)]))
+            ta.status.src_ip, ta.status.net_ns = addr_a, "/ns/a"
+            tb.status.src_ip, tb.status.net_ns = addr_b, "/ns/b"
+            store.create(ta)
+            store.create(tb)
+    for i in range(pairs):
+        t = store_a.get("default", f"ca{i}")
+        assert engine_a.add_links(t, t.spec.links), "cross-node realize"
+    wires_in, wires_out = [], []
+    for i in range(pairs):
+        wb = daemon_b._add_wire(pb.WireDef(
+            local_pod_name=f"cb{i}", kube_ns="default", link_uid=i + 1,
+            intf_name_in_pod="eth1", peer_ip=addr_a))
+        wa = daemon_a._add_wire(pb.WireDef(
+            local_pod_name=f"ca{i}", kube_ns="default", link_uid=i + 1,
+            intf_name_in_pod="eth1", peer_ip=addr_b,
+            peer_intf_id=wb.wire_id))
+        wires_in.append(wa)
+        wires_out.append(wb)
+
+    plane = WireDataPlane(daemon_a, dt_us=dt_us)
+    chaos = ChaosInjector(seed=seed)
+    plane.attach_chaos(chaos)
+    plane.start()
+
+    fed = [0]
+    stop_feed = _threading.Event()
+    frame = b"\x02" * 12 + b"\x07\x77" + b"\x00" * 50  # non-IP: no bypass
+
+    def feeder():
+        # paced injector: a fixed chunk per wire every pace_s keeps the
+        # offered load below plane capacity, so loss accounting is
+        # exact (every fed frame must eventually arrive at B)
+        pace_s = 0.02
+        per_wire = max(1, int(offered_frames_per_s * pace_s / pairs))
+        chunk = [frame] * per_wire
+        while not stop_feed.is_set():
+            for w in wires_in:
+                w.ingress.extend(chunk)
+            fed[0] += per_wire * pairs
+            stop_feed.wait(pace_s)
+
+    def drain_delivered() -> int:
+        c = 0
+        for w in wires_out:
+            dq = w.egress
+            while True:
+                try:
+                    dq.popleft()
+                except IndexError:
+                    break
+                c += 1
+        return c
+
+    delivered = 0
+    windows: list[float] = []
+    try:
+        # warm phase (chaos-free): one chunk end-to-end compiles the
+        # shaping jit buckets and settles the A→B stream, so the flap
+        # windows measure the fault-domain layer, not the compiler
+        warm_per_wire = max(1, int(offered_frames_per_s * 0.02 / pairs))
+        for w in wires_in:
+            w.ingress.extend([frame] * warm_per_wire)
+        fed[0] += warm_per_wire * pairs
+        warm_deadline = time.monotonic() + 120.0
+        while delivered < fed[0] and time.monotonic() < warm_deadline:
+            time.sleep(0.02)
+            delivered += drain_delivered()
+        if delivered < fed[0]:
+            raise RuntimeError(
+                f"chaos_soak warm-up never delivered "
+                f"({delivered}/{fed[0]})")
+        feed = _threading.Thread(target=feeder, daemon=True)
+        feed.start()
+        # flap schedule starts with the load (down first: the outage
+        # buffer is exercised from the first window)
+        chaos.flap_peer(addr_b, flap_period_s, duty_down)
+        t_end = time.monotonic() + seconds
+        while time.monotonic() < t_end:
+            w0 = time.monotonic()
+            time.sleep(window_s)
+            got = drain_delivered()
+            delivered += got
+            windows.append(got / (time.monotonic() - w0))
+        stop_feed.set()
+        feed.join(timeout=5)
+        chaos.heal_peer(addr_b)
+        # drain to empty: every fed frame must land at B (the zero-loss
+        # acceptance); the breaker needs its half-open probe to close
+        deadline = time.monotonic() + drain_timeout_s
+        while delivered < fed[0] and time.monotonic() < deadline:
+            time.sleep(0.05)
+            delivered += drain_delivered()
+        plane.flush_peers(timeout_s=10.0)
+        delivered += drain_delivered()
+    finally:
+        stop_feed.set()
+        # snapshot BEFORE stop(): stop() drops the per-peer senders
+        # (and their breakers) so a restart gets fresh ones
+        pstats = plane.peer_fault_stats().get(addr_b, {})
+        retries_total = plane.peer_retries
+        plane.stop()
+        server_a.stop(0)
+        server_b.stop(0)
+    med = float(np.median(windows)) if windows else 0.0
+    return {
+        "scenario": "chaos_soak",
+        "pairs": pairs,
+        "seconds": seconds,
+        "flap_hz": round(1.0 / flap_period_s, 3),
+        "duty_down": duty_down,
+        "offered_frames_per_s": offered_frames_per_s,
+        "frames_fed": fed[0],
+        "frames_delivered": delivered,
+        "frames_lost": fed[0] - delivered,
+        "windows_frames_per_s": [round(w, 1) for w in windows],
+        "sustained_under_flap_frames_per_s": round(med, 1),
+        "breaker": pstats,
+        "breaker_cycles": int(pstats.get("cycles", 0)),
+        "peer_retries": retries_total,
+        "peer_buffer_dropped": int(pstats.get("dropped", 0)),
+        "injected_faults": dict(chaos.injected),
+        "tick_errors": plane.tick_errors,
+        "shaping_dropped": plane.dropped,
+        "forward_errors": daemon_a.forward_errors,
+        "degrade_level_end": plane.degrade_level,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 LADDER = {
     "3node": three_node,
     "fat_tree_64": fat_tree_64,
@@ -1069,4 +1247,5 @@ LADDER = {
     "live_plane": live_plane,
     "live_plane_soak": live_plane_soak,
     "reconverge_10k": reconverge_10k,
+    "chaos_soak": chaos_soak,
 }

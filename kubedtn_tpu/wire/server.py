@@ -374,8 +374,10 @@ class Daemon:
         self.bulk_unresolved = 0
         # peers assumed to speak the coalesced SendToBulk extension until
         # one answers UNIMPLEMENTED (a reference-built Go daemon); the
-        # egress flush then falls back to per-frame SendToStream for that
-        # peer permanently (runtime._flush_remote)
+        # egress sender then falls back to per-frame SendToStream for
+        # that peer UNTIL its circuit breaker's next half-open probe
+        # calls reset_peer_bulk — an upgraded/restarted peer regains the
+        # bulk path instead of being latched stream-only forever
         self.peer_bulk_ok: dict[str, bool] = {}
         # ingress-deque entries the last drain_ingress left queued but
         # COULD drain next call (budget residue only — unrealized wires
@@ -402,6 +404,13 @@ class Daemon:
         atomic; per-peer sender threads race each other and the tick)."""
         with self._err_lock:
             self.forward_errors += n
+
+    def reset_peer_bulk(self, addr: str) -> None:
+        """Forget a peer's stream-only latch (called at every breaker
+        half-open probe, and safe on channel reconnect): the next send
+        re-tries the coalesced SendToBulk transport, so a peer upgraded
+        from a reference-built daemon regains the bulk path."""
+        self.peer_bulk_ok.pop(addr, None)
 
     def count_bulk_unresolved(self, n: int) -> None:
         """Thread-safe bulk_unresolved increment (concurrent bulk

@@ -1,0 +1,32 @@
+"""Fast chaos smoke (tier-1): the full fault-domain acceptance loop in
+a few seconds — two real gRPC daemons, the deterministic chaos injector
+flapping the cross-node peer link at 1 Hz under paced live load, zero
+frames lost, breaker cycling. The bench's chaos_soak phase runs the
+same scenario longer; this is the always-on regression gate."""
+
+import logging
+
+import pytest
+
+from kubedtn_tpu.scenarios import chaos_soak
+
+
+@pytest.mark.chaos
+def test_chaos_soak_smoke_no_frames_lost():
+    logging.disable(logging.WARNING)  # rate-limited peer-send warnings
+    try:
+        r = chaos_soak(pairs=2, seconds=3.0, flap_period_s=1.0,
+                       offered_frames_per_s=6_000, seed=11)
+    finally:
+        logging.disable(logging.NOTSET)
+    assert r["frames_fed"] > 0
+    # the flap actually fired and the peer link actually broke
+    assert r["injected_faults"]["peer_blackhole"] > 0
+    assert r["peer_retries"] > 0
+    # acceptance: zero loss, zero tick errors, >=1 full breaker
+    # open -> half-open -> closed cycle, nothing dropped at the buffer
+    assert r["frames_lost"] == 0, r
+    assert r["tick_errors"] == 0, r
+    assert r["breaker_cycles"] >= 1, r["breaker"]
+    assert r["peer_buffer_dropped"] == 0
+    assert r["shaping_dropped"] == 0

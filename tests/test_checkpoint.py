@@ -366,6 +366,337 @@ def test_restore_pending_rejects_mixed_clocks():
         [("default/a", 1, b"\x00" * 32, 1_000.0)], now_s=5.1) == 1
 
 
+def _small_cluster():
+    """Reference-sample-free store/engine pair for the corruption tests
+    (one shaped physical link, row realized)."""
+    from kubedtn_tpu.api.types import Topology, TopologySpec
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    t = Topology(name="s", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="e",
+             peer_pod="physical/10.0.0.9", uid=1,
+             properties=LinkProperties(latency="10ms"))]))
+    store.create(t)
+    engine.setup_pod("s")
+    return store, engine
+
+
+def test_checkpoint_atomic_save_layout(tmp_path):
+    """save() swaps a fully-written staging directory into place: the
+    final dir carries the manifest with per-file checksums, and neither
+    the staging dir nor a .prev generation survives a clean save."""
+    import json
+    import os
+
+    store, engine = _small_cluster()
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, store, engine)
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["format_version"] == checkpoint.FORMAT_VERSION
+    assert "edge_state.npz" in manifest["checksums"]
+    leftovers = [d for d in os.listdir(tmp_path)
+                 if d.startswith(".ckpt-tmp-") or d.endswith(".prev")]
+    assert leftovers == []
+    # a second save over the same path replaces wholesale, same contract
+    checkpoint.save(path, store, engine)
+    store2, engine2 = checkpoint.load(path)
+    assert engine2.row_of("default/s", 1) is not None
+
+
+def test_missing_checkpoint_is_distinct_from_damage(tmp_path):
+    """A fresh daemon's first start: load raises the MISSING subtype,
+    and load_pending/load_sim quietly report nothing to restore —
+    while an unsupported format version raises the base error (a
+    rolled-back daemon must not silently cold-start over a
+    newer-format checkpoint)."""
+    import json
+    import os
+
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire.server import Daemon
+
+    path = str(tmp_path / "never-written")
+    with pytest.raises(checkpoint.CheckpointMissingError):
+        checkpoint.load(path)
+    store, engine = _small_cluster()
+    plane = WireDataPlane(Daemon(engine), dt_us=10_000.0)
+    assert checkpoint.load_pending(path, plane) == 0
+    assert checkpoint.load_sim(path, engine) is None
+
+    checkpoint.save(path, store, engine)
+    mpath = os.path.join(path, "manifest.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    manifest["format_version"] = checkpoint.FORMAT_VERSION + 1
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(checkpoint.CheckpointError, match="unsupported"):
+        checkpoint.load(path)
+    with pytest.raises(checkpoint.CheckpointError, match="unsupported"):
+        checkpoint.load_pending(path, plane)
+
+
+def test_checkpoint_refuses_non_checkpoint_dir(tmp_path):
+    store, engine = _small_cluster()
+    path = str(tmp_path / "precious")
+    import os
+
+    os.makedirs(path)
+    with open(os.path.join(path, "notes.txt"), "w") as f:
+        f.write("not a checkpoint")
+    with pytest.raises(checkpoint.CheckpointError, match="refusing"):
+        checkpoint.save(path, store, engine)
+
+
+def test_manifestless_debris_is_corrupt_and_replaceable(tmp_path):
+    """A dir holding ONLY checkpoint data files but no manifest is
+    DAMAGE: load surfaces it (never a silent fresh start), and the next
+    save may replace it (a crash-looped daemon must not be wedged out
+    of checkpointing forever)."""
+    import os
+
+    store, engine = _small_cluster()
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, store, engine)
+    os.remove(os.path.join(path, "manifest.json"))
+    with pytest.raises(checkpoint.CheckpointCorruptError,
+                       match="no manifest"):
+        checkpoint.load(path)
+    checkpoint.save(path, store, engine)  # debris replaced, not refused
+    checkpoint.load(path)
+
+
+def test_save_sweeps_leaked_staging_dirs(tmp_path):
+    """kill -9 mid-save leaves a .ckpt-tmp-* staging dir; the next save
+    sweeps it (dead pids only — a live pid is another process's
+    staging, and a sibling checkpoint's staging never matches)."""
+    import os
+    import subprocess
+    import sys
+
+    store, engine = _small_cluster()
+    path = str(tmp_path / "ckpt")
+    proc = subprocess.Popen([sys.executable, "-c", ""])
+    proc.wait()  # a pid guaranteed dead
+    leaked = str(tmp_path / f".ckpt-tmp-ckpt-{proc.pid}")
+    os.makedirs(leaked)
+    with open(os.path.join(leaked, "edge_state.npz"), "w") as f:
+        f.write("junk from a crashed save")
+    # a SIBLING checkpoint's staging must never match the sweep pattern
+    sibling = str(tmp_path / f".ckpt-tmp-ckpt-b-{proc.pid}")
+    os.makedirs(sibling)
+    checkpoint.save(path, store, engine)
+    assert not os.path.exists(leaked)
+    assert os.path.exists(sibling)
+    checkpoint.load(path)
+
+
+def test_truncated_manifest_raises_typed_error(tmp_path):
+    import os
+
+    store, engine = _small_cluster()
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, store, engine)
+    with open(os.path.join(path, "manifest.json"), "r+b") as f:
+        f.truncate(25)
+    with pytest.raises(checkpoint.CheckpointCorruptError):
+        checkpoint.load(path)
+
+
+def test_truncated_npz_raises_typed_error(tmp_path):
+    import os
+
+    store, engine = _small_cluster()
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, store, engine)
+    p = os.path.join(path, "edge_state.npz")
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+    with pytest.raises(checkpoint.CheckpointCorruptError):
+        checkpoint.load(path)
+
+
+def test_checksum_mismatch_raises_typed_error(tmp_path):
+    """Garbled-but-well-formed damage (flipped byte, size unchanged) is
+    caught by the manifest checksums, not by np.load luck."""
+    import os
+
+    from kubedtn_tpu.chaos import ChaosInjector
+
+    store, engine = _small_cluster()
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, store, engine)
+    ChaosInjector(seed=2).corrupt_file(
+        os.path.join(path, "edge_state.npz"), n_bytes=1)
+    with pytest.raises(checkpoint.CheckpointCorruptError,
+                       match="checksum mismatch"):
+        checkpoint.load(path)
+
+
+def test_load_or_rebuild_falls_back_on_corruption(tmp_path):
+    """The documented recovery: a damaged checkpoint falls back cleanly
+    to rebuild_engine from the store — the reference's reconstruction
+    path — instead of raising mid-restore."""
+    import os
+
+    store, engine = _small_cluster()
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, store, engine)
+    s, e, src = checkpoint.load_or_rebuild(path, store)
+    assert src == "checkpoint"
+    with open(os.path.join(path, "manifest.json"), "r+b") as f:
+        f.truncate(10)
+    s2, e2, src2 = checkpoint.load_or_rebuild(path, store, capacity=16)
+    assert src2 == "rebuild"
+    # the rebuilt engine carries the realized link with its properties
+    row = e2.link_row("default/s", 1)
+    assert row is not None and row["latency_us"] == 10_000.0
+    # without a fallback store the typed error propagates
+    with pytest.raises(checkpoint.CheckpointError):
+        checkpoint.load_or_rebuild(path)
+
+
+def test_crash_between_renames_restores_previous_generation(tmp_path):
+    """kill -9 between save()'s two renames leaves `path` absent and
+    `<path>.prev` holding the previous complete checkpoint: load (and
+    load_pending, same resolution) restore that generation."""
+    import os
+
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire.server import Daemon
+
+    store, engine = _small_cluster()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=10_000.0)
+    plane.restore_pending([("default/s", 1, b"\xaa" * 40, 80_000.0)],
+                          now_s=0.0)
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, store, engine, dataplane=plane)
+    # emulate the crash window: new tmp never landed, old moved aside
+    os.rename(path, path + ".prev")
+    store2, engine2 = checkpoint.load(path)
+    assert engine2.row_of("default/s", 1) is not None
+    plane2 = WireDataPlane(Daemon(engine2), dt_us=10_000.0)
+    assert checkpoint.load_pending(path, plane2, now_s=100.0) == 1
+    assert len(plane2.export_pending()) == 1
+    # ... and the next successful save supersedes the .prev generation
+    checkpoint.save(path, store, engine)
+    assert not os.path.exists(path + ".prev")
+    checkpoint.load(path)
+
+
+def test_resave_without_sim_drops_stale_sim_state(tmp_path):
+    """Satellite: a reused checkpoint directory must not resurrect an
+    earlier save's sim_state.npz (mirror of the pending_frames rule) —
+    the wholesale directory swap guarantees it."""
+    import os
+
+    from kubedtn_tpu.models.traffic import cbr_everywhere
+    from kubedtn_tpu import sim as S
+
+    store, engine = _small_cluster()
+    spec = cbr_everywhere(engine.state.capacity, engine.num_active,
+                          rate_bps=1e6, pkt_bytes=500.0)
+    sim = S.init_sim(engine.state)
+    sim = S.run(sim, spec, steps=2, dt_us=1000.0, k_slots=2)
+    engine.state = sim.edges
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, store, engine, sim=sim)
+    assert os.path.exists(os.path.join(path, "sim_state.npz"))
+    _, engine2 = checkpoint.load(path)
+    assert checkpoint.load_sim(path, engine2) is not None
+
+    checkpoint.save(path, store, engine)  # sim is None this time
+    assert not os.path.exists(os.path.join(path, "sim_state.npz"))
+    _, engine3 = checkpoint.load(path)
+    assert checkpoint.load_sim(path, engine3) is None
+
+
+@pytest.mark.chaos
+def test_kill9_mid_save_never_yields_corrupt_load(tmp_path):
+    """The acceptance contract, with a REAL SIGKILL: a subprocess
+    checkpoints the same cluster in a tight loop, killed -9 at an
+    arbitrary instant; load() must then return a complete generation
+    (new or previous) — never torn state — and load_or_rebuild must
+    always produce a working engine."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = str(tmp_path / "ckpt")
+    src = f"""
+import sys
+sys.path.insert(0, {repo!r})
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+from kubedtn_tpu import checkpoint
+from kubedtn_tpu.api.types import Link, LinkProperties, Topology, \\
+    TopologySpec
+from kubedtn_tpu.topology import SimEngine, TopologyStore
+
+store = TopologyStore()
+engine = SimEngine(store, capacity=16)
+t = Topology(name="s", spec=TopologySpec(links=[
+    Link(local_intf="eth1", peer_intf="e",
+         peer_pod="physical/10.0.0.9", uid=1,
+         properties=LinkProperties(latency="10ms"))]))
+store.create(t)
+engine.setup_pod("s")
+print("READY", flush=True)
+while True:
+    checkpoint.save({path!r}, store, engine)
+"""
+    store, _engine = _small_cluster()
+    for attempt, delay_s in enumerate((0.25, 0.6)):
+        proc = subprocess.Popen([sys.executable, "-c", src],
+                                stdout=subprocess.PIPE, text=True)
+        try:
+            assert proc.stdout.readline().strip() == "READY"
+            time.sleep(delay_s)  # several saves deep, mid-save likely
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        s2, e2, src2 = checkpoint.load_or_rebuild(path, store,
+                                                  capacity=16)
+        # whichever generation (or fallback) won, the link is intact
+        row = e2.link_row("default/s", 1)
+        assert row is not None and row["latency_us"] == 10_000.0, \
+            (attempt, src2)
+        # a torn directory must never satisfy a plain load() — it either
+        # loads a complete generation or raises the typed error
+        try:
+            _s3, e3 = checkpoint.load(path)
+        except checkpoint.CheckpointError:
+            pass
+        else:
+            assert e3.link_row("default/s", 1) is not None
+
+
+def test_corrupt_pending_frames_is_typed_not_silent(tmp_path):
+    import os
+
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire.server import Daemon
+
+    store, engine = _small_cluster()
+    plane = WireDataPlane(Daemon(engine), dt_us=10_000.0)
+    plane.restore_pending([("default/s", 1, b"\xbb" * 64, 40_000.0)],
+                          now_s=0.0)
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(path, store, engine, dataplane=plane)
+    p = os.path.join(path, "pending_frames.npz")
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 3)
+    plane2 = WireDataPlane(Daemon(engine), dt_us=10_000.0)
+    with pytest.raises(checkpoint.CheckpointCorruptError):
+        checkpoint.load_pending(path, plane2, now_s=1.0)
+
+
 def test_restore_pending_rejects_synthetic_now_on_monotonic_plane():
     """Mirror direction of the clock guard: an obviously-synthetic now_s
     against a monotonic-derived origin must raise, not silently release

@@ -543,8 +543,10 @@ def test_slow_peer_does_not_stall_local_delivery():
     grpcwire.go:386). A SLOW (not blackholed — just slow) peer must cost
     only its own wires: ticks stay fast, local-pair delivery is
     unaffected, the slow peer's frames still arrive, and frames to a
-    BLACKHOLED peer are counted in forward_errors — all without the tick
-    thread ever blocking on a peer RPC."""
+    BLACKHOLED peer are held in that sender's bounded outage buffer
+    behind its circuit breaker (round 7: transient failures retry
+    instead of dropping) — all without the tick thread ever blocking on
+    a peer RPC."""
     from kubedtn_tpu.runtime import WireDataPlane
 
     class SlowDaemon(Daemon):
@@ -641,14 +643,29 @@ def test_slow_peer_does_not_stall_local_delivery():
         f"peer RPC (slow peer sleeps 0.6s, blackhole 30s)")
     assert len(wl2.egress) == n, "local delivery stalled behind peers"
 
-    # the slow peer's frames still arrive (its sender waited it out)
-    assert dp.flush_peers(timeout_s=10.0)
+    # the slow peer's frames still arrive (its sender waited it out);
+    # flush_peers would block on the blackholed sender's retry buffer,
+    # so poll the slow wire directly
+    deadline = time.monotonic() + 10.0
+    while len(slow_wire.egress) < n and time.monotonic() < deadline:
+        time.sleep(0.02)
     assert len(slow_wire.egress) == n
-    # the blackholed peer's frames died on ITS sender's deadline and
-    # were counted — nobody else paid for them
-    assert daemon_a.forward_errors == n
+    # the blackholed peer's frames failed on ITS sender's deadline and
+    # sit in that sender's bounded outage buffer awaiting retry —
+    # nobody else paid for them, and nothing was dropped or silently
+    # counted away (a recovered peer would still get them)
+    stats = dp.peer_fault_stats()[hole_addr]
+    assert stats["buffered"] == n
+    assert daemon_a.forward_errors == 0
     assert dp.peer_queue_dropped == 0
+    hole_sender = dp._peer_senders[hole_addr]
     dp.stop()
+    # stop() with the peer still dead gives up the buffer — counted,
+    # never silent
+    deadline = time.monotonic() + 5.0
+    while hole_sender.dropped < n and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert hole_sender.dropped == n
     slow_server.stop(0)
     hole_server.stop(0)
 

@@ -1,0 +1,549 @@
+"""Fault-domain layer tests: circuit breaker state machine, per-peer
+sender retry/outage-buffer behavior, dispatch-failure requeue, and the
+tick supervisor's degradation ladder + watchdog.
+
+The chaos injector (kubedtn_tpu/chaos.py) drives the in-process faults;
+peer faults use a hand-rolled flaky client so each transition is stepped
+deterministically (no wall-clock flap schedule needed)."""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from kubedtn_tpu import fault
+from kubedtn_tpu.api.types import Link, LinkProperties, Topology, \
+    TopologySpec
+from kubedtn_tpu.chaos import ChaosError, ChaosInjector
+from kubedtn_tpu.runtime import WireDataPlane, _PeerSender
+from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+from kubedtn_tpu.wire import proto as pb
+
+
+# ---- circuit breaker state machine ----------------------------------
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def test_breaker_closed_to_open_to_half_open_to_closed():
+    clk = FakeClock()
+    b = fault.CircuitBreaker(failure_threshold=3, reset_timeout_s=1.0,
+                             clock=clk)
+    assert b.state == fault.CLOSED and b.allow()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == fault.CLOSED  # below threshold
+    b.record_failure()
+    assert b.state == fault.OPEN and b.opens == 1
+    assert not b.allow()            # cooling down
+    assert b.time_to_probe() == pytest.approx(1.0)
+    clk.t = 1.5
+    assert b.allow()                # probe granted
+    assert b.state == fault.HALF_OPEN and b.half_opens == 1
+    b.record_success()
+    assert b.state == fault.CLOSED and b.closes == 1 and b.cycles == 1
+    assert b.consecutive_failures == 0
+
+
+def test_breaker_failed_probe_escalates_timeout():
+    clk = FakeClock()
+    b = fault.CircuitBreaker(failure_threshold=1, reset_timeout_s=1.0,
+                             max_reset_timeout_s=3.0, clock=clk)
+    b.record_failure()
+    assert b.state == fault.OPEN
+    clk.t = 1.0
+    assert b.allow() and b.state == fault.HALF_OPEN
+    b.record_failure()              # probe failed
+    assert b.state == fault.OPEN and b.opens == 2
+    # doubled cooldown: not before t=3.0
+    clk.t = 2.5
+    assert not b.allow()
+    clk.t = 3.1
+    assert b.allow()
+    b.record_failure()
+    # capped at max_reset_timeout_s
+    assert b.time_to_probe() <= 3.0 + 1e-9
+    clk.t = 6.2
+    assert b.allow()
+    b.record_success()
+    # success resets the escalation
+    b.record_failure()
+    assert b.time_to_probe() == pytest.approx(1.0)
+
+
+def test_backoff_jitter_bounds_and_reset():
+    import random
+
+    bo = fault.Backoff(base_s=0.1, factor=2.0, max_s=0.5,
+                       rng=random.Random(1))
+    d0 = bo.next_delay()
+    d1 = bo.next_delay()
+    d2 = bo.next_delay()
+    assert 0.05 <= d0 <= 0.1
+    assert 0.1 <= d1 <= 0.2
+    assert 0.2 <= d2 <= 0.4
+    for _ in range(10):
+        assert bo.next_delay() <= 0.5
+    bo.reset()
+    assert bo.next_delay() <= 0.1
+
+
+def test_backoff_survives_thousands_of_attempts():
+    """Regression: `factor ** attempt` must never overflow — a peer
+    down for hours reaches thousands of retry attempts, and a dead
+    sender thread would black-hole that peer forever."""
+    bo = fault.Backoff(base_s=0.05, factor=2.0, max_s=2.0)
+    for _ in range(5000):
+        assert 0.0 < bo.next_delay() <= 2.0
+
+
+def test_rate_limited_log_counts_suppressed():
+    clk = FakeClock()
+    rl = fault.RateLimitedLog(min_interval_s=1.0, clock=clk)
+    assert rl.ready() == (True, 0)
+    assert rl.ready() == (False, 0)
+    assert rl.ready() == (False, 0)
+    clk.t = 1.5
+    assert rl.ready() == (True, 2)  # two suppressed since last fire
+
+
+# ---- per-peer sender: retry, outage buffer, bulk re-latch -----------
+
+class _RpcErr(grpc.RpcError):
+    def __init__(self, code) -> None:
+        self._c = code
+
+    def code(self):
+        return self._c
+
+
+class FakeDaemon:
+    forward_timeout_s = 0.2
+
+    def __init__(self, client) -> None:
+        self.client = client
+        self.peer_bulk_ok: dict = {}
+        self.forward_errors = 0
+        self._l = threading.Lock()
+
+    def _peer_wire_client(self, addr):
+        return self.client
+
+    def count_forward_errors(self, n: int) -> None:
+        with self._l:
+            self.forward_errors += n
+
+    def reset_peer_bulk(self, addr: str) -> None:
+        self.peer_bulk_ok.pop(addr, None)
+
+
+class FlakyClient:
+    """Scripted peer: `down` raises UNAVAILABLE, `bulk_ok` gates
+    UNIMPLEMENTED on the bulk transport, counters record transport
+    usage."""
+
+    def __init__(self) -> None:
+        self.down = False
+        self.bulk_ok = True
+        self.got = 0
+        self.bulk_calls = 0
+        self.stream_calls = 0
+
+    def SendToBulk(self, it, timeout=None):
+        self.bulk_calls += 1
+        if self.down:
+            raise _RpcErr(grpc.StatusCode.UNAVAILABLE)
+        if not self.bulk_ok:
+            raise _RpcErr(grpc.StatusCode.UNIMPLEMENTED)
+        self.got += sum(len(b.packets) for b in it)
+
+    def SendToStream(self, it, timeout=None):
+        self.stream_calls += 1
+        if self.down:
+            raise _RpcErr(grpc.StatusCode.UNAVAILABLE)
+        self.got += len(list(it))
+
+
+def _sender(daemon, threshold=3, reset_s=0.05):
+    return _PeerSender(
+        daemon, "peer:1",
+        breaker=fault.CircuitBreaker(failure_threshold=threshold,
+                                     reset_timeout_s=reset_s),
+        backoff=fault.Backoff(base_s=0.005, max_s=0.02))
+
+
+def _pkts(n):
+    return [pb.Packet(remot_intf_id=1, frame=b"x" * 40) for _ in range(n)]
+
+
+def test_transient_failure_retries_without_loss():
+    cl = FlakyClient()
+    cl.down = True
+    d = FakeDaemon(cl)
+    s = _sender(d)
+    try:
+        s.enqueue(_pkts(50))
+        time.sleep(0.3)
+        # outage in progress: nothing lost, breaker open, frames buffered
+        assert cl.got == 0
+        assert s.buffered == 50 and s.dropped == 0
+        assert s.retries > 0 and s.breaker.opens >= 1
+        assert d.forward_errors == 0  # transient != failed
+        cl.down = False
+        assert s.wait_empty(5.0)
+        assert cl.got == 50 and s.sent == 50
+        assert s.breaker.state == fault.CLOSED and s.breaker.cycles >= 1
+    finally:
+        s.stop()
+
+
+def test_outage_buffer_bound_drops_and_counts():
+    cl = FlakyClient()
+    cl.down = True
+    d = FakeDaemon(cl)
+    s = _sender(d)
+    old = _PeerSender.MAX_QUEUED
+    _PeerSender.MAX_QUEUED = 100
+    try:
+        s.enqueue(_pkts(80))
+        time.sleep(0.15)  # sender drains the queue into its retry buffer
+        accepted = s.enqueue(_pkts(80))
+        # bound covers queued + retry-pending: only the remaining room
+        assert accepted == 20
+        assert s.dropped == 60
+        assert s.buffered == 100
+        cl.down = False
+        assert s.wait_empty(5.0)
+        assert cl.got == 100  # everything accepted was delivered
+    finally:
+        _PeerSender.MAX_QUEUED = old
+        s.stop()
+
+
+def test_fatal_code_drops_batch_into_forward_errors():
+    class FatalClient(FlakyClient):
+        def SendToBulk(self, it, timeout=None):
+            raise _RpcErr(grpc.StatusCode.INVALID_ARGUMENT)
+
+        def SendToStream(self, it, timeout=None):
+            raise _RpcErr(grpc.StatusCode.INVALID_ARGUMENT)
+
+    d = FakeDaemon(FatalClient())
+    s = _sender(d)
+    try:
+        s.enqueue(_pkts(10))
+        assert s.wait_empty(5.0)  # dropped counts as settled
+        assert d.forward_errors == 10
+        assert s.retries == 0  # fatal codes never retry
+    finally:
+        s.stop()
+
+
+def test_bulk_path_regained_after_half_open_probe():
+    """Satellite: the UNIMPLEMENTED stream-only latch must reset at the
+    breaker's recovery probe so an upgraded peer regains SendToBulk."""
+    cl = FlakyClient()
+    cl.bulk_ok = False  # reference-built peer: bulk unimplemented
+    d = FakeDaemon(cl)
+    s = _sender(d)
+    try:
+        s.enqueue(_pkts(10))
+        assert s.wait_empty(5.0)
+        assert d.peer_bulk_ok.get("peer:1") is False  # latched stream-only
+        assert cl.stream_calls >= 1
+        # outage; during it the peer is upgraded to speak bulk
+        cl.down = True
+        s.enqueue(_pkts(10))
+        time.sleep(0.3)
+        cl.down = False
+        cl.bulk_ok = True
+        assert s.wait_empty(5.0)
+        assert d.peer_bulk_ok.get("peer:1", True) is True
+        assert cl.got == 20
+    finally:
+        s.stop()
+
+
+# ---- dispatch-failure requeue + degradation ladder ------------------
+
+def _daemon_with_pair(props=LinkProperties(latency="1ms")):
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    store.create(Topology(name="a", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="b", uid=1,
+             properties=props)])))
+    store.create(Topology(name="b", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="a", uid=1,
+             properties=props)])))
+    engine.setup_pod("a")
+    engine.setup_pod("b")
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    wa = daemon._add_wire(pb.WireDef(local_pod_name="a", kube_ns="default",
+                                     link_uid=1, intf_name_in_pod="eth1"))
+    wb = daemon._add_wire(pb.WireDef(local_pod_name="b", kube_ns="default",
+                                     link_uid=1, intf_name_in_pod="eth1"))
+    return daemon, wa, wb
+
+
+@pytest.mark.chaos
+def test_forced_dispatch_failure_requeues_frames():
+    """A failed dispatch costs a tick, never the frames: the chaos
+    injector forces the fused dispatch to raise, the drained frames
+    requeue, and the next tick delivers every one of them."""
+    daemon, wa, wb = _daemon_with_pair()
+    plane = WireDataPlane(daemon, dt_us=2_000.0)
+    chaos = ChaosInjector(seed=3)
+    plane.attach_chaos(chaos)
+    frames = [bytes([i]) * 60 for i in range(20)]
+    wa.ingress.extend(frames)
+    chaos.fail_next_dispatches(2)
+    for i in range(2):
+        with pytest.raises(ChaosError):
+            plane.tick(now_s=1.0 + i * 0.002)
+    assert len(wa.ingress) == 20  # requeued, FIFO, nothing lost
+    plane.tick(now_s=1.004)
+    plane.tick(now_s=1.2)  # past the 1ms latency
+    assert list(wb.egress) == frames
+    assert plane.shaped == 20
+    assert chaos.injected["dispatch"] == 2
+
+
+@pytest.mark.chaos
+def test_completion_failure_requeues_frames():
+    """The zero-loss invariant holds for ASYNC failures too: a device
+    error surfacing at the pipeline's completion sync point requeues
+    the job's frames (holdback) instead of dropping the dispatch."""
+    daemon, wa, wb = _daemon_with_pair()
+    plane = WireDataPlane(daemon, dt_us=2_000.0, pipeline_depth=2)
+    plane.pipeline_explicit_clock = True
+    frames = [bytes([i]) * 60 for i in range(10)]
+    wa.ingress.extend(frames)
+    real = plane._complete
+
+    def boom(job):
+        raise RuntimeError("injected completion failure")
+
+    plane._complete = boom
+    plane.tick(now_s=1.0)  # dispatch rides the ring, not yet completed
+    with pytest.raises(RuntimeError, match="injected"):
+        plane.tick(now_s=1.002)  # idle tick drains the ring -> boom
+    plane._complete = real
+    assert plane._holdback  # requeued, not lost
+    plane.tick(now_s=1.004)
+    plane.tick(now_s=1.006)
+    plane.tick(now_s=1.5)  # past the 1ms latency
+    assert list(wb.egress) == frames
+    assert plane.shaped == 10
+
+
+def test_slice_retry_budget_drops_poison_slice():
+    """A slice failing deterministically with a nominally-transient
+    code must not wedge the peer's egress forever: after
+    MAX_SLICE_RETRIES it drops into forward_errors and the buffer
+    moves on."""
+    class AlwaysExhausted(FlakyClient):
+        def SendToBulk(self, it, timeout=None):
+            raise _RpcErr(grpc.StatusCode.RESOURCE_EXHAUSTED)
+
+        SendToStream = SendToBulk
+
+    d = FakeDaemon(AlwaysExhausted())
+    s = _PeerSender(
+        d, "peer:1",
+        breaker=fault.CircuitBreaker(failure_threshold=100,  # stay closed
+                                     reset_timeout_s=0.01),
+        backoff=fault.Backoff(base_s=0.001, max_s=0.002))
+    old = _PeerSender.MAX_SLICE_RETRIES
+    _PeerSender.MAX_SLICE_RETRIES = 4
+    try:
+        s.enqueue(_pkts(10))
+        assert s.wait_empty(10.0)  # gave up within the budget
+        assert d.forward_errors == 10
+        assert s.retries >= 3
+    finally:
+        _PeerSender.MAX_SLICE_RETRIES = old
+        s.stop()
+
+
+@pytest.mark.chaos
+def test_supervisor_degrades_and_promotes():
+    """Repeated tick failures walk the ladder 0 → 1 → 2; a clean
+    interval promotes back one rung at a time. (Driven through the
+    supervisor entry point the runner loop calls.)"""
+    daemon, _wa, _wb = _daemon_with_pair()
+    plane = WireDataPlane(daemon, dt_us=2_000.0, pipeline_depth=2)
+    plane.degrade_after = 2
+    plane.promote_after_s = 0.05
+    for _ in range(2):
+        plane._supervise(False)
+    assert plane.degrade_level == 1 and plane.degradations == 1
+    assert plane.effective_pipeline_depth == 1
+    for _ in range(2):
+        plane._supervise(False)
+    assert plane.degrade_level == 2
+    # still failing: stays at the bottom rung
+    for _ in range(4):
+        plane._supervise(False)
+    assert plane.degrade_level == 2 and plane.degradations == 2
+    time.sleep(0.06)
+    plane._supervise(True)
+    assert plane.degrade_level == 1 and plane.promotions == 1
+    time.sleep(0.06)
+    plane._supervise(True)
+    assert plane.degrade_level == 0 and plane.promotions == 2
+    assert plane.effective_pipeline_depth == 2
+
+
+@pytest.mark.chaos
+def test_runner_survives_dispatch_faults_and_degrades():
+    """End to end with the real runner: every 3rd dispatch raises; the
+    plane keeps delivering (requeue), tick_errors counts the faults, and
+    the supervisor eventually steps the ladder down."""
+    daemon, wa, wb = _daemon_with_pair()
+    plane = WireDataPlane(daemon, dt_us=1_000.0, pipeline_depth=2)
+    plane.degrade_after = 2
+    chaos = ChaosInjector(seed=5)
+    plane.attach_chaos(chaos)
+    plane.start()
+    try:
+        # warm first (jit compile would coalesce everything into one
+        # dispatch and dodge the fault plan)
+        wa.ingress.extend([b"w" * 60] * 4)
+        deadline = time.monotonic() + 60.0
+        while len(wb.egress) < 4 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(wb.egress) == 4
+        wb.egress.clear()
+        chaos.fail_every_kth_dispatch(3)
+        n = 0
+        for _ in range(30):  # paced chunks → many separate dispatches
+            wa.ingress.extend([b"q" * 60] * 10)
+            n += 10
+            time.sleep(0.01)
+        deadline = time.monotonic() + 60.0
+        while len(wb.egress) < n and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(wb.egress) == n, f"lost {n - len(wb.egress)} frames"
+        assert plane.tick_errors > 0
+        assert chaos.injected["dispatch"] > 0
+    finally:
+        plane.stop()
+
+
+def test_bulk_path_regained_after_idle_reprobe():
+    """A peer upgraded during a QUIET window (no outage, so no breaker
+    cycle) regains the bulk path via the periodic idle re-probe."""
+    cl = FlakyClient()
+    cl.bulk_ok = False  # latched stream-only on first contact
+    d = FakeDaemon(cl)
+    s = _sender(d)
+    old = _PeerSender.BULK_REPROBE_S
+    _PeerSender.BULK_REPROBE_S = 0.05
+    try:
+        s.enqueue(_pkts(5))
+        assert s.wait_empty(5.0)
+        assert d.peer_bulk_ok.get("peer:1") is False
+        cl.bulk_ok = True  # upgraded while idle; no failures anywhere
+        time.sleep(0.1)    # past the re-probe interval
+        s.enqueue(_pkts(5))
+        assert s.wait_empty(5.0)
+        assert d.peer_bulk_ok.get("peer:1", True) is True
+        assert cl.got == 10
+    finally:
+        _PeerSender.BULK_REPROBE_S = old
+        s.stop()
+
+
+def test_new_jit_bucket_disarms_watchdog():
+    """A tick dispatching a never-seen (class-mix, shape) bucket traces
+    a new executable — the watchdog must treat that window as warm-up,
+    not a stall (the runner re-arms after the tick completes)."""
+    daemon, wa, _wb = _daemon_with_pair()
+    plane = WireDataPlane(daemon, dt_us=2_000.0)
+    wa.ingress.extend([b"x" * 60] * 3)
+    plane.tick(now_s=1.0)  # first bucket (K pad 4)
+    plane._watchdog_armed = True
+    wa.ingress.extend([b"x" * 60] * 3)
+    plane.tick(now_s=1.01)  # same bucket: no compile, stays armed
+    assert plane._watchdog_armed
+    wa.ingress.extend([b"x" * 60] * 40)
+    plane.tick(now_s=1.02)  # new K bucket (pad 64): compile window
+    assert not plane._watchdog_armed
+
+
+def test_watchdog_counts_stalled_heartbeat():
+    daemon, _wa, _wb = _daemon_with_pair()
+    plane = WireDataPlane(daemon, dt_us=2_000.0)
+    plane.watchdog_timeout_s = 0.1
+    # fake a wedged runner: stale heartbeat, watchdog running and armed
+    # (arming normally happens at the first completed tick — cold
+    # compiles must not count as stalls)
+    plane._heartbeat_s = time.monotonic() - 10.0
+    plane._watchdog_armed = True
+    plane._start_watchdog()
+    try:
+        deadline = time.monotonic() + 5.0
+        while plane.watchdog_stalls == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert plane.watchdog_stalls > 0
+        assert plane.heartbeat_age_s > plane.watchdog_timeout_s
+    finally:
+        plane._watchdog_stop.set()
+        plane._watchdog_thread.join(timeout=2)
+
+
+def test_stage_breakdown_exports_degrade_gauges():
+    daemon, _wa, _wb = _daemon_with_pair()
+    plane = WireDataPlane(daemon, dt_us=2_000.0, pipeline_depth=2)
+    pipe = plane.stage_breakdown()["pipeline"]
+    assert pipe["degrade_level"] == 0
+    assert pipe["effective_depth"] == 2
+    plane.force_degrade(2)
+    pipe = plane.stage_breakdown()["pipeline"]
+    assert pipe["degrade_level"] == 2
+    assert pipe["effective_depth"] == 1
+
+
+def test_metrics_registry_exports_fault_series():
+    """The new breaker/supervision series reach the Prometheus
+    exposition (per-peer series appear once a sender exists)."""
+    from prometheus_client import generate_latest
+
+    from kubedtn_tpu.metrics.metrics import make_registry
+    from kubedtn_tpu.runtime import _PeerSender as PS
+
+    daemon, _wa, _wb = _daemon_with_pair()
+    plane = WireDataPlane(daemon, dt_us=2_000.0)
+    cl = FlakyClient()
+    fd = FakeDaemon(cl)
+    plane._peer_senders["10.0.0.9:51111"] = _sender(fd)
+    try:
+        registry, _ = make_registry(daemon.engine, dataplane=plane)
+        body = generate_latest(registry).decode()
+        for series in ("kubedtn_peer_breaker_state",
+                       "kubedtn_peer_breaker_opens",
+                       "kubedtn_peer_breaker_cycles",
+                       "kubedtn_peer_forward_retry",
+                       "kubedtn_peer_outage_buffered",
+                       "kubedtn_dataplane_degrade_level",
+                       "kubedtn_dataplane_effective_pipeline_depth",
+                       "kubedtn_dataplane_watchdog_stalls",
+                       "kubedtn_dataplane_heartbeat_age_seconds",
+                       "kubedtn_dataplane_peer_forward_retries",
+                       "kubedtn_dataplane_degradations",
+                       "kubedtn_dataplane_promotions"):
+            assert series in body, series
+        assert 'peer="10.0.0.9:51111"' in body
+    finally:
+        sender = plane._peer_senders.pop("10.0.0.9:51111")
+        sender.stop()
+        assert isinstance(sender, PS)

@@ -253,6 +253,57 @@ def test_adaptive_budget_tracks_backlog():
     assert plane.last_backlog == 4000
 
 
+def _run_plane_with_ladder(props, n_per_wire, transitions,
+                           pairs: int = 2, ticks: int = 40,
+                           dt: float = 0.002, feed_every: int = 5):
+    """Like _run_plane at depth 2, but forcing degradation-ladder
+    transitions at scheduled tick indices (transitions: {tick: level})."""
+    daemon, _engine, win, wout = _daemon_with_pairs(pairs, props)
+    plane = WireDataPlane(daemon, dt_us=dt * 1e6, pipeline_depth=2)
+    plane.pipeline_explicit_clock = True
+    t = 100.0
+    for k, wa in enumerate(win):
+        wa.ingress.extend(_tagged_frames(k, n_per_wire))
+    for j in range(ticks):
+        if j in transitions:
+            plane.force_degrade(transitions[j])
+        if feed_every and j and j % feed_every == 0:
+            for k, wa in enumerate(win):
+                wa.ingress.extend(_tagged_frames(k, n_per_wire))
+        t += dt
+        plane.tick(now_s=t)
+    plane.flush()
+    plane.tick(now_s=t + 10.0)
+    assert plane.tick_errors == 0
+    assert not plane._inflight
+    return [list(w.egress) for w in wout], plane
+
+
+@pytest.mark.parametrize("props,n", [
+    (INDEP, 50),
+    (TBF, 50),
+    (TBF_OVERLOAD, 60),
+    (SEQ, 40),
+], ids=["indep", "tbf", "tbf-fallback", "seq"])
+def test_degradation_ladder_matches_depth1(props, n):
+    """The graceful-degradation ladder active MID-STREAM — depth 2 → 1 →
+    synchronous un-fused → back up — must deliver byte-identical
+    per-wire order to a depth-1 run: every transition crosses the
+    flush() barrier and the un-fused per-class dispatches reuse the
+    fused program's key split and fold_in constants."""
+    got1, p1 = _run_plane(1, props, n, ticks=40, feed_every=5)
+    got2, p2 = _run_plane_with_ladder(
+        props, n, transitions={8: 1, 16: 2, 24: 1, 30: 0})
+    assert p1.shaped == p2.shaped
+    assert p1.dropped == p2.dropped
+    for w1, w2 in zip(got1, got2):
+        assert w1 == w2  # byte-identical, in order
+    assert sum(len(w) for w in got1) > 0
+    # the ladder actually moved (guards a vacuous pass)
+    assert p2.degradations == 2 and p2.promotions == 2
+    assert p2.degrade_level == 0
+
+
 def test_gc_tuner_refcounts_and_restores():
     """_GCTuner freezes/relaxes once for N overlapping planes and
     restores the interpreter defaults when the last one releases."""
