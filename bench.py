@@ -634,6 +634,27 @@ def main() -> None:
                 "peer_retries", "peer_buffer_dropped", "tick_errors",
                 "forward_errors", "degrade_level_end") if k in r}
 
+    def run_whatif_sweep():
+        # what-if plane evidence: >=64 perturbed replicas × >=10k virtual
+        # ticks advanced by ONE compiled program, recorded as
+        # replicas·steps/s plus the compile/run split (the twin engine's
+        # AOT cache compiles once per (N, T, capacity) shape).
+        # Process-isolated like the live phases so earlier phases'
+        # ballast can't depress the measured scan.
+        # on a CPU-only host the 640k replica-step scan is op-dispatch
+        # bound (~1k replica-steps/s measured) — give it headroom well
+        # past the default 900s; the TPU path is data-bound and fast
+        r = _isolated_scenario("whatif_sweep", {
+            "replicas": 16 if degraded else 64,
+            "steps": 2_000 if degraded else 10_000},
+            timeout_s=2400.0)
+        extras["whatif_sweep"] = {
+            k: r[k] for k in (
+                "nodes", "links", "replicas", "steps", "compile_s",
+                "run_s", "replicas_steps_per_s", "virtual_speedup",
+                "baseline_delivery_ratio", "worst_delivery_ratio",
+                "baseline_p99_us") if k in r}
+
     def run_reconverge_10k():
         from kubedtn_tpu.scenarios import reconverge_10k
 
@@ -695,6 +716,7 @@ def main() -> None:
     phase("live_soak", run_live_soak)
     phase("live_soak_tbf", run_live_soak_tbf)
     phase("chaos_soak", run_chaos_soak)
+    phase("whatif_sweep", run_whatif_sweep)
     phase("reconverge_10k", run_reconverge_10k)
 
     try:

@@ -261,6 +261,47 @@ def _arrays_to_npz(path: str, obj) -> None:
     np.savez_compressed(path, **fields)
 
 
+# SimState npz codec — the ONE flatten/unflatten for the
+# "<field>.<leaf>" layout, shared by the checkpoint's sim_state.npz
+# (edges stored separately in edge_state.npz) and the what-if twin's
+# snapshot files (edges inlined): a field added to
+# InFlight/EdgeCounters/TrafficState changes both formats in one place.
+
+def flatten_sim_arrays(sim, include_edges: bool = False) -> dict:
+    names = (("edges",) if include_edges else ()) + (
+        "inflight", "counters", "traffic")
+    flat = {}
+    for name in names:
+        sub = getattr(sim, name)
+        for fld in dataclasses.fields(sub):
+            flat[f"{name}.{fld.name}"] = np.asarray(
+                getattr(sub, fld.name))
+    flat["clock_us"] = np.asarray(sim.clock_us)
+    return flat
+
+
+def unflatten_sim_arrays(z, edges=None):
+    """SimState from a flattened npz mapping; `edges` supplies the
+    EdgeState when the file excludes it (checkpoint layout)."""
+    from kubedtn_tpu.models.traffic import TrafficState
+    from kubedtn_tpu.ops.queues import EdgeCounters, InFlight
+    from kubedtn_tpu.sim import SimState
+
+    def sub(cls, prefix):
+        return cls(**{
+            f.name: jnp.asarray(z[f"{prefix}.{f.name}"])
+            for f in dataclasses.fields(cls)
+        })
+
+    return SimState(
+        edges=edges if edges is not None else sub(es.EdgeState, "edges"),
+        inflight=sub(InFlight, "inflight"),
+        counters=sub(EdgeCounters, "counters"),
+        traffic=sub(TrafficState, "traffic"),
+        clock_us=jnp.asarray(z["clock_us"]),
+    )
+
+
 def save(path: str, store: TopologyStore, engine: SimEngine,
          sim=None, dataplane=None) -> None:
     """Write a checkpoint directory ATOMICALLY: stage everything in a
@@ -317,14 +358,8 @@ def save(path: str, store: TopologyStore, engine: SimEngine,
             save_pending(tmp, dataplane)
         _arrays_to_npz(os.path.join(tmp, "edge_state.npz"), engine.state)
         if sim is not None:
-            flat = {}
-            for name in ("inflight", "counters", "traffic"):
-                sub = getattr(sim, name)
-                for fld in dataclasses.fields(sub):
-                    flat[f"{name}.{fld.name}"] = np.asarray(
-                        getattr(sub, fld.name))
-            flat["clock_us"] = np.asarray(sim.clock_us)
-            np.savez_compressed(os.path.join(tmp, "sim_state.npz"), **flat)
+            np.savez_compressed(os.path.join(tmp, "sim_state.npz"),
+                                **flatten_sim_arrays(sim))
         checksums = {
             fname: _sha256_file(os.path.join(tmp, fname))
             for fname in sorted(os.listdir(tmp))
@@ -519,10 +554,6 @@ def load_sim(path: str, engine: SimEngine):
     sim_state.npz behind — the directory swap is wholesale). None when
     the checkpoint carries no sim state or no checkpoint exists;
     corruption and unsupported formats raise."""
-    from kubedtn_tpu.models.traffic import TrafficState
-    from kubedtn_tpu.ops.queues import EdgeCounters, InFlight
-    from kubedtn_tpu.sim import SimState
-
     try:
         dirpath, manifest = _resolve_dir(os.path.abspath(path))
     except CheckpointMissingError:
@@ -531,19 +562,7 @@ def load_sim(path: str, engine: SimEngine):
         return None
     with _load_npz(dirpath, manifest, "sim_state.npz") as z:
         try:
-            def sub(cls, prefix):
-                return cls(**{
-                    f.name: jnp.asarray(z[f"{prefix}.{f.name}"])
-                    for f in dataclasses.fields(cls)
-                })
-
-            return SimState(
-                edges=engine.state,
-                inflight=sub(InFlight, "inflight"),
-                counters=sub(EdgeCounters, "counters"),
-                traffic=sub(TrafficState, "traffic"),
-                clock_us=jnp.asarray(z["clock_us"]),
-            )
+            return unflatten_sim_arrays(z, edges=engine.state)
         except CheckpointCorruptError:
             raise
         except Exception as e:

@@ -229,9 +229,12 @@ def cmd_daemon(args) -> int:
             # next graceful checkpoint must NOT re-deliver these
             # frames again
             checkpoint.consume_pending(ckpt_dir)
+    from kubedtn_tpu.twin.query import stats_for
+
     registry, hist = make_registry(engine,
                                    sim_counters_fn=dataplane.counters_fn,
-                                   dataplane=dataplane)
+                                   dataplane=dataplane,
+                                   whatif_stats=stats_for(daemon))
     engine.stats.observer = hist
     daemon.hist = hist
     server, port = make_server(daemon, port=args.port)
@@ -478,6 +481,180 @@ def cmd_pcap(args) -> int:
     return 0
 
 
+def _load_whatif_scenarios(path: str | None):
+    """Scenario YAML → twin Scenario list. Layout:
+
+      - name: spine0-slow
+        perturbations:
+          - {kind: degrade, uid: 1, properties: {latency: 50ms}}
+          - {kind: scale, factor: 1.5}
+      - name: leaf3-dead
+        perturbations: [{kind: blackhole, node: leaf3}]
+    """
+    from kubedtn_tpu.api.types import LinkProperties
+    from kubedtn_tpu.twin.spec import Perturbation, Scenario
+
+    if not path:
+        return []
+    import yaml
+
+    with open(path) as f:
+        docs = yaml.safe_load(f)
+    if not isinstance(docs, list):
+        raise ValueError("what-if spec must be a YAML list of scenarios")
+    out = []
+    for i, d in enumerate(docs):
+        if not isinstance(d, dict):
+            raise ValueError(
+                f"what-if spec entry {i} must be a mapping with "
+                f"name/perturbations, got {type(d).__name__}")
+        perts = []
+        for p in d.get("perturbations", []):
+            if not isinstance(p, dict):
+                raise ValueError(
+                    f"scenario {d.get('name', i)!r}: perturbation must "
+                    f"be a mapping, got {type(p).__name__}")
+            props = p.get("properties")
+            perts.append(Perturbation(
+                kind=p.get("kind", "degrade"),
+                uid=p.get("uid"),
+                props=(LinkProperties.from_dict(props)
+                       if props is not None else None),
+                node=p.get("node"),
+                factor=float(p.get("factor", 1.0)),
+            ))
+        out.append(Scenario(name=d.get("name", f"scenario{i}"),
+                            perturbations=tuple(perts)))
+    return out
+
+
+def cmd_whatif(args) -> int:
+    """Run a what-if sweep — against a LIVE daemon (snapshot of its
+    running data plane; the real-time runner never stops) or locally
+    from a topology YAML — and print the ranked scenario comparison."""
+    from kubedtn_tpu.api.parsers import parse_rate_bps
+    from kubedtn_tpu.twin.engine import SweepResult
+    from kubedtn_tpu.twin.report import rank_results, render_report
+    from kubedtn_tpu.twin.spec import Scenario
+
+    from kubedtn_tpu.twin.query import DEFAULT_RATE_BPS
+
+    try:
+        scenarios = _load_whatif_scenarios(args.spec)
+    except (ValueError, OSError) as e:
+        print(f"whatif spec: {e}", file=sys.stderr)
+        return 1
+    rate_bps = parse_rate_bps(args.rate) if args.rate else DEFAULT_RATE_BPS
+
+    if args.daemon:
+        from kubedtn_tpu.wire import proto as pb
+        from kubedtn_tpu.wire.client import DaemonClient
+
+        req = pb.WhatIfRequest(
+            ticks=args.ticks, dt_us=args.dt_us,
+            traffic_rate_bps=float(rate_bps), seed=args.seed,
+            include_baseline=True)
+        for sc in scenarios:
+            msg = req.scenarios.add()
+            msg.name = sc.name
+            for p in sc.perturbations:
+                pm = msg.perturbations.add()
+                pm.kind = p.kind
+                if p.uid is not None:
+                    pm.uid = int(p.uid)
+                if p.node is not None:
+                    pm.node = str(p.node)
+                pm.factor = float(p.factor)
+                if p.props is not None:
+                    pm.properties.CopyFrom(pb.props_to_proto(p.props))
+        import grpc
+
+        client = DaemonClient(args.daemon)
+        try:
+            resp = client.WhatIf(req, timeout=args.timeout)
+        except grpc.RpcError as e:
+            try:
+                code = e.code().name
+            except Exception:
+                code = type(e).__name__
+            print(f"whatif: daemon {args.daemon} RPC failed: {code}",
+                  file=sys.stderr)
+            return 1
+        finally:
+            client.close()
+        if not resp.ok:
+            print(f"whatif failed: {resp.error}", file=sys.stderr)
+            return 1
+        none_if = lambda v: None if v < 0 else v  # noqa: E731
+        metrics = [{
+            "tx_packets": m.tx_packets,
+            "delivered_packets": m.delivered_packets,
+            "delivered_bytes": m.delivered_bytes,
+            "dropped_loss": m.dropped_loss,
+            "dropped_queue": m.dropped_queue,
+            "dropped_ring": m.dropped_ring,
+            "throughput_bps": m.throughput_bps,
+            "delivery_ratio": none_if(m.delivery_ratio),
+            "p50_us": none_if(m.p50_us),
+            "p90_us": none_if(m.p90_us),
+            "p99_us": none_if(m.p99_us),
+            "mean_queue_occupancy": m.mean_queue_occupancy,
+            "latency_hist": list(m.latency_hist),
+        } for m in resp.results]
+        result = SweepResult(
+            names=[m.name for m in resp.results], metrics=metrics,
+            replicas=resp.replicas, ticks=resp.ticks,
+            sim_seconds=resp.sim_seconds, compile_s=resp.compile_s,
+            run_s=resp.run_s,
+            replicas_steps_per_s=resp.replicas_steps_per_s)
+        # the daemon already ranked server-side: display ITS ranks
+        # rather than re-deriving (the two scorings must never drift)
+        server_ranks = {m.name: m.rank for m in resp.results}
+        title = f"what-if via {args.daemon}"
+    else:
+        if not args.file:
+            print("whatif needs --daemon or --file", file=sys.stderr)
+            return 1
+        from kubedtn_tpu.twin.engine import run_sweep
+        from kubedtn_tpu.twin.query import build_cbr_spec
+        from kubedtn_tpu.twin.snapshot import snapshot_from_engine
+
+        engine, _topos = _engine_from_yaml(args.file)
+        snap = snapshot_from_engine(engine)
+        with engine._lock:
+            pod_ids = dict(engine._pod_ids)
+        # the daemon path's ONE spec construction (query.build_cbr_spec)
+        # with --rate applied — both modes answer the same question for
+        # the same flags by sharing the code, not by copies
+        spec = build_cbr_spec(snap.sim.edges, rate_bps=float(rate_bps))
+        try:
+            result = run_sweep(
+                snap, [Scenario(name="baseline"), *scenarios],
+                steps=args.ticks, dt_us=args.dt_us, seed=args.seed,
+                spec=spec, pod_ids=pod_ids)
+        except ValueError as e:
+            # same one-line reporting as the daemon path gives the
+            # identical spec (bad uid / node / duplicate names)
+            print(f"whatif failed: {e}", file=sys.stderr)
+            return 1
+        server_ranks = None
+        title = f"what-if on {args.file}"
+
+    if args.json:
+        print(json.dumps(_json_safe({
+            "replicas": result.replicas, "ticks": result.ticks,
+            "sim_seconds": result.sim_seconds,
+            "compile_s": result.compile_s, "run_s": result.run_s,
+            "replicas_steps_per_s": result.replicas_steps_per_s,
+            "ranked": [{"rank": r, "name": n, **m}
+                       for n, m, r in rank_results(
+                           result, ranks=server_ranks)],
+        })))
+    else:
+        print(render_report(result, title=title, ranks=server_ranks))
+    return 0
+
+
 def cmd_bench(args) -> int:
     # bench.py lives at the repo root, not in the package: anchor the
     # import so `python -m kubedtn_tpu.cli bench` works from any cwd
@@ -612,6 +789,28 @@ def main(argv=None) -> int:
     jp.add_argument("file")
     jp.add_argument("--daemon", default="127.0.0.1:51111")
     jp.set_defaults(fn=cmd_physical_join)
+
+    wp = sub.add_parser(
+        "whatif",
+        help="what-if sweep: fork a snapshot (live daemon or topology "
+             "YAML), run perturbed replicas, print a ranked comparison")
+    wp.add_argument("--daemon", default=None, metavar="HOST:PORT",
+                    help="query a LIVE daemon (snapshot of its running "
+                         "data plane)")
+    wp.add_argument("--file", default=None,
+                    help="topology YAML for a local (daemonless) sweep")
+    wp.add_argument("--spec", default=None, metavar="YAML",
+                    help="scenario spec file (see `whatif` docs); "
+                         "omitted = baseline only")
+    wp.add_argument("--ticks", type=int, default=1000)
+    wp.add_argument("--dt-us", type=float, default=1000.0)
+    wp.add_argument("--rate", default=None,
+                    help="offered CBR per edge, e.g. 1Mbit (default)")
+    wp.add_argument("--seed", type=int, default=0)
+    wp.add_argument("--timeout", type=float, default=300.0)
+    wp.add_argument("--json", action="store_true",
+                    help="machine-readable output instead of the table")
+    wp.set_defaults(fn=cmd_whatif)
 
     bp = sub.add_parser("bench", help="run the headline benchmark")
     bp.set_defaults(fn=cmd_bench)

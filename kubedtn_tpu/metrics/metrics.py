@@ -287,6 +287,39 @@ class DataPlaneStatsCollector:
         return out
 
 
+class WhatIfStatsCollector:
+    """kubedtn_whatif_* counters — observability for daemon-served
+    what-if sweeps (kubedtn_tpu.twin.query): volume served (sweeps,
+    scenarios, replicas, replica-steps) and where the device time went
+    (compile vs run seconds), so an operator can see both the query
+    load and whether the executable cache is doing its job."""
+
+    SERIES = (
+        ("sweeps_served", "What-if sweeps served"),
+        ("scenarios_served", "Scenario replicas requested across sweeps"),
+        ("replicas_run", "Replica lanes run (incl. baseline/padding)"),
+        ("replica_steps_run",
+         "Total replica-ticks advanced by the twin engine"),
+        ("compile_seconds",
+         "Wall seconds compiling sweep executables (one per "
+         "(N,T,capacity) shape; 0 growth = warm cache)"),
+        ("run_seconds", "Wall seconds executing compiled sweeps"),
+        ("errors", "What-if requests rejected or failed"),
+    )
+
+    def __init__(self, stats) -> None:
+        self._stats = stats
+
+    def collect(self):
+        snap = self._stats.snapshot()
+        out = []
+        for name, doc in self.SERIES:
+            g = CounterMetricFamily(f"kubedtn_whatif_{name}", doc)
+            g.add_metric([], float(snap[name]))
+            out.append(g)
+        return out
+
+
 class MetricsServer:
     """Serves the registry on an HTTP port — the daemon's :51112/metrics
     endpoint (reference daemon/main.go:57-66)."""
@@ -328,7 +361,8 @@ class MetricsServer:
 
 
 def make_registry(engine=None, sim_counters_fn=None,
-                  max_interfaces: int = 10_000, dataplane=None):
+                  max_interfaces: int = 10_000, dataplane=None,
+                  whatif_stats=None):
     """Registry with the parity collectors installed."""
     registry = CollectorRegistry()
     hist = LatencyHistograms(registry)
@@ -337,4 +371,6 @@ def make_registry(engine=None, sim_counters_fn=None,
             engine, sim_counters_fn, max_interfaces=max_interfaces))
     if dataplane is not None:
         registry.register(DataPlaneStatsCollector(dataplane))
+    if whatif_stats is not None:
+        registry.register(WhatIfStatsCollector(whatif_stats))
     return registry, hist

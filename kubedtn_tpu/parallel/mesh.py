@@ -30,10 +30,15 @@ except ImportError:  # older jax (e.g. 0.4.x): experimental home, where
 
     shard_map = _functools.partial(_shard_map_exp, check_rep=False)
 
-__all__ = ["EDGE_AXIS", "make_mesh", "edge_sharding", "replicated",
+__all__ = ["EDGE_AXIS", "REPLICA_AXIS", "make_mesh", "make_replica_mesh",
+           "edge_sharding", "replica_sharding", "replicated",
            "init_distributed", "shard_map"]
 
 EDGE_AXIS = "edge"
+# The what-if twin's scaling axis (kubedtn_tpu.twin.engine): replicas of
+# the whole edge state, embarrassingly parallel — a sweep sharded over
+# this axis partitions with zero collectives.
+REPLICA_AXIS = "replica"
 
 
 def make_mesh(n_devices: int | None = None,
@@ -46,9 +51,26 @@ def make_mesh(n_devices: int | None = None,
     return Mesh(np.array(devices), (EDGE_AXIS,))
 
 
+def make_replica_mesh(n_devices: int | None = None,
+                      devices: list | None = None) -> Mesh:
+    """1-D mesh over the what-if REPLICA axis (twin sweeps shard their
+    leading replica dimension across it; N must be a multiple of the
+    mesh size — twin.spec pads with unperturbed replicas)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (REPLICA_AXIS,))
+
+
 def edge_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading (edge) dimension, replicate the rest."""
     return NamedSharding(mesh, P(EDGE_AXIS))
+
+
+def replica_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (replica) dimension, replicate the rest."""
+    return NamedSharding(mesh, P(REPLICA_AXIS))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -116,13 +116,25 @@ def router_step(rs: RouterState, spec: TrafficSpec, flow_dst: jax.Array,
     sourced on each edge; entries < 0 default to the edge's own far end
     (single-hop). Pending lanes are forwarded packets re-entering mid-path.
     """
-    sim = rs.sim
-    E = sim.edges.capacity
     kg, ks = jax.random.split(key)
 
     # 1. traffic + pending-forward arrivals
-    tstate, sizes_t, valid_t, t_arr_t = generate(spec, sim.traffic, dt_us,
-                                                 k_slots, kg)
+    tstate, sizes_t, valid_t, t_arr_t = generate(spec, rs.sim.traffic,
+                                                 dt_us, k_slots, kg)
+    return _finish_router_step(rs, spec, flow_dst, tstate, sizes_t,
+                               valid_t, t_arr_t, ks, k_fwd, dt_us)
+
+
+def _finish_router_step(rs: RouterState, spec: TrafficSpec,
+                        flow_dst: jax.Array, tstate, sizes_t, valid_t,
+                        t_arr_t, ks, k_fwd: int, dt_us: jax.Array):
+    """Everything after traffic generation — split out so the what-if
+    twin engine (kubedtn_tpu.twin.engine) can hoist the replica-
+    independent `generate` out of its vmap (traffic evolution never
+    reads edge state, so one unbatched call per step serves every
+    replica and keeps replica 0 bit-identical to `run_routed`)."""
+    sim = rs.sim
+    E = sim.edges.capacity
     valid_t = valid_t & sim.edges.active[:, None]
     sizes_t = jnp.where(valid_t, sizes_t, 0.0)  # keep byte counters honest
     fd = jnp.where(flow_dst >= 0, flow_dst, sim.edges.dst)

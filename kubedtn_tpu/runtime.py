@@ -897,6 +897,9 @@ class WireDataPlane:
         # ticks immediately instead of sleeping out the period
         self._wake = threading.Event()
         daemon.ingress_signal = self._wake
+        # the what-if query surface (twin.query) snapshots the live
+        # plane through this back-reference
+        daemon.dataplane = self
         self._thread: threading.Thread | None = None
         self.counters: EdgeCounters = init_counters(
             self.engine.state.capacity)
@@ -1143,12 +1146,20 @@ class WireDataPlane:
                               else self.last_now_s)
         finally:
             self._ff_active = False
+        wall_s = time.monotonic() - wall0
+        ticks = self.ticks - t0_ticks
         return {
             "sim_seconds": sim_seconds,
-            "ticks": self.ticks - t0_ticks,
+            "ticks": ticks,
             "shaped": self.shaped - t0_shaped,
             "virtual_clock_s": t,
-            "wall_s": round(time.monotonic() - wall0, 3),
+            "wall_s": round(wall_s, 3),
+            # effective virtual speedup + tick rate: directly comparable
+            # to the twin engine's replicas·steps/s bench figures
+            "virtual_speedup": round(sim_seconds / wall_s, 2)
+            if wall_s > 0 else None,
+            "ticks_per_s": round(ticks / wall_s, 1) if wall_s > 0
+            else None,
         }
 
     # -- pending-frame persistence ------------------------------------

@@ -74,21 +74,36 @@ def _add(c: EdgeCounters, **deltas) -> EdgeCounters:
         c, **{k: getattr(c, k) + v for k, v in deltas.items()})
 
 
-@partial(jax.jit, static_argnums=(3,), donate_argnums=0)
-def sim_step(sim: SimState, spec: TrafficSpec, key: jax.Array,
-             k_slots: int, dt_us: jax.Array):
-    """One data-plane step: generate → shape → enqueue → deliver.
+def _step_parts(sim: SimState, spec: TrafficSpec, key: jax.Array,
+                k_slots: int, dt_us: jax.Array, size_scale=None):
+    """Shared body of `sim_step`: generate → shape → enqueue → deliver,
+    split so the what-if twin engine (kubedtn_tpu.twin.engine) can
+    reuse it piecewise: traffic generation is replica-INDEPENDENT (the
+    active mask applies after it, and nothing downstream feeds back),
+    so a replica sweep hoists `generate` out of its vmap — one
+    unbatched call per step, bit-identical to this function's — and
+    vmaps only `_finish_step`. `size_scale` (scalar) multiplies
+    generated packet sizes — the twin's per-replica offered-load dial;
+    None traces the exact historical program.
 
-    Returns (sim', delivered_mask bool[E, Q]) — the mask refers to the
-    pre-pop in-flight arrays for callers needing per-packet delivery times.
-    """
+    Returns (sim', due, res, sizes, t_arr)."""
     kg, ks = jax.random.split(key)
 
     # 1. traffic sources
     tstate, sizes, valid, t_arr = generate(spec, sim.traffic, dt_us,
                                            k_slots, kg)
+    return _finish_step(sim, tstate, sizes, valid, t_arr, ks, dt_us,
+                        size_scale)
+
+
+def _finish_step(sim: SimState, tstate, sizes, valid, t_arr, ks,
+                 dt_us: jax.Array, size_scale=None):
+    """Steps 2-4 of the data-plane step (everything after traffic
+    generation): shape → enqueue → deliver → counters → epoch roll."""
     valid = valid & sim.edges.active[:, None]
     sizes = jnp.where(valid, sizes, 0.0)  # keep byte counters honest
+    if size_scale is not None:
+        sizes = sizes * size_scale
 
     # 2. qdisc chain (netem root + TBF child), K sequential slots per edge
     edges, res = shape_packets(sim.edges, sizes, valid, t_arr, ks)
@@ -129,6 +144,19 @@ def sim_step(sim: SimState, spec: TrafficSpec, key: jax.Array,
     edges = netem.roll_epoch.__wrapped__(edges, dt_us)
     sim2 = SimState(edges=edges, inflight=fl_after, counters=counters,
                     traffic=tstate, clock_us=sim.clock_us + dt_us)
+    return sim2, due, res, sizes, t_arr
+
+
+@partial(jax.jit, static_argnums=(3,), donate_argnums=0)
+def sim_step(sim: SimState, spec: TrafficSpec, key: jax.Array,
+             k_slots: int, dt_us: jax.Array):
+    """One data-plane step: generate → shape → enqueue → deliver.
+
+    Returns (sim', delivered_mask bool[E, Q]) — the mask refers to the
+    pre-pop in-flight arrays for callers needing per-packet delivery times.
+    """
+    sim2, due, _res, _sizes, _t_arr = _step_parts(sim, spec, key, k_slots,
+                                                  dt_us)
     return sim2, due
 
 

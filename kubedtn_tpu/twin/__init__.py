@@ -1,0 +1,48 @@
+"""What-if plane — batched snapshot-fork replica engine.
+
+The live data plane answers "what IS the network doing"; this package
+answers "what WOULD it do" — fork a consistent snapshot of the running
+twin, apply N perturbed futures (link degrades/failures, node
+blackholes, offered-load scaling, property deltas), and advance all N
+replicas × T virtual ticks in ONE jitted scan with the replica axis as
+just another array dimension. The reference, bound to kernel qdisc
+clocks, can never run one topology faster than real time, let alone
+hundreds of perturbed copies at once.
+
+Layers:
+- snapshot: consistent capture from a live plane / sim / router state
+  (crossing the pipeline flush() barrier — the runner never stops).
+- spec: the perturbation vocabulary and its compilation into padded
+  per-replica edit batches (device scatters, update_links semantics).
+- engine: the batched replica engine — vmapped `sim_step`/`router_step`
+  under one lax.scan, on-device metric reductions (latency histogram
+  against the reference Prometheus buckets, loss, throughput, queue
+  occupancy), optional sharding over the parallel/mesh replica axis.
+- report: ranking + rendering of a sweep (the `kdt whatif` output).
+- query: the daemon-side WhatIfRequest service surface.
+"""
+
+from kubedtn_tpu.twin.engine import SweepResult, run_sweep, run_sweep_routed
+from kubedtn_tpu.twin.report import rank_results, render_report
+from kubedtn_tpu.twin.snapshot import (
+    TwinSnapshot,
+    snapshot_from_plane,
+    snapshot_from_router,
+    snapshot_from_sim,
+)
+from kubedtn_tpu.twin.spec import Perturbation, Scenario, compile_scenarios
+
+__all__ = [
+    "Perturbation",
+    "Scenario",
+    "SweepResult",
+    "TwinSnapshot",
+    "compile_scenarios",
+    "rank_results",
+    "render_report",
+    "run_sweep",
+    "run_sweep_routed",
+    "snapshot_from_plane",
+    "snapshot_from_router",
+    "snapshot_from_sim",
+]

@@ -1,0 +1,499 @@
+"""Batched replica engine: N perturbed futures × T virtual ticks in ONE
+compiled program.
+
+The replica axis is just another array axis: the snapshot's `SimState`
+(or `RouterState`) broadcasts to [N, ...] leaves, per-replica edit
+batches scatter the perturbations in (update_links semantics), and one
+`lax.scan` over T per-step PRNG keys advances a vmapped
+`sim._step_parts` / `router_step` body with on-device metric
+reductions — delivery-latency histogram against the reference
+Prometheus buckets, delivered/dropped counters, queue occupancy. Only
+[N]-sized reductions ever cross to the host.
+
+Determinism contract (pinned by tests/test_twin.py):
+- The per-step keys are `jax.random.split(jax.random.key(seed), steps)`
+  — exactly `sim.run`'s schedule — and are SHARED across replicas
+  (vmapped with in_axes=None). Every random draw inside the step
+  depends only on (key, spec, shapes), so the draws hoist out of the
+  replica batch: replica 0 of an unperturbed sweep is bit-identical to
+  the unbatched `sim.run`/`run_routed` on the same snapshot and seed,
+  and padding replicas cannot perturb any real replica's streams —
+  the same sweep at N=4 and N=64 returns identical per-scenario
+  results.
+- Compilation is cached per (N, T, capacity, k_slots, ...) signature
+  via an AOT executable cache, so the compile cost is paid once per
+  shape and the compile/run split is measured exactly (the
+  `kubedtn_whatif_*` metrics).
+
+Sharding: pass `mesh=` (see parallel.mesh.make_replica_mesh) to shard
+the replica axis across devices — replicas are embarrassingly
+parallel, so GSPMD partitions the whole scan with zero communication.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubedtn_tpu.metrics.metrics import BUCKETS
+from kubedtn_tpu.models.traffic import TrafficSpec
+from kubedtn_tpu.ops import edge_state as es
+from kubedtn_tpu.twin.snapshot import TwinSnapshot
+from kubedtn_tpu.twin.spec import ReplicaEdits, compile_scenarios
+
+# latency histogram bin upper edges in µs — the reference daemon's
+# request-duration bucket ladder (metrics.BUCKETS, milliseconds) scaled
+# to the data plane's native unit; one overflow bin past the last edge
+BUCKET_EDGES_US = tuple(float(b) * 1000.0 for b in BUCKETS[1:])
+N_BINS = len(BUCKET_EDGES_US) + 1
+
+_COUNTER_KEYS = ("tx_packets", "tx_bytes", "rx_packets", "rx_bytes",
+                 "dropped_loss", "dropped_queue", "dropped_ring",
+                 "rx_corrupted", "duplicated", "reordered")
+
+
+@dataclasses.dataclass
+class SweepResult:
+    """One sweep's outcome: per-scenario metrics + provenance."""
+
+    names: list
+    metrics: list           # dict per scenario (see _replica_metrics)
+    replicas: int           # total replica lanes incl. baseline/padding
+    ticks: int
+    sim_seconds: float
+    compile_s: float        # 0.0 on a warm executable cache
+    run_s: float
+    replicas_steps_per_s: float
+    final: object = None    # batched final state (tests/forks); [N,...]
+
+
+def _broadcast(tree, n: int):
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), tree)
+
+
+@jax.jit
+def _apply_edits(bedges, rows, props, valid, drows, dvalid):
+    """Vmapped perturbation application: one update_links scatter (row
+    state reset, qdisc-reinstall semantics) plus one active-mask clear
+    per replica. All-invalid lanes drop — a no-edit replica's arrays
+    keep the base state's exact bits."""
+
+    def one(edges, r, p, v, dr, dv):
+        edges = es.update_links.__wrapped__(edges, r, p, v, False)
+        t = jnp.where(dv, dr, edges.capacity)
+        return dataclasses.replace(
+            edges, active=edges.active.at[t].set(False, mode="drop"))
+
+    return jax.vmap(one)(bedges, rows, props, valid, drows, dvalid)
+
+
+# -- the compiled sweep ------------------------------------------------
+
+def _spec_fingerprint(spec) -> tuple:
+    """Hashable identity of a TrafficSpec's exact contents — the sweep
+    closes over the spec as jaxpr CONSTANTS (below), so the compiled-fn
+    cache must key on the values, not the object."""
+    out = []
+    for f in dataclasses.fields(spec):
+        a = np.asarray(getattr(spec, f.name))
+        out.append((f.name, a.shape, str(a.dtype), a.tobytes()))
+    return tuple(out)
+
+
+def _spec_from_fingerprint(fp) -> TrafficSpec:
+    return TrafficSpec(**{
+        name: jnp.asarray(np.frombuffer(buf, dtype=dtype).reshape(shape))
+        for name, shape, dtype, buf in fp})
+
+
+@functools.lru_cache(maxsize=64)
+def _sweep_fn(k_slots: int, dt_us_f: float, spec_fp: tuple):
+    edges_us = jnp.asarray(BUCKET_EDGES_US, jnp.float32)
+    # dt AND the traffic spec are closure CONSTANTS, exactly as sim.run's
+    # scan closes over them: passed traced instead, XLA keeps
+    # `rate_b_us * dt` as a runtime multiply and contracts the following
+    # `credit + rate*dt` into an FMA — one rounding the constant-folded
+    # reference program doesn't take (measured ~2e-4 drift on
+    # traffic.credit). Bit-exact replica 0 is the contract, so the
+    # constant treatment must match; the lru key carries the spec's
+    # exact bytes.
+    dt_us = jnp.float32(dt_us_f)
+    spec = _spec_from_fingerprint(spec_fp)
+
+    def fn(bsim, keys, scale):
+        from kubedtn_tpu.models.traffic import generate
+        from kubedtn_tpu.sim import _finish_step
+
+        n = bsim.clock_us.shape[0]
+
+        def one(sim, s, tstate, sizes, valid, t_arr, ks):
+            sim2, due, res, sizes2, t_arr2 = _finish_step(
+                sim, tstate, sizes, valid, t_arr, ks, dt_us,
+                size_scale=s)
+            deliv = res.delivered
+            # one-hop delivery latency of every shaped-and-delivered
+            # packet this step (netem delay incl. rate backlog), binned
+            # against the reference bucket ladder on device
+            lat = (res.depart_us - t_arr2).ravel()
+            idx = jnp.searchsorted(edges_us, lat, side="left")
+            hist = jnp.zeros((N_BINS,), jnp.float32).at[idx].add(
+                deliv.ravel().astype(jnp.float32))
+            occ = jnp.isfinite(sim2.inflight.t).sum().astype(jnp.float32)
+            return sim2, hist, occ
+
+        def body(carry, key):
+            bsim, ts, hist, occ = carry
+            # traffic generation is replica-INDEPENDENT (the active mask
+            # applies downstream and nothing feeds back into the
+            # sources), so ONE unbatched call serves every replica: the
+            # credit/PRNG chain stays the exact program sim.run traces —
+            # a vmapped chain let XLA contract `credit + rate*dt` into
+            # an FMA the reference program doesn't use, drifting replica
+            # 0 by one rounding
+            kg, ks = jax.random.split(key)
+            ts2, sizes, valid, t_arr = generate(spec, ts, dt_us, k_slots,
+                                                kg)
+            bsim2, h, o = jax.vmap(
+                one, in_axes=(0, 0, None, None, None, None, None))(
+                bsim, scale, ts2, sizes, valid, t_arr, ks)
+            return (bsim2, ts2, hist + h, occ + o), None
+
+        # all replicas share one traffic chain; lane 0's state IS it
+        ts0 = jax.tree.map(lambda x: x[0], bsim.traffic)
+        init = (bsim, ts0, jnp.zeros((n, N_BINS), jnp.float32),
+                jnp.zeros((n,), jnp.float32))
+        (bsim, _ts, hist, occ), _ = jax.lax.scan(body, init, keys)
+        # per-replica counter totals reduced on device: [N] each
+        totals = {k: getattr(bsim.counters, k).sum(axis=1)
+                  for k in _COUNTER_KEYS}
+        return bsim, hist, occ / keys.shape[0], totals
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _routed_sweep_fn(k_slots: int, k_fwd: int):
+    from kubedtn_tpu.models.traffic import generate
+    from kubedtn_tpu.router import _finish_router_step
+
+    def fn(brs, spec, flow_dst, keys, dt_us):
+        def body(carry, key):
+            brs, ts = carry
+            # same hoisted-generate treatment as the unrouted sweep:
+            # one unbatched traffic chain keeps replica 0 bit-identical
+            # to run_routed (see _sweep_fn)
+            kg, ks = jax.random.split(key)
+            ts2, sizes_t, valid_t, t_arr_t = generate(spec, ts, dt_us,
+                                                      k_slots, kg)
+            brs2 = jax.vmap(
+                lambda rs: _finish_router_step(
+                    rs, spec, flow_dst, ts2, sizes_t, valid_t, t_arr_t,
+                    ks, k_fwd, dt_us))(brs)
+            return (brs2, ts2), None
+
+        ts0 = jax.tree.map(lambda x: x[0], brs.sim.traffic)
+        (brs, _ts), _ = jax.lax.scan(body, (brs, ts0), keys)
+        totals = {k: getattr(brs.sim.counters, k).sum(axis=1)
+                  for k in _COUNTER_KEYS}
+        totals["node_rx_packets"] = brs.node_rx_packets.sum(axis=1)
+        totals["node_rx_bytes"] = brs.node_rx_bytes.sum(axis=1)
+        totals["fwd_dropped"] = brs.fwd_dropped
+        totals["no_route_dropped"] = brs.no_route_dropped
+        return brs, totals
+
+    return jax.jit(fn)
+
+
+# AOT executable cache: exactly ONE compile per (program, input-shape)
+# signature, and an exact compile-vs-run split for the whatif metrics.
+# LRU-bounded: the signature includes CLIENT-controlled parameters
+# (ticks, scenario count on the daemon's WhatIf surface), so an
+# unbounded dict would let varied queries grow a long-lived daemon's
+# memory monotonically — one compiled 10k-step scan per distinct shape.
+_EXEC_MAX = 32
+_EXEC_LOCK = threading.Lock()
+_EXEC_CACHE: collections.OrderedDict = collections.OrderedDict()
+
+
+def _compile_cached(jitted, sig, *args):
+    """(executable, compile_seconds) — compile_seconds is 0.0 on a hit."""
+    with _EXEC_LOCK:
+        hit = _EXEC_CACHE.get(sig)
+        if hit is not None:
+            _EXEC_CACHE.move_to_end(sig)
+            return hit, 0.0
+    t0 = time.perf_counter()
+    compiled = jitted.lower(*args).compile()
+    compile_s = time.perf_counter() - t0
+    with _EXEC_LOCK:
+        # a racer may have compiled too; either executable is valid
+        compiled = _EXEC_CACHE.setdefault(sig, compiled)
+        _EXEC_CACHE.move_to_end(sig)
+        while len(_EXEC_CACHE) > _EXEC_MAX:
+            _EXEC_CACHE.popitem(last=False)
+    return compiled, compile_s
+
+
+def _abstract_sig(tree):
+    return tuple((x.shape, str(x.dtype))
+                 for x in jax.tree.leaves(tree))
+
+
+def _mesh_sig(mesh):
+    """Value identity of a mesh for the executable cache: axis names +
+    device ids. id(mesh) would recompile for every equal-but-distinct
+    Mesh object (a caller building make_replica_mesh() per sweep) and,
+    worse, a GC'd mesh's reused id could alias a stale executable."""
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names),
+            tuple(d.id for d in mesh.devices.flat))
+
+
+# -- percentiles from bucket counts ------------------------------------
+
+def _percentiles(hist_row: np.ndarray, qs=(0.5, 0.9, 0.99)) -> dict:
+    """histogram_quantile over the reference bucket ladder: linear
+    interpolation inside a bin, the overflow bin capped at the last
+    edge (Prometheus semantics)."""
+    edges = np.asarray(BUCKET_EDGES_US)
+    total = float(hist_row.sum())
+    out = {}
+    for q in qs:
+        key = f"p{int(q * 100)}_us"
+        if total <= 0:
+            out[key] = None
+            continue
+        target = q * total
+        cum = np.cumsum(hist_row)
+        b = int(np.searchsorted(cum, target, side="left"))
+        if b >= len(edges):
+            out[key] = float(edges[-1])
+            continue
+        lo = 0.0 if b == 0 else float(edges[b - 1])
+        hi = float(edges[b])
+        below = 0.0 if b == 0 else float(cum[b - 1])
+        inbin = float(hist_row[b])
+        frac = 0.0 if inbin <= 0 else (target - below) / inbin
+        out[key] = round(lo + (hi - lo) * frac, 3)
+    return out
+
+
+def _replica_metrics(i: int, totals_np: dict, start: dict,
+                     hist: np.ndarray, occ: np.ndarray,
+                     sim_seconds: float) -> dict:
+    """One replica's report row. Two populations, deliberately:
+    `latency_hist`/percentiles measure the SHAPING latency of every
+    packet that left the qdisc chain (scheduled delivery, at shaping
+    time — including packets whose pop falls past the horizon), while
+    `delivered_packets`/`delivery_ratio` count pops WITHIN the horizon.
+    A latency perturbation comparable to the sweep horizon therefore
+    shows both a high p99 and a depressed delivery ratio — read
+    together, they say "slow AND not yet arrived", not a contradiction
+    (documented in ARCHITECTURE.md "What-if plane")."""
+    delta = {k: float(totals_np[k][i]) - start.get(k, 0.0)
+             for k in _COUNTER_KEYS}
+    m = {
+        "tx_packets": delta["tx_packets"],
+        "delivered_packets": delta["rx_packets"],
+        "delivered_bytes": delta["rx_bytes"],
+        "dropped_loss": delta["dropped_loss"],
+        "dropped_queue": delta["dropped_queue"],
+        "dropped_ring": delta["dropped_ring"],
+        "corrupted": delta["rx_corrupted"],
+        "throughput_bps": (delta["rx_bytes"] * 8.0 / sim_seconds
+                           if sim_seconds > 0 else 0.0),
+        "delivery_ratio": (delta["rx_packets"] / delta["tx_packets"]
+                           if delta["tx_packets"] > 0 else None),
+        "mean_queue_occupancy": float(occ[i]),
+        "latency_hist": [float(x) for x in hist[i]],
+    }
+    m.update(_percentiles(hist[i]))
+    for extra in ("node_rx_packets", "node_rx_bytes", "fwd_dropped",
+                  "no_route_dropped"):
+        if extra in totals_np:
+            m[extra] = float(totals_np[extra][i]) - start.get(extra, 0.0)
+    return m
+
+
+def _start_totals(counters) -> dict:
+    return {k: float(np.asarray(getattr(counters, k)).sum())
+            for k in _COUNTER_KEYS}
+
+
+def _shard_replicas(tree, mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubedtn_tpu.parallel.mesh import REPLICA_AXIS, replica_sharding
+
+    # the canonical replica sharding when the mesh uses the standard
+    # axis name; a caller-supplied custom mesh shards its first axis
+    if mesh.axis_names and mesh.axis_names[0] == REPLICA_AXIS:
+        sh = replica_sharding(mesh)
+    else:
+        sh = NamedSharding(mesh, P(mesh.axis_names[0]))
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+def run_sweep(snapshot: TwinSnapshot, scenarios, *, steps: int,
+              dt_us: float, spec: TrafficSpec | None = None,
+              k_slots: int = 4, seed: int = 0, mesh=None,
+              edits: ReplicaEdits | None = None, pod_ids=None,
+              keep_final: bool = False) -> SweepResult:
+    """Run one what-if sweep: scenario replicas forked from `snapshot`,
+    advanced `steps` × `dt_us` of virtual time under one compiled scan.
+
+    Replica layout: lane i runs scenarios[i]; when `mesh` is given the
+    lane count pads up to a multiple of the mesh size with unperturbed
+    replicas (dropped from the results). `spec` defaults to the query
+    surface's offered load (query.build_cbr_spec — the ONE default, so
+    a library sweep and a `kdt whatif` sweep answer the same question).
+    `edits` short-circuits compilation for callers that prebuilt the
+    batches.
+    """
+    names = [sc.name for sc in scenarios]
+    if len(set(names)) != len(names):
+        # reports and the wire surface key ranks by name — a duplicate
+        # would silently collapse two lanes' results
+        raise ValueError("scenario names must be unique")
+    base = snapshot.sim
+    cap = base.edges.capacity
+    if spec is None:
+        from kubedtn_tpu.twin.query import build_cbr_spec
+
+        spec = build_cbr_spec(base.edges)
+    pad_to = None
+    if mesh is not None:
+        size = int(mesh.devices.size)
+        pad_to = -(-max(len(scenarios), 1) // size) * size
+    if edits is None:
+        edits = compile_scenarios(scenarios, base.edges, pod_ids=pod_ids,
+                                  pad_replicas_to=pad_to)
+    n = edits.n_replicas
+    if n < len(scenarios):
+        raise ValueError("edits cover fewer replicas than scenarios")
+
+    bsim = _broadcast(base, n)
+    bedges = _apply_edits(bsim.edges, jnp.asarray(edits.rows),
+                          jnp.asarray(edits.props),
+                          jnp.asarray(edits.valid),
+                          jnp.asarray(edits.drows),
+                          jnp.asarray(edits.dvalid))
+    bsim = dataclasses.replace(bsim, edges=bedges)
+    scale = jnp.asarray(edits.scale)
+    keys = jax.random.split(jax.random.key(seed), steps)
+    if mesh is not None:
+        bsim = _shard_replicas(bsim, mesh)
+        scale = _shard_replicas(scale, mesh)
+
+    spec_fp = _spec_fingerprint(spec)
+    jitted = _sweep_fn(k_slots, float(dt_us), spec_fp)
+    # spec_fp itself (not its hash): the spec is a closure constant,
+    # invisible to _abstract_sig — a 64-bit hash collision between two
+    # same-shaped specs would silently reuse an executable baked with
+    # the wrong traffic constants
+    sig = ("sim", k_slots, float(dt_us), spec_fp, steps, n, cap,
+           _abstract_sig((bsim, keys, scale)),
+           _mesh_sig(mesh))
+    compiled, compile_s = _compile_cached(jitted, sig, bsim, keys, scale)
+    t0 = time.perf_counter()
+    bfinal, hist, occ, totals = compiled(bsim, keys, scale)
+    hist_np = np.asarray(hist)
+    occ_np = np.asarray(occ)
+    totals_np = {k: np.asarray(v) for k, v in totals.items()}
+    run_s = time.perf_counter() - t0
+
+    sim_seconds = steps * dt_us / 1e6
+    start = _start_totals(base.counters)
+    metrics = [_replica_metrics(i, totals_np, start, hist_np, occ_np,
+                                sim_seconds)
+               for i in range(len(scenarios))]
+    return SweepResult(
+        names=names, metrics=metrics, replicas=n, ticks=steps,
+        sim_seconds=sim_seconds, compile_s=round(compile_s, 3),
+        run_s=round(run_s, 3),
+        replicas_steps_per_s=round(n * steps / max(run_s, 1e-9), 1),
+        final=bfinal if keep_final else None)
+
+
+def run_sweep_routed(snapshot: TwinSnapshot, scenarios, *, steps: int,
+                     dt_us: float, spec: TrafficSpec, flow_dst,
+                     k_slots: int = 4, k_fwd: int = 8, seed: int = 0,
+                     mesh=None, pod_ids=None,
+                     keep_final: bool = False) -> SweepResult:
+    """run_sweep over the multi-hop forwarding plane: vmapped
+    `router_step` with the snapshot's routing table shared across
+    replicas. Link perturbations apply per replica; offered-load
+    scaling needs the unrouted engine (`router_step` has no size dial),
+    so a scaled scenario is rejected here."""
+    rs = snapshot.router
+    if rs is None:
+        raise ValueError("snapshot carries no RouterState; capture with "
+                         "snapshot_from_router")
+    for sc in scenarios:
+        if sc.traffic_scale != 1.0:
+            raise ValueError(
+                f"scenario {sc.name!r}: traffic scale is only supported "
+                f"by the unrouted sweep (run_sweep)")
+    cap = rs.sim.edges.capacity
+    pad_to = None
+    if mesh is not None:
+        size = int(mesh.devices.size)
+        pad_to = -(-max(len(scenarios), 1) // size) * size
+    edits = compile_scenarios(scenarios, rs.sim.edges, pod_ids=pod_ids,
+                              pad_replicas_to=pad_to)
+    n = edits.n_replicas
+
+    brs = _broadcast(rs, n)
+    bedges = _apply_edits(brs.sim.edges, jnp.asarray(edits.rows),
+                          jnp.asarray(edits.props),
+                          jnp.asarray(edits.valid),
+                          jnp.asarray(edits.drows),
+                          jnp.asarray(edits.dvalid))
+    brs = dataclasses.replace(
+        brs, sim=dataclasses.replace(brs.sim, edges=bedges))
+    keys = jax.random.split(jax.random.key(seed), steps)
+    dt = jnp.float32(dt_us)
+    if mesh is not None:
+        brs = _shard_replicas(brs, mesh)
+
+    jitted = _routed_sweep_fn(k_slots, k_fwd)
+    sig = ("routed", k_slots, k_fwd, steps, n, cap,
+           _abstract_sig((brs, spec, flow_dst, keys, dt)),
+           _mesh_sig(mesh))
+    compiled, compile_s = _compile_cached(jitted, sig, brs, spec,
+                                          flow_dst, keys, dt)
+    t0 = time.perf_counter()
+    bfinal, totals = compiled(brs, spec, flow_dst, keys, dt)
+    totals_np = {k: np.asarray(v) for k, v in totals.items()}
+    run_s = time.perf_counter() - t0
+
+    sim_seconds = steps * dt_us / 1e6
+    start = _start_totals(rs.sim.counters)
+    start["node_rx_packets"] = float(np.asarray(rs.node_rx_packets).sum())
+    start["node_rx_bytes"] = float(np.asarray(rs.node_rx_bytes).sum())
+    start["fwd_dropped"] = float(np.asarray(rs.fwd_dropped))
+    start["no_route_dropped"] = float(np.asarray(rs.no_route_dropped))
+    zeros = np.zeros((n, N_BINS), np.float32)
+    occ = np.zeros((n,), np.float32)
+    metrics = [_replica_metrics(i, totals_np, start, zeros, occ,
+                                sim_seconds)
+               for i in range(len(scenarios))]
+    for m in metrics:
+        m.pop("latency_hist", None)
+        for k in ("p50_us", "p90_us", "p99_us"):
+            m[k] = None
+    return SweepResult(
+        names=[sc.name for sc in scenarios], metrics=metrics,
+        replicas=n, ticks=steps, sim_seconds=sim_seconds,
+        compile_s=round(compile_s, 3), run_s=round(run_s, 3),
+        replicas_steps_per_s=round(n * steps / max(run_s, 1e-9), 1),
+        final=bfinal if keep_final else None)

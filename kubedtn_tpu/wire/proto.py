@@ -123,6 +123,67 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
     f.message_type.append(_msg(
         "GenerateNodeInterfaceNameResponse",
         _field("ok", 1, B), _field("node_intf_name", 2, S)))
+    # Framework extension (absent from reference kube_dtn.proto): the
+    # what-if query surface — a live daemon forks a consistent snapshot
+    # of its running data plane and answers counterfactual sweeps
+    # (kubedtn_tpu.twin) without stopping the real-time runner.
+    # Reference-built clients never see these types.
+    D = _T.TYPE_DOUBLE
+    f.message_type.append(_msg(
+        "WhatIfPerturbation",
+        _field("kind", 1, S),          # degrade|fail|blackhole|scale
+        _field("uid", 2, I64),
+        _field("node", 3, S),
+        _field("factor", 4, D),
+        _field("properties", 5, None, type_name="LinkProperties"),
+    ))
+    f.message_type.append(_msg(
+        "WhatIfScenario",
+        _field("name", 1, S),
+        _field("perturbations", 2, None, REP,
+               type_name="WhatIfPerturbation"),
+    ))
+    f.message_type.append(_msg(
+        "WhatIfRequest",
+        _field("scenarios", 1, None, REP, type_name="WhatIfScenario"),
+        _field("ticks", 2, I32),
+        _field("dt_us", 3, D),
+        _field("traffic_rate_bps", 4, D),
+        _field("traffic_pkt_bytes", 5, D),
+        _field("k_slots", 6, I32),
+        _field("seed", 7, I64),
+        _field("include_baseline", 8, B),
+    ))
+    f.message_type.append(_msg(
+        "WhatIfMetrics",
+        _field("name", 1, S),
+        _field("tx_packets", 2, D),
+        _field("delivered_packets", 3, D),
+        _field("delivered_bytes", 4, D),
+        _field("dropped_loss", 5, D),
+        _field("dropped_queue", 6, D),
+        _field("dropped_ring", 7, D),
+        _field("throughput_bps", 8, D),
+        _field("delivery_ratio", 9, D),
+        _field("p50_us", 10, D),
+        _field("p90_us", 11, D),
+        _field("p99_us", 12, D),
+        _field("mean_queue_occupancy", 13, D),
+        _field("latency_hist", 14, D, REP),
+        _field("rank", 15, I32),
+    ))
+    f.message_type.append(_msg(
+        "WhatIfResponse",
+        _field("ok", 1, B),
+        _field("error", 2, S),
+        _field("results", 3, None, REP, type_name="WhatIfMetrics"),
+        _field("replicas", 4, I32),
+        _field("ticks", 5, I32),
+        _field("sim_seconds", 6, D),
+        _field("compile_s", 7, D),
+        _field("run_s", 8, D),
+        _field("replicas_steps_per_s", 9, D),
+    ))
     return f
 
 
@@ -135,7 +196,9 @@ for _name in ("LinkProperties", "Link", "Pod", "PodQuery",
               "RemotePod", "WireDef", "WireCreateResponse", "Packet",
               "PacketBatch",
               "GenerateNodeInterfaceNameRequest",
-              "GenerateNodeInterfaceNameResponse"):
+              "GenerateNodeInterfaceNameResponse",
+              "WhatIfPerturbation", "WhatIfScenario", "WhatIfRequest",
+              "WhatIfMetrics", "WhatIfResponse"):
     _MESSAGES[_name] = message_factory.GetMessageClass(
         _pool.FindMessageTypeByName(f"{PACKAGE}.{_name}"))
 
@@ -155,6 +218,11 @@ GenerateNodeInterfaceNameRequest = _MESSAGES[
     "GenerateNodeInterfaceNameRequest"]
 GenerateNodeInterfaceNameResponse = _MESSAGES[
     "GenerateNodeInterfaceNameResponse"]
+WhatIfPerturbation = _MESSAGES["WhatIfPerturbation"]
+WhatIfScenario = _MESSAGES["WhatIfScenario"]
+WhatIfRequest = _MESSAGES["WhatIfRequest"]
+WhatIfMetrics = _MESSAGES["WhatIfMetrics"]
+WhatIfResponse = _MESSAGES["WhatIfResponse"]
 
 # Service method tables: name -> (request class, response class, streaming)
 LOCAL_METHODS = {
@@ -170,6 +238,9 @@ LOCAL_METHODS = {
     "RemGRPCWire": (WireDef, BoolResponse, False),
     "GenerateNodeInterfaceName": (GenerateNodeInterfaceNameRequest,
                                   GenerateNodeInterfaceNameResponse, False),
+    # Framework extension: what-if sweeps served from the live daemon's
+    # forked snapshot (kubedtn_tpu.twin.query; not in the reference IDL)
+    "WhatIf": (WhatIfRequest, WhatIfResponse, False),
 }
 REMOTE_METHODS = {
     "Update": (RemotePod, BoolResponse, False),

@@ -350,6 +350,10 @@ class Daemon:
         # ingress arriving mid-sleep starts a tick immediately instead of
         # waiting out the period
         self.ingress_signal: threading.Event | None = None
+        # back-reference installed by WireDataPlane: the what-if query
+        # surface snapshots the LIVE plane through it (engine-only
+        # snapshots when no plane is attached)
+        self.dataplane = None
         self.wires = WireManager(on_ingress=self.mark_hot)
         self.hist = latency_histograms
         # deadline on per-frame peer forwards: a blackholed peer must cost
@@ -497,6 +501,15 @@ class Daemon:
                                               request.pod_intf_name)
         return pb.GenerateNodeInterfaceNameResponse(ok=True,
                                                     node_intf_name=name)
+
+    def WhatIf(self, request, context):
+        """Framework extension: serve a what-if sweep from a consistent
+        fork of the LIVE data plane (kubedtn_tpu.twin) — the real-time
+        runner keeps ticking while the replicas run; only the snapshot
+        barrier (one pipeline flush) briefly holds the tick lock."""
+        from kubedtn_tpu.twin.query import serve_whatif
+
+        return serve_whatif(self, request)
 
     # -- Remote --------------------------------------------------------
 

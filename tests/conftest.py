@@ -39,6 +39,31 @@ if _TEST_PLATFORM == "cpu":
 
 import pytest  # noqa: E402
 
+# -- reference-checkout gating ----------------------------------------
+#
+# Convention: tests that read the reference repo's sample files
+# (config/samples/*.yml, proto/v1/kube_dtn.proto) carry
+# `@pytest.mark.requires_reference_yaml`. The reference checkout is an
+# ENVIRONMENT dependency, not a code one — CI images without
+# /root/reference used to fail ~50 tests with a misleading
+# AttributeError (load_yaml treats a missing path as literal YAML
+# text), polluting every tier-1 failure-set diff against the seed.
+# Marked tests auto-skip below with a reason naming the missing env, so
+# the failure set stays exactly "real regressions".
+REFERENCE_ROOT = "/root/reference"
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.path.exists(REFERENCE_ROOT):
+        return
+    skip = pytest.mark.skip(
+        reason=f"requires_reference_yaml: reference checkout missing at "
+               f"{REFERENCE_ROOT} (this environment ships without the "
+               f"dtn-dslab/kube-dtn sample files)")
+    for item in items:
+        if "requires_reference_yaml" in item.keywords:
+            item.add_marker(skip)
+
 
 @pytest.fixture(scope="session")
 def devices8():

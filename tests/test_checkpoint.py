@@ -40,6 +40,7 @@ def engine_fingerprint(engine: SimEngine):
     }
 
 
+@pytest.mark.requires_reference_yaml
 def test_rebuild_engine_reconstruction():
     """Daemon restart: device arrays are rebuildable from the store."""
     store, engine, _ = build_three_node()
@@ -60,6 +61,7 @@ def test_rebuild_engine_reconstruction():
                 assert a[k] == b[k], (pod, uid, k)
 
 
+@pytest.mark.requires_reference_yaml
 def test_rebuild_skips_dead_pods():
     store, engine, topos = build_three_node()
     engine.destroy_pod(topos[0].name, topos[0].namespace)
@@ -68,6 +70,7 @@ def test_rebuild_skips_dead_pods():
     assert all(pod != dead_key for pod, _ in rebuilt._rows)
 
 
+@pytest.mark.requires_reference_yaml
 def test_checkpoint_roundtrip(tmp_path):
     store, engine, topos = build_three_node()
     # advance mutable shaping state so restore has something to preserve
@@ -98,6 +101,7 @@ def test_checkpoint_roundtrip(tmp_path):
         assert t2.resource_version == t.resource_version
 
 
+@pytest.mark.requires_reference_yaml
 def test_restored_engine_keeps_working(tmp_path):
     """Resume then mutate: the restored engine accepts new reconciles."""
     store, engine, topos = build_three_node()
@@ -123,6 +127,7 @@ def test_restored_engine_keeps_working(tmp_path):
     assert row is not None and row["latency_us"] == 42000.0
 
 
+@pytest.mark.requires_reference_yaml
 def test_checkpoint_with_sim_state(tmp_path):
     from kubedtn_tpu.models.traffic import cbr_everywhere
     from kubedtn_tpu import sim as S
@@ -180,6 +185,7 @@ def test_restored_engine_rebuilds_shaped_rows(tmp_path):
     assert not engine2.is_shaped(engine2.row_of("default/s", 2))
 
 
+@pytest.mark.requires_reference_yaml
 def test_daemon_restart_resumes_shaping_e2e(tmp_path):
     """Full daemon-restart story (the reference's restart rescan,
     SURVEY §5.3-5.4): checkpoint a live daemon's store+engine, 'crash'

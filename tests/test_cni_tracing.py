@@ -45,6 +45,7 @@ def env_for(cmd: str, pod: str, ns: str = "default") -> dict:
     }
 
 
+@pytest.mark.requires_reference_yaml
 def test_cmd_add_realizes_pod(daemon_port, capsys):
     port, engine = daemon_port
     prev = {"cniVersion": "1.0.0", "ips": [{"address": "10.244.0.7/24"}]}
@@ -56,6 +57,7 @@ def test_cmd_add_realizes_pod(daemon_port, capsys):
     assert engine.is_alive("default/r1")
 
 
+@pytest.mark.requires_reference_yaml
 def test_add_then_peer_plumbs_links(daemon_port, capsys):
     port, engine = daemon_port
     cni.main(stdin_text=conf(port), env=env_for("ADD", "r1"))
@@ -65,6 +67,7 @@ def test_add_then_peer_plumbs_links(daemon_port, capsys):
     assert engine.num_active >= 2
 
 
+@pytest.mark.requires_reference_yaml
 def test_non_topology_pod_errors_but_del_is_silent(daemon_port, capsys):
     port, engine = daemon_port
     # SetupPod returns True for unknown pods (delegate), so ADD succeeds
@@ -76,6 +79,7 @@ def test_non_topology_pod_errors_but_del_is_silent(daemon_port, capsys):
     assert rc == 0
 
 
+@pytest.mark.requires_reference_yaml
 def test_cmd_del(daemon_port, capsys):
     port, engine = daemon_port
     cni.main(stdin_text=conf(port), env=env_for("ADD", "r1"))
@@ -93,6 +97,7 @@ def test_version(capsys):
     assert "1.0.0" in out["supportedVersions"]
 
 
+@pytest.mark.requires_reference_yaml
 def test_check_noop(daemon_port):
     port, _ = daemon_port
     assert cni.main(stdin_text=conf(port), env=env_for("CHECK", "r1")) == 0

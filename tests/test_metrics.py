@@ -1,5 +1,7 @@
 """Metrics parity tests: series names, buckets, labels, HTTP exposition."""
 
+import pytest
+
 import urllib.request
 
 from kubedtn_tpu.api.types import LinkProperties, load_yaml
@@ -42,6 +44,7 @@ def test_histogram_name_and_buckets():
     assert 'method="add"' in text and 'method="update"' in text
 
 
+@pytest.mark.requires_reference_yaml
 def test_interface_series():
     engine, sim = build_cluster_with_traffic()
     registry, _ = make_registry(engine, lambda: sim.counters)
@@ -58,6 +61,7 @@ def test_interface_series():
     assert any(float(l.rsplit(" ", 1)[1]) > 0 for l in lines)
 
 
+@pytest.mark.requires_reference_yaml
 def test_http_exposition():
     engine, sim = build_cluster_with_traffic()
     registry, hist = make_registry(engine, lambda: sim.counters)
@@ -80,6 +84,7 @@ def test_http_exposition():
         srv.stop()
 
 
+@pytest.mark.requires_reference_yaml
 def test_node_aggregates_and_series_cap():
     """Node totals are always exported; per-interface series truncate at
     max_interfaces with the truncation count reported (the 100k-interface
@@ -111,6 +116,7 @@ def test_node_aggregates_and_series_cap():
     assert float(trunc2.rsplit(" ", 1)[1]) == 0.0
 
 
+@pytest.mark.requires_reference_yaml
 def test_node_totals_exclude_deleted_links():
     """Freed rows keep their cumulative counters until reuse; node totals
     must sum ACTIVE rows only, so deleting a pod's links removes its
@@ -130,6 +136,7 @@ def test_node_totals_exclude_deleted_links():
     assert after < before
 
 
+@pytest.mark.requires_reference_yaml
 def test_dataplane_stats_series():
     """kubedtn_dataplane_* counters track the wire plane's runtime
     health (no reference analogue — its data plane is kernel state)."""

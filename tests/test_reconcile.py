@@ -70,6 +70,7 @@ class TestEngineLifecycle:
         assert engine.setup_pod("ghost") is True  # delegate, not error
         assert engine.num_active == 0
 
+    @pytest.mark.requires_reference_yaml
     def test_peer_alive_gating(self):
         store, engine, _ = cluster(REFERENCE_3NODE)
         engine.setup_pod("r1")
@@ -84,6 +85,7 @@ class TestEngineLifecycle:
         # full mesh: uids 1,2,3 × 2 directions
         assert engine.num_active == 6
 
+    @pytest.mark.requires_reference_yaml
     def test_finalizer_set_on_alive(self):
         store, engine, _ = cluster(REFERENCE_3NODE)
         engine.setup_pod("r1")
@@ -91,6 +93,7 @@ class TestEngineLifecycle:
         engine.destroy_pod("r1")
         assert store.get("default", "r1").finalizers == []
 
+    @pytest.mark.requires_reference_yaml
     def test_destroy_pod_tears_down_both_directions(self):
         store, engine, _ = cluster(REFERENCE_3NODE)
         for n in ("r1", "r2", "r3"):
@@ -139,6 +142,7 @@ class TestEngineLifecycle:
 
 
 class TestReconciler:
+    @pytest.mark.requires_reference_yaml
     def test_first_seen_copies_status_without_plumbing(self):
         store, engine, _ = cluster(REFERENCE_3NODE)
         rec = Reconciler(store, engine)
@@ -148,12 +152,14 @@ class TestReconciler:
         topo = store.get("default", "r1")
         assert topo.status.links == topo.spec.links
 
+    @pytest.mark.requires_reference_yaml
     def test_noop_when_steady(self):
         store, engine, _ = cluster(REFERENCE_3NODE)
         rec = Reconciler(store, engine)
         rec.reconcile("default", "r1")
         assert rec.reconcile("default", "r1").action == "noop"
 
+    @pytest.mark.requires_reference_yaml
     def test_property_change_flows_to_device(self):
         store, engine, _ = cluster(REFERENCE_3NODE)
         for n in ("r1", "r2", "r3"):
@@ -176,6 +182,7 @@ class TestReconciler:
         # update touches only the local end (handler.go:649-658)
         assert engine.link_row("default/r2", 1)["latency_us"] == 0.0
 
+    @pytest.mark.requires_reference_yaml
     def test_link_remove_via_spec(self):
         store, engine, _ = cluster(REFERENCE_3NODE)
         for n in ("r1", "r2", "r3"):
@@ -190,6 +197,7 @@ class TestReconciler:
         assert engine.row_of("default/r1", 2) is None
         assert engine.row_of("default/r3", 2) is None  # pair destroyed
 
+    @pytest.mark.requires_reference_yaml
     def test_drain_watch_loop(self):
         store, engine, _ = cluster(REFERENCE_3NODE)
         rec = Reconciler(store, engine)
@@ -251,6 +259,7 @@ class TestThreeNodeE2E:
         assert engine.num_active == 6
 
 
+@pytest.mark.requires_reference_yaml
 def test_destroy_pod_with_pending_deletion():
     # Deleting the CR while the pod is alive leaves it held by the
     # finalizer; DestroyPod must still tear down links even though
@@ -510,6 +519,7 @@ class TestPlacementGeneration:
     placement generation; these pin the generation's bump/no-bump rules
     and the cache's cross-drain invalidation."""
 
+    @pytest.mark.requires_reference_yaml
     def test_spec_update_and_status_copyback_keep_generation(self):
         store, engine, _ = cluster(REFERENCE_3NODE)
         engine.setup_pod("r1")
@@ -525,6 +535,7 @@ class TestPlacementGeneration:
         store.update_status(t)
         assert store.placement_generation == gen
 
+    @pytest.mark.requires_reference_yaml
     def test_placement_write_and_delete_bump_generation(self):
         store, engine, _ = cluster(REFERENCE_3NODE)
         gen = store.placement_generation
@@ -534,6 +545,7 @@ class TestPlacementGeneration:
         engine.destroy_pod("r1")  # clears placement (src_ip="")
         assert store.placement_generation > gen
 
+    @pytest.mark.requires_reference_yaml
     def test_cache_invalidated_when_peer_comes_alive(self):
         store, engine, _ = cluster(REFERENCE_3NODE)
         rec = Reconciler(store, engine)

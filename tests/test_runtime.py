@@ -38,6 +38,7 @@ def add_wire(daemon, pod, uid, wire_id_hint=0):
         intf_name_in_pod=f"eth{uid}", peer_intf_id=wire_id_hint))
 
 
+@pytest.mark.requires_reference_yaml
 def test_wire_frames_shaped_and_delivered_to_peer():
     """Frames entering r1's wire exit r2's wire after the netem delay."""
     daemon, engine = make_daemon(LATENCY)  # r1<->r2 uid 1 has 10ms latency
@@ -61,6 +62,7 @@ def test_wire_frames_shaped_and_delivered_to_peer():
     assert float(np.asarray(c.rx_packets).sum()) == 1.0
 
 
+@pytest.mark.requires_reference_yaml
 def test_wire_dataplane_thread_runs():
     daemon, engine = make_daemon(THREE_NODE)
     w1 = add_wire(daemon, "r1", 1)
@@ -81,6 +83,7 @@ def test_wire_dataplane_thread_runs():
     assert dp.ticks > 0
 
 
+@pytest.mark.requires_reference_yaml
 def test_metrics_scrape_concurrent_with_mutation():
     """The collector's locked snapshot never races engine mutators."""
     from prometheus_client import generate_latest
@@ -162,6 +165,7 @@ def test_corruption_persists_across_hops():
     assert float(np.asarray(rs.node_rx_packets)[n_nodes - 1]) > 0
 
 
+@pytest.mark.requires_reference_yaml
 def test_dataplane_uses_native_wheel_when_available():
     """The delay line rides the native timing wheel (Python heap only as
     fallback); pending frames drain through it and nothing leaks."""
@@ -208,6 +212,7 @@ native_only = pytest.mark.skipif(
     .have_native(), reason="native library unavailable")
 
 
+@pytest.mark.requires_reference_yaml
 @native_only
 def test_bypass_unshaped_tcp_flow_skips_shaping():
     """Same-node TCP flow over an UNSHAPED link: after the first message
@@ -231,6 +236,7 @@ def test_bypass_unshaped_tcp_flow_skips_shaping():
     assert dp.flow_stats["bypassed"] >= 1
 
 
+@pytest.mark.requires_reference_yaml
 @native_only
 def test_bypass_disabled_forever_on_shaped_link():
     """A flow crossing a shaped row is DISABLED permanently — even after
@@ -264,6 +270,7 @@ def test_bypass_disabled_forever_on_shaped_link():
     assert dp._flowtable.flag(sip, sport, dip, dport) == _n.PROXY_DISABLED
 
 
+@pytest.mark.requires_reference_yaml
 def test_addlinks_not_blocked_by_busy_dataplane():
     """Control-plane ops must not wait for a data-plane device dispatch:
     the tick holds the engine lock only for snapshot and write-back."""
@@ -306,6 +313,7 @@ def test_addlinks_not_blocked_by_busy_dataplane():
         dp.stop()
 
 
+@pytest.mark.requires_reference_yaml
 @native_only
 def test_bypass_never_for_cross_node_wires():
     """sockops redirection is socket-to-socket on ONE node: a flow whose
@@ -326,6 +334,7 @@ def test_bypass_never_for_cross_node_wires():
     assert dp.shaped == 3
 
 
+@pytest.mark.requires_reference_yaml
 def test_wheel_wakes_early_for_due_releases():
     """With a coarse tick period, a short netem delay still releases near
     its deadline: the runner sleeps only until the wheel's next due time,
@@ -363,6 +372,7 @@ def test_wheel_wakes_early_for_due_releases():
         dp.stop()
 
 
+@pytest.mark.requires_reference_yaml
 def test_unrealized_hot_wire_does_not_busy_spin():
     """A wire with frames but no realized link must NOT wake the runner
     in a tight loop — it stays hot for scheduled ticks only."""
@@ -522,6 +532,7 @@ def _half_second_daemon():
     return daemon, wa, wb
 
 
+@pytest.mark.requires_reference_yaml
 def test_fast_forward_virtual_time():
     """A 500ms-latency link delivers in milliseconds of wall time under
     fast_forward — virtual-time replay the real-time reference can't do."""
@@ -546,6 +557,7 @@ def test_fast_forward_virtual_time():
     assert len(wb.egress) == 2
 
 
+@pytest.mark.requires_reference_yaml
 def test_fast_forward_rejects_live_runner():
     daemon, _, _ = _half_second_daemon()
     dp = WireDataPlane(daemon)
@@ -557,6 +569,7 @@ def test_fast_forward_rejects_live_runner():
         dp.stop()
 
 
+@pytest.mark.requires_reference_yaml
 def test_fast_forward_then_realtime_keeps_remaining_latency():
     """Pending virtual-time releases survive a switch to the real-time
     runner with their REMAINING latency, not an instant release (the

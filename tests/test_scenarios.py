@@ -2,9 +2,12 @@
 and the CLI drive on hardware, shrunk so the suite exercises them on the
 virtual CPU mesh every run."""
 
+import pytest
+
 from kubedtn_tpu import scenarios as S
 
 
+@pytest.mark.requires_reference_yaml
 def test_three_node_reference_sample():
     r = S.three_node()
     assert r["links"] == 3

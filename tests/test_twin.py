@@ -1,0 +1,517 @@
+"""What-if plane tests: snapshot-fork consistency, replica bit-exactness,
+perturbation semantics, the daemon-served WhatIf query (live runner, zero
+frame loss), sharded replica meshes, and the bench-phase smoke.
+
+The heavy sweeps share ONE (N, T, capacity) shape via module-scope
+fixtures so the engine's executable cache compiles each program once for
+the whole module.
+"""
+
+import dataclasses
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubedtn_tpu import router as RT
+from kubedtn_tpu import sim as S
+from kubedtn_tpu.api.types import LinkProperties
+from kubedtn_tpu.models import topologies as T
+from kubedtn_tpu.models.traffic import cbr_everywhere
+from kubedtn_tpu.ops import routing as R
+from kubedtn_tpu.twin import (
+    Perturbation,
+    Scenario,
+    compile_scenarios,
+    rank_results,
+    render_report,
+    run_sweep,
+    run_sweep_routed,
+    snapshot_from_router,
+    snapshot_from_sim,
+)
+from kubedtn_tpu.twin.snapshot import load_snapshot, save_snapshot
+
+STEPS = 30
+DT_US = 1000.0
+K_SLOTS = 4
+N_NODES = 20
+
+
+def _bitwise_equal(ref_obj, batched_obj, lane, fields_of):
+    """Compare every leaf of `ref_obj` against lane `lane` of the
+    batched object, bitwise. Returns the list of mismatched leaves."""
+    bad = []
+    for name in fields_of:
+        ref_sub = getattr(ref_obj, name)
+        bat_sub = getattr(batched_obj, name)
+        for f in dataclasses.fields(ref_sub):
+            a = np.asarray(getattr(ref_sub, f.name))
+            b = np.asarray(getattr(bat_sub, f.name))[lane]
+            if a.tobytes() != b.tobytes():
+                bad.append(f"{name}.{f.name}")
+    return bad
+
+
+@pytest.fixture(scope="module")
+def base():
+    el = T.random_mesh(N_NODES, 40, seed=3,
+                       props=LinkProperties(latency="2ms", jitter="1ms",
+                                            loss="1"))
+    state, rows = T.load_edge_list_into_state(el)
+    spec = cbr_everywhere(state.capacity, len(rows), rate_bps=2e6,
+                          pkt_bytes=400.0)
+    sim = S.init_sim(state, q=16)
+    # warm prefix: fork mid-run so the snapshot carries non-trivial
+    # shaping state (token clocks, correlation memory, in-flight slots)
+    sim = S.run(sim, spec, steps=20, dt_us=DT_US, k_slots=K_SLOTS, seed=7)
+    return el, state, rows, spec, snapshot_from_sim(sim, n_nodes=N_NODES)
+
+
+SCENARIOS = [
+    Scenario("baseline"),
+    Scenario("degrade-lat", (Perturbation(
+        "degrade", uid=1, props=LinkProperties(latency="50ms")),)),
+    Scenario("degrade-loss", (Perturbation(
+        "degrade", uid=3, props=LinkProperties(latency="2ms",
+                                               loss="30")),)),
+    Scenario("fail", (Perturbation("fail", uid=2),)),
+    Scenario("blackhole", (Perturbation("blackhole", node=0),)),
+    Scenario("halve-load", (Perturbation("scale", factor=0.5),)),
+]
+
+
+@pytest.fixture(scope="module")
+def sweep(base):
+    _el, _state, _rows, spec, snap = base
+    return run_sweep(snap, SCENARIOS, steps=STEPS, dt_us=DT_US,
+                     spec=spec, k_slots=K_SLOTS, seed=11,
+                     keep_final=True)
+
+
+def test_replica0_unperturbed_bit_identical_to_sim_run(base, sweep):
+    """The fork contract: an empty perturbation continues the forked
+    SimState EXACTLY as the unbatched engine would — every leaf of
+    replica 0's final state matches sim.run bit for bit."""
+    _el, _state, _rows, spec, snap = base
+    ref = S.run(snap.sim, spec, steps=STEPS, dt_us=DT_US,
+                k_slots=K_SLOTS, seed=11)
+    bad = _bitwise_equal(ref, sweep.final, 0,
+                         ("edges", "inflight", "counters", "traffic"))
+    assert not bad, f"replica 0 diverged from sim.run on: {bad}"
+    assert (np.asarray(ref.clock_us).tobytes()
+            == np.asarray(sweep.final.clock_us)[0].tobytes())
+
+
+def test_same_seed_same_spec_reproducible(base, sweep):
+    _el, _state, _rows, spec, snap = base
+    again = run_sweep(snap, SCENARIOS, steps=STEPS, dt_us=DT_US,
+                      spec=spec, k_slots=K_SLOTS, seed=11)
+    assert again.metrics == sweep.metrics
+    assert again.compile_s == 0.0  # executable cache hit
+
+
+def test_padding_replicas_do_not_perturb_results(base, sweep):
+    """N=6 and N=16 (10 padding lanes) sweeps return identical
+    per-scenario results: padding replicas share the PRNG schedule
+    instead of splitting it, so they cannot shift any real replica's
+    streams."""
+    _el, _state, _rows, spec, snap = base
+    edits16 = compile_scenarios(SCENARIOS, snap.sim.edges,
+                                pad_replicas_to=16)
+    res16 = run_sweep(snap, SCENARIOS, steps=STEPS, dt_us=DT_US,
+                      spec=spec, k_slots=K_SLOTS, seed=11, edits=edits16)
+    assert res16.replicas == 16
+    assert res16.metrics == sweep.metrics
+
+
+def test_perturbations_change_the_future(sweep):
+    by = dict(zip(sweep.names, sweep.metrics))
+    base_m = by["baseline"]
+    # 50ms degrade on one link pushes its packets into the 50ms bucket
+    assert by["degrade-lat"]["p99_us"] > base_m["p99_us"]
+    # heavy loss on a link lowers the delivery ratio
+    assert (by["degrade-loss"]["delivery_ratio"]
+            < base_m["delivery_ratio"])
+    # a failed link stops sourcing traffic: fewer tx packets
+    assert by["fail"]["tx_packets"] < base_m["tx_packets"]
+    # a blackholed node kills every adjacent edge
+    assert by["blackhole"]["tx_packets"] < by["fail"]["tx_packets"]
+    # halving offered bytes ~halves delivered bytes (packets unchanged;
+    # the snapshot's pre-fork in-flight packets deliver at full size, so
+    # the ratio is bounded, not exact)
+    assert (0.4 * base_m["delivered_bytes"]
+            < by["halve-load"]["delivered_bytes"]
+            < 0.7 * base_m["delivered_bytes"])
+    assert (by["halve-load"]["delivered_packets"]
+            == base_m["delivered_packets"])
+
+
+def test_ranking_and_report(sweep):
+    ranked = rank_results(sweep)
+    assert [r for _n, _m, r in ranked] == list(range(1, len(ranked) + 1))
+    # worst delivery ranks first
+    ratios = [m["delivery_ratio"] for _n, m, _r in ranked]
+    assert ratios[0] == min(r for r in ratios if r is not None)
+    text = render_report(sweep)
+    for name in sweep.names:
+        assert name in text
+    assert "replica-steps/s" in text
+
+
+def test_sharded_replica_mesh_matches_unsharded(base, sweep, devices8):
+    """The replica axis shards over a device mesh with identical
+    results — replicas are embarrassingly parallel."""
+    from kubedtn_tpu.parallel.mesh import make_replica_mesh
+
+    _el, _state, _rows, spec, snap = base
+    mesh = make_replica_mesh(4, devices=devices8)
+    res = run_sweep(snap, SCENARIOS, steps=STEPS, dt_us=DT_US,
+                    spec=spec, k_slots=K_SLOTS, seed=11, mesh=mesh)
+    assert res.replicas % 4 == 0
+    assert res.metrics == sweep.metrics
+
+
+def test_routed_replica0_bit_identical_to_run_routed(base):
+    el, state, rows, spec, _snap = base
+    _, nh = R.recompute_routes(state, N_NODES, max_hops=8)
+    rs = RT.init_router(state, nh, N_NODES, q=16, k_fwd=4)
+    rng = np.random.default_rng(5)
+    fdst = np.full((state.capacity,), -1, np.int32)
+    fdst[:len(rows)] = rng.integers(0, N_NODES, len(rows))
+    flow_dst = jnp.asarray(fdst)
+    rs = RT.run_routed(rs, spec, flow_dst, steps=15, dt_us=DT_US,
+                       k_slots=K_SLOTS, k_fwd=4, seed=3)
+    snap = snapshot_from_router(rs, n_nodes=N_NODES)
+    ref = RT.run_routed(snap.router, spec, flow_dst, steps=20,
+                        dt_us=DT_US, k_slots=K_SLOTS, k_fwd=4, seed=9)
+    res = run_sweep_routed(snap, SCENARIOS[:3], steps=20, dt_us=DT_US,
+                           spec=spec, flow_dst=flow_dst,
+                           k_slots=K_SLOTS, k_fwd=4, seed=9,
+                           keep_final=True)
+    bad = _bitwise_equal(ref.sim, res.final.sim, 0,
+                         ("edges", "inflight", "counters", "traffic"))
+    for f in ("next_edge", "pend_size", "pend_dst", "pend_corr",
+              "node_rx_packets", "node_rx_bytes", "fwd_dropped",
+              "no_route_dropped"):
+        a = np.asarray(getattr(ref, f))
+        b = np.asarray(getattr(res.final, f))[0]
+        if a.tobytes() != b.tobytes():
+            bad.append(f)
+    assert not bad, f"routed replica 0 diverged on: {bad}"
+    assert res.metrics[0]["node_rx_packets"] > 0
+
+
+def test_routed_rejects_traffic_scale(base):
+    el, state, rows, spec, _snap = base
+    _, nh = R.recompute_routes(state, N_NODES, max_hops=8)
+    rs = RT.init_router(state, nh, N_NODES, q=16, k_fwd=4)
+    snap = snapshot_from_router(rs, n_nodes=N_NODES)
+    with pytest.raises(ValueError, match="traffic scale"):
+        run_sweep_routed(
+            snap, [Scenario("s", (Perturbation("scale", factor=2.0),))],
+            steps=5, dt_us=DT_US, spec=spec,
+            flow_dst=jnp.full((state.capacity,), -1, jnp.int32))
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="unknown perturbation"):
+        Perturbation("melt", uid=1)
+    with pytest.raises(ValueError, match="needs a link uid"):
+        Perturbation("fail")
+    with pytest.raises(ValueError, match="needs LinkProperties"):
+        Perturbation("degrade", uid=1)
+    with pytest.raises(ValueError, match="needs a node"):
+        Perturbation("blackhole")
+
+
+def test_compile_unknown_uid_raises(base):
+    _el, _state, _rows, _spec, snap = base
+    sc = [Scenario("x", (Perturbation("fail", uid=999_999),))]
+    with pytest.raises(ValueError, match="no active rows"):
+        compile_scenarios(sc, snap.sim.edges)
+
+
+def test_blackhole_resolves_node_names(base):
+    _el, _state, _rows, _spec, snap = base
+    pod_ids = {"default/left": 0, "default/right": 1}
+    sc = [Scenario("bh", (Perturbation("blackhole", node="left"),))]
+    edits = compile_scenarios(sc, snap.sim.edges, pod_ids=pod_ids)
+    assert edits.dvalid[0].any()
+    with pytest.raises(ValueError, match="not found"):
+        compile_scenarios(
+            [Scenario("bh", (Perturbation("blackhole", node="ghost"),))],
+            snap.sim.edges, pod_ids=pod_ids)
+
+
+def test_snapshot_save_load_roundtrip(tmp_path, base):
+    _el, _state, _rows, _spec, snap = base
+    p = str(tmp_path / "twin" / "snap.npz")
+    save_snapshot(p, snap)
+    back = load_snapshot(p)
+    assert back.n_nodes == snap.n_nodes
+    bad = _bitwise_equal(snap.sim, _Lane0Wrap(back.sim), 0,
+                         ("edges", "inflight", "counters", "traffic"))
+    assert not bad, bad
+
+
+class _Lane0Wrap:
+    """Adapter so _bitwise_equal's [lane] indexing works on an
+    unbatched state: wraps each leaf as a one-element batch."""
+
+    def __init__(self, sim):
+        self._sim = sim
+
+    def __getattr__(self, name):
+        sub = getattr(self._sim, name)
+
+        class _Sub:
+            pass
+
+        w = _Sub()
+        for f in dataclasses.fields(sub):
+            setattr(w, f.name, np.asarray(getattr(sub, f.name))[None])
+        return w
+
+
+# -- live daemon end-to-end --------------------------------------------
+
+def test_whatif_served_live_zero_frame_loss():
+    """Acceptance: a LIVE daemon (real-time runner ACTIVE, traffic
+    flowing) serves a WhatIf sweep end-to-end over gRPC — snapshot →
+    sweep → ranked report — and afterwards every frame fed during the
+    sweep has been delivered: zero live-frame loss."""
+    from kubedtn_tpu.metrics.metrics import make_registry
+    from kubedtn_tpu.scenarios import _live_plane_setup
+    from kubedtn_tpu.twin.query import stats_for
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+    from prometheus_client import generate_latest
+
+    pairs = 2
+    daemon, server, port, plane, wires_in, wires_out = _live_plane_setup(
+        pairs, "2ms", 2000.0, "tw")
+    frame = b"\x02" * 12 + b"\x07\x77" + b"\x00" * 50  # non-IP: no bypass
+    fed = [0]
+    stop = threading.Event()
+
+    def feeder():
+        while not stop.is_set():
+            for w in wires_in:
+                w.ingress.extend([frame] * 50)
+            fed[0] += 50 * pairs
+            stop.wait(0.02)
+
+    delivered = [0]
+
+    def drain() -> int:
+        c = 0
+        for w in wires_out:
+            dq = w.egress
+            while True:
+                try:
+                    dq.popleft()
+                except IndexError:
+                    break
+                c += 1
+        delivered[0] += c
+        return c
+
+    client = DaemonClient(f"127.0.0.1:{port}")
+    t = threading.Thread(target=feeder, daemon=True)
+    t.start()
+    try:
+        # live traffic must be flowing before the sweep starts
+        deadline = time.monotonic() + 30.0
+        while delivered[0] == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+            drain()
+        assert delivered[0] > 0, "live plane never delivered"
+
+        req = pb.WhatIfRequest(ticks=200, dt_us=1000.0,
+                               traffic_rate_bps=1e6, seed=5,
+                               include_baseline=True)
+        sc = req.scenarios.add()
+        sc.name = "uid1-slow"
+        p = sc.perturbations.add()
+        p.kind = "degrade"
+        p.uid = 1
+        p.properties.CopyFrom(pb.props_to_proto(
+            LinkProperties(latency="100ms")))
+        sc2 = req.scenarios.add()
+        sc2.name = "b0-dead"
+        p2 = sc2.perturbations.add()
+        p2.kind = "blackhole"
+        p2.node = "tw-b0"
+        resp = client.WhatIf(req, timeout=300.0)
+        assert resp.ok, resp.error
+        assert len(resp.results) == 3  # baseline + 2 scenarios
+        names = {m.name for m in resp.results}
+        assert names == {"baseline", "uid1-slow", "b0-dead"}
+        ranks = sorted(m.rank for m in resp.results)
+        assert ranks == [1, 2, 3]
+        by = {m.name: m for m in resp.results}
+        assert by["uid1-slow"].p99_us > by["baseline"].p99_us
+        assert by["b0-dead"].tx_packets < by["baseline"].tx_packets
+        assert resp.replicas >= 3 and resp.ticks == 200
+
+        # runner stayed live THROUGH the sweep
+        assert plane.running
+        # keep feeding a moment longer, then drain to zero loss
+        time.sleep(0.2)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    deadline = time.monotonic() + 60.0
+    while delivered[0] < fed[0] and time.monotonic() < deadline:
+        time.sleep(0.02)
+        drain()
+    try:
+        assert delivered[0] == fed[0], \
+            f"live frames lost during sweep: {fed[0] - delivered[0]}"
+        assert plane.tick_errors == 0
+        assert plane.dropped == 0
+
+        # satellite: kubedtn_whatif_* series flow through the registry
+        registry, _h = make_registry(daemon.engine,
+                                     dataplane=plane,
+                                     whatif_stats=stats_for(daemon))
+        text = generate_latest(registry).decode()
+        assert "kubedtn_whatif_sweeps_served" in text
+        assert "kubedtn_whatif_replicas_run" in text
+        assert "kubedtn_whatif_run_seconds" in text
+        assert stats_for(daemon).sweeps == 1
+        assert stats_for(daemon).replicas >= 3
+    finally:
+        client.close()
+        plane.stop()
+        server.stop(0)
+
+
+def test_whatif_request_budget_rejected():
+    """scenarios × ticks (and × edge capacity) are bounded per request:
+    one in-limit-per-factor query must not pin a gRPC worker for hours
+    or broadcast the daemon into an OOM."""
+    from kubedtn_tpu.topology import SimEngine, TopologyStore
+    from kubedtn_tpu.twin.query import serve_whatif
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    daemon = Daemon(SimEngine(TopologyStore(), capacity=16))
+    req = pb.WhatIfRequest(ticks=200_000, include_baseline=True)
+    for i in range(64):
+        sc = req.scenarios.add()
+        sc.name = f"s{i}"
+    resp = serve_whatif(daemon, req)
+    assert not resp.ok
+    assert "budget" in resp.error
+    assert daemon.whatif_stats.errors == 1
+
+    # concurrency guard: with the single sweep slot held, an in-budget
+    # request is refused loudly instead of parking a gRPC worker
+    from kubedtn_tpu.twin import query as Q
+
+    small = pb.WhatIfRequest(ticks=10, include_baseline=True)
+    slots = Q._sweep_slots(daemon)
+    assert slots.acquire(blocking=False)
+    old_wait = Q.SWEEP_WAIT_S
+    Q.SWEEP_WAIT_S = 0.05
+    try:
+        resp2 = serve_whatif(daemon, small)
+    finally:
+        Q.SWEEP_WAIT_S = old_wait
+        slots.release()
+    assert not resp2.ok and "in progress" in resp2.error
+
+
+def test_fast_forward_reports_virtual_speedup():
+    """Satellite: fast_forward's result dict carries the effective
+    virtual speedup and tick rate, comparable to twin bench figures."""
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.topology import SimEngine, TopologyStore
+    from kubedtn_tpu.wire.server import Daemon
+
+    engine = SimEngine(TopologyStore(), capacity=16)
+    plane = WireDataPlane(Daemon(engine), dt_us=10_000.0)
+    out = plane.fast_forward(2.0)
+    assert out["sim_seconds"] == 2.0
+    assert out["wall_s"] >= 0.0
+    assert out["virtual_speedup"] is not None and out["virtual_speedup"] > 0
+    assert out["ticks_per_s"] is not None and out["ticks_per_s"] > 0
+
+
+TOPO_YAML = """\
+apiVersion: y-young.github.io/v1
+kind: Topology
+metadata: {name: p1}
+spec:
+  links:
+    - {uid: 1, peer_pod: p2, local_intf: eth1, peer_intf: eth1,
+       properties: {latency: 5ms}}
+---
+apiVersion: y-young.github.io/v1
+kind: Topology
+metadata: {name: p2}
+spec:
+  links:
+    - {uid: 1, peer_pod: p1, local_intf: eth1, peer_intf: eth1,
+       properties: {latency: 5ms}}
+"""
+
+
+def test_cli_whatif_local(tmp_path, capsys):
+    """`kdt whatif --file` end to end: spec YAML → sweep → ranked JSON,
+    plus loud failure on a malformed spec."""
+    import json
+
+    from kubedtn_tpu import cli
+
+    topo = tmp_path / "topo.yml"
+    topo.write_text(TOPO_YAML)
+    spec = tmp_path / "sweep.yml"
+    spec.write_text(
+        "- name: slow\n  perturbations:\n"
+        "    - {kind: degrade, uid: 1, properties: {latency: 50ms}}\n")
+    rc = cli.main(["whatif", "--file", str(topo), "--spec", str(spec),
+                   "--ticks", "30", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    rep = json.loads(out)
+    ranked = {r["name"]: r for r in rep["ranked"]}
+    assert set(ranked) == {"baseline", "slow"}
+    assert ranked["slow"]["rank"] == 1
+    assert ranked["slow"]["p99_us"] > ranked["baseline"]["p99_us"]
+
+    # table mode renders both scenario names
+    rc = cli.main(["whatif", "--file", str(topo), "--spec", str(spec),
+                   "--ticks", "30"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "slow" in out and "baseline" in out
+
+    # malformed spec entries are a clean CLI error, not a traceback
+    bad = tmp_path / "bad.yml"
+    bad.write_text("- just-a-string\n")
+    rc = cli.main(["whatif", "--file", str(topo), "--spec", str(bad)])
+    err = capsys.readouterr().err
+    assert rc == 1 and "must be a mapping" in err
+
+
+def test_whatif_sweep_scenario_smoke():
+    """Tier-1 smoke of the bench phase (small N×T): the subsystem's
+    whole path — topology → snapshot → mixed perturbation set → one
+    compiled sweep → report fields — can't silently rot."""
+    from kubedtn_tpu.scenarios import whatif_sweep
+
+    r = whatif_sweep(replicas=6, steps=40, n_nodes=12, n_links=24,
+                     k_slots=2)
+    assert r["replicas"] == 6
+    assert r["steps"] == 40
+    assert r["replicas_steps_per_s"] > 0
+    assert r["virtual_speedup"] > 0
+    assert 0 < r["baseline_delivery_ratio"] <= 1.0
+    assert ((r["worst_delivery_ratio"] or 0.0)
+            <= r["baseline_delivery_ratio"])
+    assert r["compile_s"] >= 0.0 and r["run_s"] > 0.0

@@ -39,6 +39,7 @@ def test_proto_roundtrip_bytes():
     assert back.properties.latency == "10ms"
 
 
+@pytest.mark.requires_reference_yaml
 def test_setup_pod_flow(daemon_and_client):
     daemon, client, engine, store = daemon_and_client
     # CNI cmdAdd: SetupPod for each pod
@@ -53,6 +54,7 @@ def test_setup_pod_flow(daemon_and_client):
     assert len(pod.links) == 2
 
 
+@pytest.mark.requires_reference_yaml
 def test_setup_unknown_pod_delegates(daemon_and_client):
     _, client, engine, _ = daemon_and_client
     resp = client.SetupPod(pb.SetupPodQuery(name="not-in-topology"))
@@ -60,6 +62,7 @@ def test_setup_unknown_pod_delegates(daemon_and_client):
     assert engine.num_active == 0
 
 
+@pytest.mark.requires_reference_yaml
 def test_update_links_via_wire(daemon_and_client):
     daemon, client, engine, store = daemon_and_client
     for name in ("r1", "r2", "r3"):
@@ -75,6 +78,7 @@ def test_update_links_via_wire(daemon_and_client):
     assert engine.link_row("default/r1", 1)["latency_us"] == 33_000.0
 
 
+@pytest.mark.requires_reference_yaml
 def test_destroy_pod_flow(daemon_and_client):
     daemon, client, engine, _ = daemon_and_client
     for name in ("r1", "r2", "r3"):
@@ -85,6 +89,7 @@ def test_destroy_pod_flow(daemon_and_client):
     assert engine.num_active == 2  # only r1-r3 link remains
 
 
+@pytest.mark.requires_reference_yaml
 def test_remote_update(daemon_and_client):
     daemon, client, engine, _ = daemon_and_client
     resp = client.Update(pb.RemotePod(
@@ -96,6 +101,7 @@ def test_remote_update(daemon_and_client):
     assert row is not None and row["latency_us"] == 5000.0
 
 
+@pytest.mark.requires_reference_yaml
 def test_wire_lifecycle_and_packets(daemon_and_client):
     daemon, client, engine, _ = daemon_and_client
     for name in ("r1", "r2"):
@@ -138,6 +144,7 @@ def test_wire_lifecycle_and_packets(daemon_and_client):
     assert not client.GRPCWireExists(wd).response
 
 
+@pytest.mark.requires_reference_yaml
 def test_send_to_unknown_wire_errors(daemon_and_client):
     import grpc
 
@@ -147,6 +154,7 @@ def test_send_to_unknown_wire_errors(daemon_and_client):
     assert ei.value.code() == grpc.StatusCode.NOT_FOUND
 
 
+@pytest.mark.requires_reference_yaml
 def test_concurrent_rpcs_race_free(daemon_and_client):
     # 16-thread gRPC pool vs the engine lock: concurrent SetupPod /
     # AddGRPCWireRemote / Update must neither lose links nor reuse wire ids.
