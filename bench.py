@@ -632,7 +632,32 @@ def main() -> None:
                 "windows_frames_per_s",
                 "sustained_under_flap_frames_per_s", "breaker_cycles",
                 "peer_retries", "peer_buffer_dropped", "tick_errors",
-                "forward_errors", "degrade_level_end") if k in r}
+                "forward_errors", "degrade_level_end",
+                "sampled_frames", "trace_ok", "trace_id",
+                "trace_hops", "trace_stages", "trace_nodes",
+                "telemetry_windows_closed") if k in r}
+
+    def run_telemetry_overhead():
+        # observability cost evidence: the SAME plane-only workload
+        # with the link-telemetry window ring + flight recorder off vs
+        # on at the default 1/256 sampling, rounds interleaved. The
+        # acceptance bar is < 5% overhead (telemetry rides the fused
+        # dispatch — no extra device calls, no per-tick host sync).
+        # Process-isolated like the live phases so earlier phases'
+        # ballast can't skew the comparison.
+        r = _isolated_scenario("telemetry_overhead", {
+            "pairs": 4,
+            "frames_per_wire": 8_000 if degraded else 20_000,
+            "rounds": 3 if degraded else 5})
+        extras["telemetry_overhead"] = {
+            k: r[k] for k in (
+                "pairs", "frames_per_wire", "rounds", "sample_period",
+                "rounds_off_frames_per_s", "rounds_on_frames_per_s",
+                "frames_per_s_off", "frames_per_s_on", "overhead_pct",
+                "overhead_pct_best", "stalled_first_attempt",
+                "meets_5pct_target", "sampled_frames",
+                "recorder_events", "telemetry_windows_closed",
+                "tick_errors_off", "tick_errors_on") if k in r}
 
     def run_whatif_sweep():
         # what-if plane evidence: >=64 perturbed replicas × >=10k virtual
@@ -716,6 +741,7 @@ def main() -> None:
     phase("live_soak", run_live_soak)
     phase("live_soak_tbf", run_live_soak_tbf)
     phase("chaos_soak", run_chaos_soak)
+    phase("telemetry_overhead", run_telemetry_overhead)
     phase("whatif_sweep", run_whatif_sweep)
     phase("reconverge_10k", run_reconverge_10k)
 

@@ -320,6 +320,14 @@ def save(path: str, store: TopologyStore, engine: SimEngine,
         raise RuntimeError(
             "stop() the data plane before checkpointing its pending "
             "frames")
+    from kubedtn_tpu.utils import tracing
+
+    with tracing.span("checkpoint-save", path=path):
+        return _save_traced(path, store, engine, sim, dataplane)
+
+
+def _save_traced(path: str, store: TopologyStore, engine: SimEngine,
+                 sim=None, dataplane=None) -> None:
     path = os.path.abspath(path)
     _CKPT_FILES = {"manifest.json", "edge_state.npz", "sim_state.npz",
                    "pending_frames.npz"}
@@ -415,6 +423,13 @@ def load(path: str) -> tuple[TopologyStore, SimEngine]:
     crash may have left; raises `CheckpointError`/`CheckpointCorruptError`
     (typed — see `load_or_rebuild` for the reconstruction fallback) when
     neither generation is usable."""
+    from kubedtn_tpu.utils import tracing
+
+    with tracing.span("checkpoint-load", path=path):
+        return _load_traced(path)
+
+
+def _load_traced(path: str) -> tuple[TopologyStore, SimEngine]:
     path = os.path.abspath(path)
     dirpath, manifest = _resolve_dir(path)
 

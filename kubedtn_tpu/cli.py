@@ -24,6 +24,15 @@ import sys
 import time
 
 
+def _rpc_code(e) -> str:
+    """Short status-code name for a grpc.RpcError (shared by every verb
+    that dials a daemon)."""
+    try:
+        return e.code().name
+    except Exception:
+        return type(e).__name__
+
+
 def _json_safe(obj):
     """inf/nan are not valid JSON — emit null for unreachable values."""
     if isinstance(obj, dict):
@@ -91,12 +100,167 @@ def cmd_ping(args) -> int:
 
 
 def cmd_trace(args) -> int:
-    """Multi-hop path query across the whole fabric (ping's traceroute
-    sibling)."""
+    """Two modes sharing one verb:
+
+    - path mode (`kdt trace a b --file topo.yml`): multi-hop route
+      query across the fabric (ping's traceroute sibling);
+    - flight-recorder mode (`kdt trace <trace-id|latest> --daemon A
+      [--daemon B ...]`): reconstruct a SAMPLED FRAME's hop-by-hop
+      lifecycle — ingress → bypass/shaped → delivered/dropped(cause) →
+      staged-peer → outage-buffered/retried → peer-sent → received —
+      by merging the flight-recorder events of every named daemon
+      (cross-node trace correlation, Local.ObserveTrace)."""
+    if args.daemon:
+        return _cmd_trace_flight(args)
+    if not args.file or args.b is None:
+        print("trace needs `a b --file topo.yml` (path mode) or "
+              "`<trace-id|latest> --daemon HOST:PORT` (flight-recorder "
+              "mode)", file=sys.stderr)
+        return 1
     engine, _ = _engine_from_yaml(args.file)
     out = engine.trace(args.a, args.b, max_hops=args.max_hops)
     print(json.dumps(_json_safe(out)))
     return 0 if out["reachable"] else 1
+
+
+def _cmd_trace_flight(args) -> int:
+    import grpc
+
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+
+    clients = []
+    try:
+        for addr in args.daemon:
+            clients.append((addr, DaemonClient(addr)))
+
+        def observe(client, tid):
+            return client.ObserveTrace(
+                pb.ObserveTraceRequest(trace_id=tid,
+                                       limit=args.max_hops * 64),
+                timeout=10.0)
+
+        tid = 0
+        if args.a != "latest":
+            try:
+                tid = int(args.a, 0)  # decimal or 0x-hex
+            except ValueError:
+                print(f"trace: {args.a!r} is not a trace id (use a "
+                      f"decimal/hex id or 'latest')", file=sys.stderr)
+                return 1
+        events = []
+        recents: list[int] = []
+        for addr, client in clients:
+            try:
+                resp = observe(client, tid)
+            except grpc.RpcError as e:
+                print(f"trace: daemon {addr} RPC failed: "
+                      f"{_rpc_code(e)}", file=sys.stderr)
+                return 1
+            if not resp.ok:
+                print(f"trace: {addr}: {resp.error}", file=sys.stderr)
+                return 1
+            recents.extend(int(t) for t in resp.recent_traces)
+            events.extend(
+                {"trace_id": int(e.trace_id), "t": e.t, "node": e.node,
+                 "stage": e.stage, "detail": e.detail}
+                for e in resp.events)
+        if tid == 0:
+            # newest sampled trace across the daemons, preferring one
+            # with a complete local story (an ingress event)
+            have_ingress = {e["trace_id"] for e in events
+                            if e["stage"] == "ingress"}
+            pick = next((t for t in recents if t in have_ingress),
+                        recents[0] if recents else 0)
+            if not pick:
+                print("trace: no sampled traces recorded yet",
+                      file=sys.stderr)
+                return 1
+            tid = pick
+        path = sorted((e for e in events if e["trace_id"] == tid),
+                      key=lambda e: e["t"])
+        if args.json:
+            print(json.dumps(_json_safe({"trace_id": tid,
+                                         "events": path})))
+            return 0
+        if not path:
+            print(f"trace: no events for {tid:#x}", file=sys.stderr)
+            return 1
+        from kubedtn_tpu.telemetry import render_trace
+
+        print(render_trace(
+            path, header=f"trace {tid:#018x} ({len(path)} events, "
+                         f"{len(set(e['node'] for e in path))} "
+                         f"node(s))"))
+        return 0
+    finally:
+        for _addr, client in clients:
+            client.close()
+
+
+def cmd_top(args) -> int:
+    """Live ranked per-link table from a daemon's link telemetry plane
+    (Local.ObserveLinks): delivery rate, p50/p99 shaping latency, and
+    drops BY CAUSE per link — the per-edge time-series view the
+    reference daemon never had."""
+    import grpc
+
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+
+    client = DaemonClient(args.daemon)
+    try:
+        for it in range(args.count):
+            if it:
+                time.sleep(args.interval)
+            try:
+                resp = client.ObserveLinks(
+                    pb.ObserveLinksRequest(top_n=args.top,
+                                           windows=args.windows),
+                    timeout=10.0)
+            except grpc.RpcError as e:
+                print(f"top: daemon {args.daemon} RPC failed: "
+                      f"{_rpc_code(e)}", file=sys.stderr)
+                return 1
+            if not resp.ok:
+                print(f"top: {resp.error}", file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(_json_safe({
+                    "covered_seconds": resp.covered_seconds,
+                    "windows_closed": resp.windows_closed,
+                    "truncated": resp.truncated,
+                    "links": [{
+                        "pod": l.pod, "namespace": l.namespace,
+                        "uid": l.uid, "delivered_pps": l.delivered_pps,
+                        "bytes_ps": l.bytes_ps, "tx": l.tx,
+                        "delivered": l.delivered,
+                        "dropped_loss": l.dropped_loss,
+                        "dropped_queue": l.dropped_queue,
+                        "corrupted": l.corrupted,
+                        "queue_depth": l.queue_depth,
+                        "p50_us": None if l.p50_us < 0 else l.p50_us,
+                        "p99_us": None if l.p99_us < 0 else l.p99_us,
+                    } for l in resp.links]})))
+                continue
+            fmt_us = lambda v: "-" if v < 0 else f"{v / 1000:.2f}ms"  # noqa: E731
+            print(f"links via {args.daemon} — window "
+                  f"{resp.covered_seconds:.1f}s "
+                  f"({resp.windows_closed} closed"
+                  + (f", {resp.truncated} truncated" if resp.truncated
+                     else "") + ")")
+            hdr = (f"{'link':<24}{'rate/s':>10}{'p50':>10}{'p99':>10}"
+                   f"{'loss':>8}{'queue':>8}{'corrupt':>8}{'qdepth':>8}")
+            print(hdr)
+            for l in resp.links:
+                name = f"{l.pod}/uid{l.uid}"
+                print(f"{name:<24}{l.delivered_pps:>10.1f}"
+                      f"{fmt_us(l.p50_us):>10}{fmt_us(l.p99_us):>10}"
+                      f"{l.dropped_loss:>8.0f}{l.dropped_queue:>8.0f}"
+                      f"{l.corrupted:>8.0f}{l.queue_depth:>8.0f}")
+    finally:
+        client.close()
+    return 0
 
 
 def cmd_scenario(args) -> int:
@@ -211,6 +375,31 @@ def cmd_daemon(args) -> int:
         daemon.capture.open(args.capture)
         log.info("capture on %s", fields(path=args.capture))
     dataplane = WireDataPlane(daemon)
+    if not getattr(args, "no_telemetry", False):
+        # link telemetry plane: per-edge window ring + sampled flight
+        # recorder, riding the fused tick (no extra device dispatch)
+        dataplane.enable_telemetry(
+            window_s=getattr(args, "telemetry_window", 1.0),
+            sample_period=getattr(args, "telemetry_sample", 256),
+            node=args.node_ip)
+        log.info("link telemetry on %s", fields(
+            window_s=getattr(args, "telemetry_window", 1.0),
+            sample_period=getattr(args, "telemetry_sample", 256)))
+    trace_out = getattr(args, "trace_out", None)
+    jax_profile = getattr(args, "jax_profile", None)
+    if jax_profile:
+        # opt-in XLA device profiling for the daemon's whole lifetime
+        # (today only stage_shares was consumed; this is the device
+        # half of the host spans)
+        try:
+            import jax as _jax
+
+            _jax.profiler.start_trace(jax_profile)
+            log.info("jax profiler capturing %s",
+                     fields(dir=jax_profile))
+        except Exception:
+            log.exception("jax profiler start failed; continuing")
+            jax_profile = None
     if ckpt_dir:
         try:
             n_pending = checkpoint.load_pending(ckpt_dir, dataplane)
@@ -276,6 +465,27 @@ def cmd_daemon(args) -> int:
                               fields(path=ckpt_dir))
         if daemon.capture is not None:
             daemon.capture.close_all()
+        if jax_profile:
+            try:
+                import jax as _jax
+
+                _jax.profiler.stop_trace()
+            except Exception:
+                log.exception("jax profiler stop failed")
+        if trace_out:
+            # catapult/Perfetto JSON of the daemon's structured spans
+            # (reconcile / checkpoint / what-if sweeps) — dumped on
+            # Ctrl-C AND SIGTERM (both route through this handler)
+            from kubedtn_tpu.utils.tracing import default_tracer
+
+            try:
+                default_tracer().export_chrome(trace_out)
+                log.info("trace written %s", fields(
+                    path=trace_out,
+                    spans=len(default_tracer().spans())))
+            except Exception:
+                log.exception("trace export failed %s",
+                              fields(path=trace_out))
         metrics.stop()
     return 0
 
@@ -573,12 +783,8 @@ def cmd_whatif(args) -> int:
         try:
             resp = client.WhatIf(req, timeout=args.timeout)
         except grpc.RpcError as e:
-            try:
-                code = e.code().name
-            except Exception:
-                code = type(e).__name__
-            print(f"whatif: daemon {args.daemon} RPC failed: {code}",
-                  file=sys.stderr)
+            print(f"whatif: daemon {args.daemon} RPC failed: "
+                  f"{_rpc_code(e)}", file=sys.stderr)
             return 1
         finally:
             client.close()
@@ -717,13 +923,40 @@ def main(argv=None) -> int:
     pp.add_argument("--file", required=True)
     pp.set_defaults(fn=cmd_ping)
 
-    tp = sub.add_parser("trace",
-                        help="traceroute-equivalent multi-hop path query")
-    tp.add_argument("a")
-    tp.add_argument("b")
-    tp.add_argument("--file", required=True)
+    tp = sub.add_parser(
+        "trace",
+        help="path query (a b --file) or sampled-frame flight-recorder "
+             "trace (<trace-id|latest> --daemon ...)")
+    tp.add_argument("a", help="source pod, or a trace id / 'latest' "
+                              "with --daemon")
+    tp.add_argument("b", nargs="?", default=None)
+    tp.add_argument("--file", default=None)
     tp.add_argument("--max-hops", type=int, default=16)
+    tp.add_argument("--daemon", action="append", default=None,
+                    metavar="HOST:PORT",
+                    help="flight-recorder mode: merge this daemon's "
+                         "trace events (repeat for cross-node "
+                         "correlation)")
+    tp.add_argument("--json", action="store_true")
     tp.set_defaults(fn=cmd_trace)
+
+    top = sub.add_parser(
+        "top",
+        help="live ranked per-link table (rate, p50/p99, drops by "
+             "cause) from a daemon's link telemetry plane")
+    top.add_argument("--daemon", default="127.0.0.1:51111",
+                     metavar="HOST:PORT")
+    top.add_argument("-n", "--top", type=int, default=20,
+                     help="links to show (busiest first)")
+    top.add_argument("--windows", type=int, default=0,
+                     help="closed telemetry windows to cover (0 = all "
+                          "retained)")
+    top.add_argument("--count", type=int, default=1,
+                     help="refreshes to print (watch mode)")
+    top.add_argument("--interval", type=float, default=2.0,
+                     help="seconds between refreshes")
+    top.add_argument("--json", action="store_true")
+    top.set_defaults(fn=cmd_top)
 
     sp = sub.add_parser("scenario", help="run a BASELINE ladder scenario")
     sp.add_argument("name")
@@ -747,6 +980,23 @@ def main(argv=None) -> int:
                     help="restore state from DIR on boot (if present) and "
                          "checkpoint to it on shutdown, incl. in-flight "
                          "delay-line frames")
+    dp.add_argument("--no-telemetry", action="store_true",
+                    help="disable the link telemetry plane (per-edge "
+                         "window ring + sampled flight recorder; on by "
+                         "default)")
+    dp.add_argument("--telemetry-window", type=float, default=1.0,
+                    metavar="SECONDS",
+                    help="link-telemetry window length (default 1s)")
+    dp.add_argument("--telemetry-sample", type=int, default=256,
+                    metavar="N", help="flight-recorder sampling period: "
+                                      "1 frame in N (default 256)")
+    dp.add_argument("--trace-out", default=None, metavar="JSON",
+                    help="dump catapult/Perfetto trace JSON (spans "
+                         "around reconcile / checkpoint / what-if "
+                         "sweeps) on stop or SIGTERM")
+    dp.add_argument("--jax-profile", default=None, metavar="DIR",
+                    help="opt-in jax.profiler device capture for the "
+                         "daemon's lifetime (TensorBoard-loadable)")
     dp.set_defaults(fn=cmd_daemon)
 
     pcp = sub.add_parser("pcap", help="summarize a capture file")

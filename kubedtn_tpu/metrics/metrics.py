@@ -287,6 +287,93 @@ class DataPlaneStatsCollector:
         return out
 
 
+class LinkTelemetryCollector:
+    """kubedtn_link_* per-edge series from the data plane's window ring
+    (telemetry.LinkTelemetry) — the per-link time-series the reference
+    collapses into node aggregates. Same truncation-guard pattern as
+    InterfaceStatsCollector: per-link series are exported for the
+    busiest `max_links` links covered by the ring,
+    `kubedtn_link_series_truncated` counts the tail, and the ring's
+    coverage (windows closed, covered seconds) is always exported so a
+    rate can be derived without scrape-interval guesswork."""
+
+    VALUE_KEYS = (
+        ("tx", "Frames offered to the shaping kernels in the ring's "
+               "covered windows"),
+        ("delivered", "Frames delivered through the qdisc chain"),
+        ("dropped_loss", "Frames dropped by netem loss"),
+        ("dropped_queue", "Frames dropped by TBF 50ms-queue overflow"),
+        ("corrupted", "Frames delivered corrupt-flagged"),
+        ("queue_depth", "Frames deferred to the holdback buffer"),
+        ("delivered_pps", "Delivered frames/s over the covered span"),
+        ("bytes_ps", "Delivered bytes/s over the covered span"),
+    )
+    QUANTILES = (("p50_us", "p50 shaping latency (µs) from the ring's "
+                            "bucket counts"),
+                 ("p99_us", "p99 shaping latency (µs) from the ring's "
+                            "bucket counts"))
+
+    def __init__(self, engine, dataplane, max_links: int = 1000) -> None:
+        self._engine = engine
+        self._plane = dataplane
+        self._max_links = max_links
+
+    def collect(self):
+        out = []
+        tel = getattr(self._plane, "telemetry", None)
+        if tel is None:
+            return out
+        rows, seconds, truncated = tel.link_rows(self._engine)
+        labels = ["interface", "pod", "namespace"]
+        fams = {}
+        for key, doc in self.VALUE_KEYS:
+            fams[key] = GaugeMetricFamily(f"kubedtn_link_{key}", doc,
+                                          labels=labels)
+        for key, doc in self.QUANTILES:
+            fams[key] = GaugeMetricFamily(f"kubedtn_link_{key}", doc,
+                                          labels=labels)
+        shown = rows[:self._max_links]
+        for r in shown:
+            lab = [f"uid{r['uid']}", r["pod"], r["namespace"]]
+            for key, _doc in self.VALUE_KEYS:
+                fams[key].add_metric(lab, float(r[key]))
+            for key, _doc in self.QUANTILES:
+                v = r[key]
+                if v is not None and v != float("inf"):
+                    fams[key].add_metric(lab, float(v))
+        out.extend(fams.values())
+        trunc = GaugeMetricFamily(
+            "kubedtn_link_series_truncated",
+            "Busy links beyond the per-link telemetry series cap "
+            "(0 = full coverage)")
+        trunc.add_metric([], float(truncated
+                                   + max(0, len(rows) - len(shown))))
+        out.append(trunc)
+        cov = GaugeMetricFamily(
+            "kubedtn_link_window_seconds",
+            "Wall seconds covered by the exported window ring")
+        cov.add_metric([], float(seconds))
+        out.append(cov)
+        wins = CounterMetricFamily(
+            "kubedtn_link_windows_closed",
+            "Telemetry windows closed since the plane started")
+        wins.add_metric([], float(tel.windows_closed))
+        out.append(wins)
+        rec = getattr(self._plane, "recorder", None)
+        if rec is not None:
+            samp = CounterMetricFamily(
+                "kubedtn_flight_sampled_frames",
+                "Frames sampled into the flight recorder")
+            samp.add_metric([], float(rec.sampled))
+            out.append(samp)
+            evc = CounterMetricFamily(
+                "kubedtn_flight_events",
+                "Lifecycle events recorded by the flight recorder")
+            evc.add_metric([], float(rec.recorded))
+            out.append(evc)
+        return out
+
+
 class WhatIfStatsCollector:
     """kubedtn_whatif_* counters — observability for daemon-served
     what-if sweeps (kubedtn_tpu.twin.query): volume served (sweeps,
@@ -336,7 +423,22 @@ class MetricsServer:
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = generate_latest(reg)
+                # a collector raising mid-scrape must cost THIS scrape a
+                # 500, not the handler thread (an unhandled exception
+                # would reset the connection and log a traceback per
+                # scrape) — subsequent scrapes see the registry afresh
+                try:
+                    body = generate_latest(reg)
+                except Exception as e:
+                    err = f"# scrape failed: {type(e).__name__}: {e}\n"
+                    self.send_response(500)
+                    self.send_header("Content-Type", "text/plain")
+                    self.end_headers()
+                    try:
+                        self.wfile.write(err.encode())
+                    except OSError:
+                        pass
+                    return
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")
@@ -371,6 +473,9 @@ def make_registry(engine=None, sim_counters_fn=None,
             engine, sim_counters_fn, max_interfaces=max_interfaces))
     if dataplane is not None:
         registry.register(DataPlaneStatsCollector(dataplane))
+        if engine is not None:
+            # emits nothing until the plane's telemetry is enabled
+            registry.register(LinkTelemetryCollector(engine, dataplane))
     if whatif_stats is not None:
         registry.register(WhatIfStatsCollector(whatif_stats))
     return registry, hist

@@ -62,6 +62,10 @@ def _declare(lib) -> None:
         "kdt_parse_packet_batch": (c.c_int64, [u8p, c.c_uint64,
                                                c.POINTER(c.c_int64),
                                                u64p, u64p, c.c_int64]),
+        "kdt_parse_packet_batch_t": (c.c_int64, [u8p, c.c_uint64,
+                                                 c.POINTER(c.c_int64),
+                                                 u64p, u64p, u64p,
+                                                 c.c_int64]),
         "kdt_ft_decide_batch_ptrs": (c.c_int64, [c.c_void_p,
                                                  c.POINTER(c.c_char_p),
                                                  u64p, c.c_int64, u8p,
@@ -244,6 +248,34 @@ def parse_packet_batch(blob: bytes):
     if n < 0:
         raise ValueError("malformed PacketBatch")
     return ids[:n], offs[:n], lens[:n]
+
+
+def parse_packet_batch_traced(blob: bytes):
+    """parse_packet_batch that also decodes each packet's OPTIONAL
+    `trace_id` (Packet field 3, the flight recorder's cross-node
+    correlation id) in the same single native walk — the zero-copy
+    ingestion path stays zero-copy while sampled frames keep their
+    trace. Returns (ids, frame_offsets, frame_lens, trace_ids[uint64],
+    0 = untraced); raises ValueError on malformed input."""
+    import numpy as np
+
+    lib = _load()
+    nb = len(blob)
+    n_max = nb // 2 + 1
+    ids = np.empty(n_max, np.int64)
+    offs = np.empty(n_max, np.uint64)
+    lens = np.empty(n_max, np.uint64)
+    traces = np.empty(n_max, np.uint64)
+    c = ctypes
+    u64p = c.POINTER(c.c_uint64)
+    n = lib.kdt_parse_packet_batch_t(
+        c.cast(c.c_char_p(blob), c.POINTER(c.c_uint8)), nb,
+        ids.ctypes.data_as(c.POINTER(c.c_int64)),
+        offs.ctypes.data_as(u64p), lens.ctypes.data_as(u64p),
+        traces.ctypes.data_as(u64p), n_max)
+    if n < 0:
+        raise ValueError("malformed PacketBatch")
+    return ids[:n], offs[:n], lens[:n], traces[:n]
 
 
 def classify_counts(frames: list[bytes], lens=None) -> dict[str, int]:

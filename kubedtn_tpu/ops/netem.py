@@ -95,6 +95,21 @@ jax.tree_util.register_dataclass(
 )
 
 
+def cause_codes(res: "ShapeResult") -> jax.Array:
+    """Per-slot outcome taxonomy as one uint8 code (the flight
+    recorder's drop-cause attribution): 0 = invalid/padding lane,
+    1 = delivered, 2 = netem loss, 3 = TBF queue overflow. The three
+    outcome masks are mutually exclusive BY CONSTRUCTION — a packet
+    that survives loss and overflows the queue is dropped_queue only,
+    one that hits loss never reaches the bucket, and a simultaneous
+    duplicate+loss hit transmits exactly once (kernel packet-count
+    semantics, see netem_packet) — so the weighted sum is exact; the
+    partition invariant delivered + dropped_loss + dropped_queue ==
+    offered is pinned by tests/test_drop_causes.py."""
+    return (res.delivered * 1 + res.dropped_loss * 2
+            + res.dropped_queue * 3).astype(jnp.uint8)
+
+
 def crandom(u: jax.Array, last: jax.Array, rho: jax.Array):
     """netem get_crandom: AR(1)-blended uniform in [0,1).
 

@@ -105,6 +105,17 @@ ARCHITECTURE.md "Failure domains & recovery"):
   (determinism suite). A failed dispatch REQUEUES its drained frames
   (ingress front / holdback) before surfacing: tick faults degrade
   throughput, never lose frames.
+
+Round 8 adds the LINK TELEMETRY plane (kubedtn_tpu/telemetry.py,
+ARCHITECTURE.md "Link telemetry plane"): `enable_telemetry()` makes the
+fused tick additionally fold per-edge delivered / bytes /
+drop-by-cause / latency-bucket reductions into an on-device window
+accumulator chained like the dynamic columns (no extra dispatch, no
+per-tick host sync; closed windows drain to a bounded host ring
+lazily), and a deterministic hash-sampled flight recorder follows
+1/period of the frames through their whole lifecycle — across the peer
+gRPC hop via Packet.trace_id, so `cli trace` reconstructs a frame's
+path on BOTH daemons, breaker outages and retries included.
 """
 
 from __future__ import annotations
@@ -123,6 +134,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubedtn_tpu import fault, native
+from kubedtn_tpu import telemetry as tele
 from kubedtn_tpu.ops import netem
 from kubedtn_tpu.ops.queues import EdgeCounters, init_counters
 from kubedtn_tpu.wire.server import FrameSeg, flatten_frames
@@ -223,7 +235,9 @@ def parse_tcp_flow(frame: bytes) -> tuple[int, int, int, int] | None:
 class _RemoteStage:
     """Staging queue for released cross-node frames: native SPSC FrameRing
     when available (bounded, overflow-counted), deque fallback. Packed
-    entry: u16 addr_len | addr | u32 peer_intf_id | frame bytes."""
+    entry: u16 addr_len | addr | u32 peer_intf_id | u64 trace_id |
+    frame bytes (trace_id 0 = untraced; sampled frames carry their
+    flight-recorder id across to the peer hop)."""
 
     def __init__(self, capacity_bytes: int = 4 << 20) -> None:
         self._ring: native.FrameRing | None = None
@@ -232,16 +246,17 @@ class _RemoteStage:
         except native.NativeUnavailable:
             self._dq: deque[bytes] = deque()
 
-    def push(self, addr: str, intf_id: int, frame: bytes) -> bool:
+    def push(self, addr: str, intf_id: int, frame: bytes,
+             trace_id: int = 0) -> bool:
         a = addr.encode()
-        blob = struct.pack(">H", len(a)) + a + struct.pack(">I", intf_id) \
-            + frame
+        blob = struct.pack(">H", len(a)) + a \
+            + struct.pack(">IQ", intf_id, trace_id) + frame
         if self._ring is not None:
             return bool(self._ring.push(blob))
         self._dq.append(blob)
         return True
 
-    def pop(self) -> tuple[str, int, bytes] | None:
+    def pop(self) -> tuple[str, int, int, bytes] | None:
         if self._ring is not None:
             blob = self._ring.pop()
             if blob is None:
@@ -252,8 +267,8 @@ class _RemoteStage:
             blob = self._dq.popleft()
         alen = struct.unpack_from(">H", blob)[0]
         addr = blob[2:2 + alen].decode()
-        intf = struct.unpack_from(">I", blob, 2 + alen)[0]
-        return addr, intf, blob[6 + alen:]
+        intf, tid = struct.unpack_from(">IQ", blob, 2 + alen)
+        return addr, intf, tid, blob[14 + alen:]
 
     @property
     def dropped(self) -> int:
@@ -335,6 +350,16 @@ class _PeerSender:
         self.retries = 0     # transient-failure retry attempts
         self.sent = 0        # frames delivered to the peer
         self._bulk_reprobe_at = 0.0  # next idle re-test of the latch
+        # flight-recorder bookkeeping: sampled frames in this sender's
+        # buffer as [global_frame_pos, trace_id, outage_marked]. The
+        # buffer drains strictly FIFO (batches → pending → sent
+        # slices), so a monotonically increasing enqueue position plus
+        # a resolved-frames counter locate every traced frame without
+        # ever scanning a slice — O(sampled), not O(frames). Empty
+        # whenever no recorder is attached.
+        self._traced: deque = deque()
+        self._pos_enq = 0    # frames ever accepted into the buffer
+        self._pos_done = 0   # frames resolved (sent or given up)
         self.breaker = (breaker if breaker is not None
                         else fault.CircuitBreaker())
         self._backoff = (backoff if backoff is not None
@@ -351,21 +376,80 @@ class _PeerSender:
         buffer's fill level."""
         return self._queued + self._pending
 
-    def enqueue(self, packets: list) -> int:
-        """Queue one tick's packets for this peer; never blocks. Returns
-        how many were accepted (the rest are dropped and counted)."""
+    def _recorder(self):
+        return getattr(self.daemon, "recorder", None)
+
+    def enqueue(self, packets: list, traced: list | None = None) -> int:
+        """Queue one tick's packets for this peer; never blocks.
+        `traced` lists (index_in_packets, trace_id) for sampled frames.
+        Returns how many were accepted (the rest are dropped and
+        counted)."""
+        rec = self._recorder()
         with self._lock:
             room = self.MAX_QUEUED - self._queued - self._pending
             if room <= 0:
                 self.dropped += len(packets)
-                return 0
-            take = packets if len(packets) <= room else packets[:room]
-            self.dropped += len(packets) - len(take)
-            self._batches.append(take)
-            self._queued += len(take)
-            self._empty.clear()
-        self._wake.set()
+                take: list = []
+            else:
+                take = (packets if len(packets) <= room
+                        else packets[:room])
+                self.dropped += len(packets) - len(take)
+                self._batches.append(take)
+                self._queued += len(take)
+                self._empty.clear()
+            if rec is not None and traced:
+                for idx, tid in traced:
+                    if idx < len(take):
+                        self._traced.append(
+                            [self._pos_enq + idx, tid, False])
+                    else:
+                        rec.record(tid, tele.ST_EGRESS_DROP,
+                                   reason="peer-queue-full",
+                                   peer=self.addr)
+            self._pos_enq += len(take)
+        if take:
+            self._wake.set()
         return len(take)
+
+    def _traced_in_flight(self, upto: int):
+        """Traced entries among the next `upto` unresolved frames."""
+        limit = self._pos_done + upto
+        with self._lock:
+            return [e for e in self._traced if e[0] < limit]
+
+    def _advance_traced(self, n: int, stage: str, **detail) -> None:
+        """Resolve the next `n` buffer frames (sent or given up):
+        traced entries inside them get their terminal `stage` event."""
+        rec = self._recorder()
+        with self._lock:
+            self._pos_done += n
+            while self._traced and self._traced[0][0] < self._pos_done:
+                e = self._traced.popleft()
+                if rec is not None:
+                    rec.record(e[1], stage, peer=self.addr, **detail)
+
+    def _resolve_all_traced(self, stage: str, **detail) -> None:
+        rec = self._recorder()
+        with self._lock:
+            self._pos_done = self._pos_enq
+            while self._traced:
+                e = self._traced.popleft()
+                if rec is not None:
+                    rec.record(e[1], stage, peer=self.addr, **detail)
+
+    def _mark_outage(self) -> None:
+        """First breaker-open park with frames buffered: every traced
+        frame in the outage buffer records `outage-buffered` once."""
+        rec = self._recorder()
+        if rec is None:
+            return
+        with self._lock:
+            entries = [e for e in self._traced if not e[2]]
+            for e in entries:
+                e[2] = True
+        for e in entries:
+            rec.record(e[1], tele.ST_OUTAGE, peer=self.addr,
+                       breaker=fault.STATE_NAMES[self.breaker.state])
 
     def wait_empty(self, timeout_s: float) -> bool:
         return self._empty.wait(timeout_s)
@@ -447,10 +531,14 @@ class _PeerSender:
                     # orderly shutdown must not hang on a dead peer's
                     # cooldown: the buffered frames are lost and counted
                     self._drop_pending(pending, to_errors=False)
+                    self._resolve_all_traced(tele.ST_EGRESS_DROP,
+                                             reason="shutdown")
                     return
                 # breaker OPEN: park until the half-open probe is due
                 # (or a stop request), without dropping anything — the
-                # queue is the bounded outage buffer
+                # queue is the bounded outage buffer. Sampled frames in
+                # the buffer record `outage-buffered` (once each).
+                self._mark_outage()
                 self._interrupt.wait(
                     min(max(self.breaker.time_to_probe(), 0.005), 0.25))
                 self._interrupt.clear()
@@ -524,13 +612,25 @@ class _PeerSender:
                     pending = pending[len(sl):]
                     self._drop_pending(sl, to_errors=True,
                                        remaining=len(pending))
+                    self._advance_traced(
+                        len(sl), tele.ST_EGRESS_DROP,
+                        reason=(code.name if code is not None
+                                else "fatal"))
                     slice_attempts = 0
                     self._backoff.reset()
                     continue
                 if self._stopping:
                     self._drop_pending(pending, to_errors=False)
+                    self._resolve_all_traced(tele.ST_EGRESS_DROP,
+                                             reason="shutdown")
                     return
-                # transient: keep the slice, back off, try again
+                # transient: keep the slice, back off, try again — the
+                # slice's sampled frames record each retry attempt
+                rec = self._recorder()
+                if rec is not None:
+                    for e in self._traced_in_flight(len(sl)):
+                        rec.record(e[1], tele.ST_RETRIED,
+                                   peer=self.addr, attempt=slice_attempts)
                 self.retries += 1
                 self._interrupt.wait(self._backoff.next_delay())
                 self._interrupt.clear()
@@ -541,6 +641,7 @@ class _PeerSender:
             self._backoff.reset()
             self.sent += len(sl)
             pending = pending[len(sl):]
+            self._advance_traced(len(sl), tele.ST_SENT)
             with self._lock:
                 self._pending = len(pending)
                 # "empty" means queue drained AND nothing in flight —
@@ -652,8 +753,10 @@ def _shape_class(state, kind: str, args, sub):
     one dispatch) and `_class_tick` (the degradation ladder's un-fused
     per-class dispatches): the two paths stay byte-identical by
     construction, not by hand-synchronized copies. Returns
-    (state', out) with out = (delivered [R,K], depart_us [R,K],
-    loss [R], queue [R], corrupt [R] [, fallback [R] for tbf])."""
+    (state', out, res) with out = (delivered [R,K], depart_us [R,K],
+    loss [R], queue [R], corrupt [R] [, fallback [R] for tbf]) and
+    `res` the full ShapeResult (the telemetry reduction's feed; dead
+    code when telemetry is off)."""
     rows, sizes, valid = args
     if kind == "tbf":
         res, tok_row, dep_row, delta, hacc, fbk = \
@@ -676,21 +779,46 @@ def _shape_class(state, kind: str, args, sub):
             pkt_count=state.pkt_count.at[rows].add(
                 jnp.where(apply, delta, 0), mode="drop"))
         return state, (res.delivered, res.depart_us, *_row_counts(res),
-                       fbk)
+                       fbk), res
     if kind == "seq":
         state, res = netem.shape_slots_nodonate(
             state, rows, sizes, valid, jax.random.fold_in(sub, 0))
-        return state, (res.delivered, res.depart_us, *_row_counts(res))
+        return state, (res.delivered, res.depart_us,
+                       *_row_counts(res)), res
     res, new_count = netem.shape_slots_indep_nodonate(
         state, rows, sizes, valid, jax.random.fold_in(sub, 1))
     state = dataclasses.replace(state, pkt_count=new_count)
-    return state, (res.delivered, res.depart_us, *_row_counts(res))
+    return state, (res.delivered, res.depart_us, *_row_counts(res)), res
+
+
+def _tel_class(tel, kind: str, args, out, res):
+    """Fold one class's shaping results into the chained telemetry
+    accumulator — traced only when telemetry is on (has_tel), so the
+    off program is bit-identical to the pre-telemetry one. TBF rows
+    flagged for the 50ms-queue fallback re-shape are masked OUT of the
+    device reduction (their detection-run results are discarded); the
+    completion-side exact re-shape patches their stats host-side.
+
+    Per-CAUSE attribution stays row-granular on purpose: the [R] loss/
+    queue sums already in the transfer set disambiguate a sampled
+    frame's drop cause whenever the row saw a single cause that tick
+    (the overwhelming case — a netem-loss link and a TBF-overloaded
+    link fail differently); shipping a per-slot [R, K] cause plane
+    measured ~3% of the whole tick at the probe shapes, for labels
+    only the 1/256 sampled frames would ever read."""
+    rows, sizes, valid = args
+    if kind == "tbf":
+        fbk = out[5]
+        rows = jnp.where(fbk, jnp.int32(tel.shape[0]), rows)
+    return tele.tel_accumulate(tel, rows, sizes, valid, res,
+                               row_counts=out[2:5]), out
 
 
 @partial(jax.jit, static_argnames=("has_seq", "has_tbf", "has_ind",
-                                   "has_dyn"))
+                                   "has_dyn", "has_tel"))
 def _fused_tick(state, dyn, key, elapsed_us, seq_args, tbf_args,
-                ind_args, *, has_seq, has_tbf, has_ind, has_dyn):
+                ind_args, tel, *, has_seq, has_tbf, has_ind, has_dyn,
+                has_tel=False):
     """One tick's whole device program in ONE dispatch: per-tick key
     split, epoch roll, the three shaping-kernel classes (each over its
     gathered [R, K] batch), the TBF accepted-row state write-back, and
@@ -699,41 +827,53 @@ def _fused_tick(state, dyn, key, elapsed_us, seq_args, tbf_args,
     branches (one executable per class mix, cached). `dyn` (when
     has_dyn) overrides the dynamic columns with the previous in-flight
     tick's chained outputs — possibly still computing; XLA sequences
-    the dependency without a host sync.
+    the dependency without a host sync. `tel` (when has_tel) is the
+    link-telemetry window accumulator, chained through in-flight
+    dispatches exactly like `dyn` — per-edge delivered / bytes /
+    drop-by-cause / latency-bucket reductions ride this same dispatch,
+    adding ZERO extra device calls and no host sync (drop-cause
+    attribution for sampled frames stays row-granular via the [R]
+    sums already in the transfer set; see `_tel_class`).
 
-    Returns (key', sub, dyn', outs) with outs[kind] as documented on
-    `_shape_class`; `sub` seeds the completion-side TBF fallback
+    Returns (key', sub, dyn', outs, tel') with outs[kind] as documented
+    on `_shape_class`; `sub` seeds the completion-side TBF fallback
     re-shape."""
     if has_dyn:
         state = _with_dyn(state, dyn)
     key, sub = jax.random.split(key)
     state = _roll_clocks(state, elapsed_us)
     outs = {}
-    if has_tbf:
-        state, outs["tbf"] = _shape_class(state, "tbf", tbf_args, sub)
-    if has_seq:
-        state, outs["seq"] = _shape_class(state, "seq", seq_args, sub)
-    if has_ind:
-        state, outs["ind"] = _shape_class(state, "ind", ind_args, sub)
-    return key, sub, _dyn_of(state), outs
+    for kind, args, has in (("tbf", tbf_args, has_tbf),
+                            ("seq", seq_args, has_seq),
+                            ("ind", ind_args, has_ind)):
+        if not has:
+            continue
+        state, out, res = _shape_class(state, kind, args, sub)
+        if has_tel:
+            tel, out = _tel_class(tel, kind, args, out, res)
+        outs[kind] = out
+    return key, sub, _dyn_of(state), outs, tel
 
 
-@partial(jax.jit, static_argnames=("kind", "has_dyn"))
-def _class_tick(state, dyn, sub, elapsed_us, args, *, kind, has_dyn):
+@partial(jax.jit, static_argnames=("kind", "has_dyn", "has_tel"))
+def _class_tick(state, dyn, sub, elapsed_us, args, tel, *, kind,
+                has_dyn, has_tel=False):
     """One kernel class's slice of `_fused_tick`, dispatched on its own
     — the degradation ladder's synchronous un-fused mode (level 2). The
     caller chains the classes in the fused program's order (tbf → seq →
     ind) with `dyn` carrying each class's write-backs and the SAME
     per-tick `sub` / per-class fold_in constants; both paths trace the
-    shared `_shape_class`, so the outputs stay byte-identical to the
-    fused dispatch (the determinism suite pins this). `elapsed_us` must
-    be the tick's clock roll on the first class and 0 on the rest (the
-    roll is idempotent at 0)."""
+    shared `_shape_class` (and `_tel_class`), so the outputs stay
+    byte-identical to the fused dispatch (the determinism suite pins
+    this). `elapsed_us` must be the tick's clock roll on the first
+    class and 0 on the rest (the roll is idempotent at 0)."""
     if has_dyn:
         state = _with_dyn(state, dyn)
     state = _roll_clocks(state, elapsed_us)
-    state, out = _shape_class(state, kind, args, sub)
-    return _dyn_of(state), out
+    state, out, res = _shape_class(state, kind, args, sub)
+    if has_tel:
+        tel, out = _tel_class(tel, kind, args, out, res)
+    return _dyn_of(state), out, tel
 
 
 def _pad_rows(n: int) -> int:
@@ -788,7 +928,8 @@ class _ShapeJob:
 
     __slots__ = ("now_s", "base_us", "shaped_at", "prev_shaped_s",
                  "batches", "rowinfo", "groups", "state", "dyn_before",
-                 "dyn_after", "sub", "touched_after", "force_rows")
+                 "dyn_after", "sub", "touched_after", "force_rows",
+                 "samples", "has_tel")
 
     def __init__(self, now_s, base_us, shaped_at, prev_shaped_s,
                  batches, rowinfo, state) -> None:
@@ -810,6 +951,9 @@ class _ShapeJob:
         # the exact scan from the corrected engine columns (per-row TBF
         # independence scopes the redo to exactly these rows)
         self.force_rows: set[int] = set()
+        # flight-recorder samples per batch index: [(offset, trace_id)]
+        self.samples: list | None = None
+        self.has_tel = False
 
 
 class WireDataPlane:
@@ -974,6 +1118,15 @@ class WireDataPlane:
         # (single tick thread under _tick_lock)
         self._disp_items: list | None = None
         self._disp_decided = False
+        # recorder bookkeeping for the same failure path: the live
+        # samples list (mutated in place through the bypass/seq-cap
+        # splits) and the per-batch (row, frames, sampled) counter
+        # advances, so a failed dispatch can roll sampling back
+        # (undecided frames re-drain and must replay the SAME schedule)
+        # or terminate the traces (decided frames go to holdback and
+        # never re-sample)
+        self._disp_samples: list | None = None
+        self._disp_samp_adv: list | None = None
         # graceful-degradation ladder: 0 = configured pipeline depth,
         # 1 = depth-1 (overlap off), 2 = synchronous un-fused per-class
         # dispatches. The runner's supervisor steps DOWN one level after
@@ -1004,6 +1157,37 @@ class WireDataPlane:
         # re-arms after each completed tick.
         self._watchdog_armed = False
         self._seen_buckets: set = set()
+        # -- link telemetry plane (round 8) ----------------------------
+        # per-edge window ring + sampled flight recorder, both None
+        # until enable_telemetry(): the off program is bit-identical to
+        # the pre-telemetry fused tick (has_tel is a static jit flag)
+        self.telemetry: tele.LinkTelemetry | None = None
+        self.recorder: tele.FlightRecorder | None = None
+
+    def enable_telemetry(self, window_s: float = 1.0, windows: int = 12,
+                         sample_period: int = 256,
+                         recorder_capacity: int = 65_536,
+                         node: str | None = None):
+        """Switch the link telemetry plane on: the fused tick starts
+        chaining the per-edge window accumulator and the deterministic
+        hash-sampled flight recorder follows 1/`sample_period` of the
+        frames through their lifecycle (telemetry.py module docstring).
+        The recorder is also installed on the daemon so the receive
+        paths attach cross-node traces. Crossing the flush() barrier
+        keeps the telemetry program switch off any in-flight dispatch.
+        Returns (LinkTelemetry, FlightRecorder)."""
+        with self._tick_lock:
+            self.flush()
+            self.telemetry = tele.LinkTelemetry(
+                self.engine.state.capacity, window_s=window_s,
+                windows=windows)
+            self.recorder = tele.FlightRecorder(
+                node=node or getattr(self.engine, "node_ip", "")
+                or "local",
+                sample_period=sample_period,
+                capacity=recorder_capacity)
+            self.daemon.recorder = self.recorder
+        return self.telemetry, self.recorder
 
     # -- bypass --------------------------------------------------------
 
@@ -1089,6 +1273,16 @@ class WireDataPlane:
             # before any wheel scheduling, so requeueing the whole job
             # cannot double-schedule; the later failure points are pure
             # host bookkeeping
+            rec = self.recorder
+            if rec is not None and job.samples:
+                # the frames requeue PREDECIDED (holdback) and never
+                # re-sample: terminate their traces instead of leaving
+                # them dangling at `ingress` through the very fault
+                # window the recorder exists to explain
+                for sm in job.samples:
+                    for _off, tid in sm:
+                        rec.record(tid, tele.ST_REQUEUED,
+                                   reason="completion-fault")
             self._requeue_failed(job.batches, True)
             raise
 
@@ -1196,7 +1390,7 @@ class WireDataPlane:
                                             - wheel_now)))
             else:
                 base = self.last_now_s or 0.0
-                for rel, _seq, pk, uid, frame in self._heap:
+                for rel, _seq, pk, uid, frame, _tid in self._heap:
                     out.append((pk, uid, frame,
                                 max(0.0, (rel - base) * 1e6)))
             return out
@@ -1247,10 +1441,10 @@ class WireDataPlane:
                                          self._bseq << _TOK_BITS)
                 else:
                     self._seq += 1
-                    heapq.heappush(
+                    heapq.heappush(  # restored frames are untraced
                         self._heap,
                         (now_s + rem_us / 1e6, self._seq, pk, uid,
-                         bytes(frame)))
+                         bytes(frame), 0))
             return len(entries)
 
     def _tick_inner(self, now_s: float | None) -> int:
@@ -1288,6 +1482,10 @@ class WireDataPlane:
             if job is not None:
                 self._inflight.append(job)
                 dispatched = True
+        if not dispatched and self.telemetry is not None:
+            # idle tick: the window clock still advances (rollover
+            # happens at dispatch otherwise)
+            self.telemetry.touch(now_s)
         # consume completed pipeline stages: with a fresh dispatch in
         # the ring, everything beyond depth-1 in-flight jobs syncs now —
         # the PREVIOUS tick's job, whose device work overlapped this
@@ -1526,10 +1724,36 @@ class WireDataPlane:
         try:
             return self._dispatch_inner(inputs, now_s)
         except Exception:
+            rec = self.recorder
+            if rec is not None and self._disp_samp_adv is not None:
+                if self._disp_decided:
+                    # frames requeue PREDECIDED (holdback) and will not
+                    # re-sample: terminate their traces explicitly
+                    for sm in (self._disp_samples or []):
+                        for _off, tid in sm:
+                            rec.record(tid, tele.ST_REQUEUED,
+                                       reason="dispatch-fault")
+                else:
+                    # frames return to the ingress FRONT and re-drain:
+                    # roll the per-row counters back so the retry
+                    # replays the exact sampling schedule (same
+                    # offsets, same trace ids — the determinism
+                    # contract holds across tick faults). A `requeued`
+                    # marker between the attempts keeps the rendered
+                    # timeline coherent (ingress → requeued → ingress),
+                    # not a mysterious duplicate arrival.
+                    for sm in (self._disp_samples or []):
+                        for _off, tid in sm:
+                            rec.record(tid, tele.ST_REQUEUED,
+                                       reason="dispatch-fault-retry")
+                    for row, m, n in self._disp_samp_adv:
+                        rec.unsample_batch(row, m, n)
             self._requeue_failed(self._disp_items, self._disp_decided)
             raise
         finally:
             self._disp_items = None
+            self._disp_samples = None
+            self._disp_samp_adv = None
 
     def _dispatch_inner(self, inputs, now_s: float) -> _ShapeJob | None:
         if self.chaos is not None:
@@ -1624,6 +1848,31 @@ class WireDataPlane:
         # requeues the surviving batches instead of the raw inputs
         self._disp_items = batches
 
+        # -- flight-recorder sampling (deterministic, O(batches)) ------
+        # counters advance per batch in drain order; the sampled
+        # offsets fall out of counter arithmetic, never per-frame
+        # hashing. Holdback (predecided) batches were counted and
+        # sampled on their FIRST pass — their residue traces ended at
+        # the `requeued` event, so they neither re-count nor re-sample
+        # (the count-and-decide-exactly-once invariant, recorder form).
+        rec = self.recorder
+        samples: list[list] | None = None
+        if rec is not None:
+            samples = []
+            samp_adv = []
+            for w, row, lens, _fr, pd in batches:
+                if pd:
+                    samples.append([])
+                    continue
+                sm = rec.sample_batch(row, len(lens))
+                samp_adv.append((row, len(lens), len(sm)))
+                for _off, tid in sm:
+                    rec.record(tid, tele.ST_INGRESS, row=row,
+                               wire=w.wire_id, batch=len(lens))
+                samples.append(sm)
+            self._disp_samples = samples
+            self._disp_samp_adv = samp_adv
+
         # -- vectorized bypass decision OUTSIDE the engine lock --------
         # (eBPF sockops/redir semantics; no native flow table → no
         # bypass, same gate as the per-frame _try_bypass). Per-protocol
@@ -1696,8 +1945,9 @@ class WireDataPlane:
                 # requeued for a duplicate delivery
                 pos = 0
                 kept_batches = []
+                kept_samples: list[list] = []
                 deliveries = []
-                for w, row, lens, fr, pd in batches:
+                for bi, (w, row, lens, fr, pd) in enumerate(batches):
                     m = len(lens)
                     d = decide[pos:pos + m]
                     pos += m
@@ -1710,11 +1960,34 @@ class WireDataPlane:
                         kl = [int(ln) for ln, dd in zip(lens, d)
                               if not dd]
                         kf = [f for f, dd in zip(ff, d) if not dd]
+                        if samples is not None:
+                            # shift kept samples past the extracted
+                            # bypass frames; bypassed traces end here
+                            # (delivered in the same tick, latency ≈ 0)
+                            cum = np.cumsum(d)
+                            sm = []
+                            for off, tid in samples[bi]:
+                                if d[off]:
+                                    rec.record(tid, tele.ST_BYPASS,
+                                               row=row)
+                                    rec.record(tid, tele.ST_DELIVERED,
+                                               via="bypass")
+                                else:
+                                    sm.append((off - int(cum[off]),
+                                               tid))
+                            if kf:
+                                kept_samples.append(sm)
                         if kf:
                             kept_batches.append((w, row, kl, kf, pd))
                     else:
                         kept_batches.append((w, row, lens, fr, pd))
+                        if samples is not None:
+                            kept_samples.append(samples[bi])
                 batches = kept_batches
+                if samples is not None:
+                    # in place: _dispatch's failure handler holds the
+                    # same list object
+                    samples[:] = kept_samples
                 self._disp_items = batches
                 for target, by in deliveries:
                     # latency ≈ 0: delivered in the same tick. Guarded
@@ -1776,6 +2049,21 @@ class WireDataPlane:
                 fr_head, fr_tail = _split_parts(fr, cap)
                 self._holdback[w.wire_id] = (w, lens[cap:], fr_tail)
                 batches[i] = (w, row, lens[:cap], fr_head, pd)
+                deferred = len(lens) - cap
+                if self.telemetry is not None:
+                    # per-edge shaping-queue depth: frames this tick
+                    # deferred to the holdback buffer
+                    self.telemetry.patch_add(row, tele.T_QDEPTH,
+                                             deferred)
+                if samples is not None:
+                    sm = []
+                    for off, tid in samples[i]:
+                        if off < cap:
+                            sm.append((off, tid))
+                        else:
+                            rec.record(tid, tele.ST_REQUEUED,
+                                       reason="seq-cap", row=row)
+                    samples[i] = sm
         if self._holdback:
             # deferred work exists: the runner must tick again promptly
             # rather than sleep out the period
@@ -1793,18 +2081,27 @@ class WireDataPlane:
         job = _ShapeJob(now_s, (now_s - self._origin_s) * 1e6, now_s,
                         prev, batches, rowinfo, state)
         job.dyn_before = self._pipe_state
+        job.samples = samples
         args = {}
         for kind, group in (("seq", seq_group), ("tbf", tbf_group),
                             ("ind", ind_group)):
             if group:
                 args[kind] = _build_group(batches, group, E)
+        # link-telemetry window accumulator: fetched under the tick
+        # lock (window rollover happens here, on the dispatch clock, so
+        # each dispatch's reductions land wholly in one window) and
+        # chained through the fused program like the dynamic columns
+        tel_in = (self.telemetry.open_acc(now_s, E)
+                  if self.telemetry is not None else None)
+        has_tel = tel_in is not None
+        job.has_tel = has_tel
         # a (class-mix, padded-shape) combination this plane has not
         # dispatched before will trace+compile inside the jit call —
         # disarm the watchdog for the duration (the runner re-arms when
         # the tick completes) so a mid-run recompile is never counted
         # as a stalled runner
         bucket = (E, self._pipe_state is not None,
-                  self.degrade_level >= 2,
+                  self.degrade_level >= 2, has_tel,
                   tuple(sorted((kind, a[1].shape)
                                for kind, a in args.items())))
         if bucket not in self._seen_buckets:
@@ -1819,25 +2116,30 @@ class WireDataPlane:
             # failure mode this rung exists to route around)
             key, sub = jax.random.split(self._key)
             dyn = self._pipe_state
+            tel_out = tel_in
             el = jnp.float32(elapsed_us)
             outs = {}
             for kind in ("tbf", "seq", "ind"):
                 a = args.get(kind)
                 if a is None:
                     continue
-                dyn, outs[kind] = _class_tick(
-                    state, dyn, sub, el, a, kind=kind,
-                    has_dyn=dyn is not None)
+                dyn, outs[kind], tel_out = _class_tick(
+                    state, dyn, sub, el, a, tel_out, kind=kind,
+                    has_dyn=dyn is not None, has_tel=has_tel)
                 el = jnp.float32(0.0)  # the clock roll applies once
             dyn_after = dyn
         else:
-            key, sub, dyn_after, outs = _fused_tick(
+            key, sub, dyn_after, outs, tel_out = _fused_tick(
                 state, self._pipe_state, self._key,
                 jnp.float32(elapsed_us),
                 args.get("seq"), args.get("tbf"), args.get("ind"),
+                tel_in,
                 has_seq=bool(seq_group), has_tbf=bool(tbf_group),
                 has_ind=bool(ind_group),
-                has_dyn=self._pipe_state is not None)
+                has_dyn=self._pipe_state is not None,
+                has_tel=has_tel)
+        if has_tel:
+            self.telemetry.set_acc(tel_out)
         self._key = key
         job.sub = sub
         job.dyn_after = dyn_after
@@ -1882,7 +2184,8 @@ class WireDataPlane:
             kind, group, row_idx, sizes, valid, arrs = g
             if kind != "tbf":
                 continue
-            fbk = arrs[5][:len(group)].astype(bool)
+            fbk_dev = arrs[5][:len(group)].astype(bool)
+            fbk = fbk_dev
             forced = job.force_rows
             if forced:
                 # rows an older job's fallback corrected AFTER this
@@ -1931,6 +2234,30 @@ class WireDataPlane:
                 base, jnp.asarray(fb_rows), jnp.asarray(fb_sizes),
                 jnp.asarray(fb_valid), jax.random.fold_in(job.sub, 3))
             fbouts = [np.asarray(a) for a in _res_to_outs(res)]
+            if job.has_tel and self.telemetry is not None:
+                # window-ring correction for the re-shaped rows: the
+                # device reduction masked device-flagged fallback rows
+                # OUT (their stats come from the exact scan, here), and
+                # FORCED rows' stale detection-run stats are subtracted
+                # before the corrected ones are added — per-cause sums
+                # stay exact through the fallback path
+                telm = self.telemetry
+                for fj, r in enumerate(sel.tolist()):
+                    row = int(row_idx[r])
+                    if not fbk_dev[r]:
+                        stale = tele.tel_row_host(
+                            sizes[r], valid[r], arrs[0][r], arrs[1][r])
+                        stale[tele.T_DROP_LOSS] = float(arrs[2][r])
+                        stale[tele.T_DROP_QUEUE] = float(arrs[3][r])
+                        stale[tele.T_CORRUPT] = float(arrs[4][r])
+                        telm.patch_row(row, -stale)
+                    cols = tele.tel_row_host(
+                        fb_sizes[fj], fb_valid[fj],
+                        fbouts[0][fj], fbouts[1][fj])
+                    cols[tele.T_DROP_LOSS] = float(fbouts[2][fj])
+                    cols[tele.T_DROP_QUEUE] = float(fbouts[3][fj])
+                    cols[tele.T_CORRUPT] = float(fbouts[4][fj])
+                    telm.patch_row(row, cols)
             for a_i in range(5):
                 # np.asarray of a device array is a read-only view —
                 # the splice needs a private writable copy
@@ -2004,6 +2331,7 @@ class WireDataPlane:
         base_us = job.base_us
         now_s = job.now_s
         pending = self._pending
+        rec = self.recorder
         for kind, group, row_idx, sizes, valid, arrs in np_groups:
             deliv = arrs[0]
             depart = arrs[1]
@@ -2015,6 +2343,41 @@ class WireDataPlane:
                 nd = int(drow.sum())
                 shaped += nd
                 self.dropped += m - nd
+                # flight recorder: sampled frames' kernel-class +
+                # shaped/dropped(cause) verdicts; survivors carry their
+                # trace into the delay-line entry for the release event
+                tids = None
+                if (rec is not None and job.samples is not None
+                        and job.samples[i]):
+                    tids = {}
+                    for off, tid in job.samples[i]:
+                        rec.record(tid, tele.ST_SHAPED, kind=kind,
+                                   row=row)
+                        if drow[off]:
+                            if target is None:
+                                rec.record(tid, tele.ST_DROPPED,
+                                           cause="no-target", row=row)
+                            else:
+                                tids[off] = tid
+                        else:
+                            # row-granular attribution from the [R]
+                            # per-cause sums already in the transfer
+                            # set: exact whenever the row saw a single
+                            # drop cause this tick (see _tel_class)
+                            loss_n = float(arrs[2][r])
+                            queue_n = float(arrs[3][r])
+                            if loss_n and not queue_n:
+                                name = "dropped_loss"
+                            elif queue_n and not loss_n:
+                                name = "dropped_queue"
+                            elif loss_n and queue_n:
+                                name = (f"mixed(loss={int(loss_n)},"
+                                        f"queue={int(queue_n)})")
+                            else:
+                                name = ("tbf-fallback" if kind == "tbf"
+                                        else "unknown")
+                            rec.record(tid, tele.ST_DROPPED, cause=name,
+                                       row=row)
                 if nd == 0 or target is None:
                     continue
                 has_segs = any(type(p) is FrameSeg for p in fr)
@@ -2024,12 +2387,16 @@ class WireDataPlane:
                     # their transport blob until delivery needs them)
                     sel_frames = _LazyFrames(fr) if has_segs else fr
                     sel_dep = depart[r, :m]
+                    slot_map = tids
                 else:
                     if has_segs:
                         fr = flatten_frames(fr)
                     idxs = np.nonzero(drow)[0]
                     sel_frames = [fr[j] for j in idxs.tolist()]
                     sel_dep = depart[r, idxs]
+                    slot_map = ({int(np.searchsorted(idxs, off)): tid
+                                 for off, tid in tids.items()}
+                                if tids else None)
                 pk, uid = target
                 if use_wheel:
                     dls = base_us + sel_dep.astype(np.float64)
@@ -2039,10 +2406,15 @@ class WireDataPlane:
                     # (a list copy or a _LazyFrames): release None's
                     # slots out in place after materialization.
                     self._bseq += 1
-                    pending[self._bseq] = [
+                    entry = [
                         pk, uid,
                         sel_frames if type(sel_frames) is _LazyFrames
                         else list(sel_frames), dls, nd]
+                    if slot_map:
+                        # optional 6th element: delay-line slot → trace
+                        # id for the release-time delivered event
+                        entry.append(slot_map)
+                    pending[self._bseq] = entry
                     deadline_parts.append(dls)
                     token_parts.append(
                         (np.uint64(self._bseq << _TOK_BITS)
@@ -2055,9 +2427,15 @@ class WireDataPlane:
                            + sel_dep.astype(np.float64) / 1e6).tolist()
                     if type(sel_frames) is _LazyFrames:
                         sel_frames = sel_frames.materialize()
-                    for t_rel, tok, f in zip(rel, toks, sel_frames):
-                        heapq.heappush(self._heap,
-                                       (t_rel, tok, pk, uid, f))
+                    # heap entries carry the trace id (0 = untraced) so
+                    # the release path records delivery / stages the
+                    # peer hop identically to the wheel path
+                    for j, (t_rel, tok, f) in enumerate(
+                            zip(rel, toks, sel_frames)):
+                        heapq.heappush(
+                            self._heap,
+                            (t_rel, tok, pk, uid, f,
+                             slot_map.get(j, 0) if slot_map else 0))
             self._accumulate_group(row_idx, sizes, valid, arrs)
         if deadline_parts:
             self._wheel.schedule_batch(np.concatenate(deadline_parts),
@@ -2116,6 +2494,9 @@ class WireDataPlane:
         # preserved (appends happen in token order).
         groups: dict[tuple[str, int], list[bytes]] = {}
         setd = groups.setdefault
+        rec = self.recorder
+        # per-group frame-position → trace id (sampled frames only)
+        traced: dict[tuple[str, int], dict[int, int]] = {}
         if self._wheel is not None:
             # Tokens arrive in wheel (time) order and consecutive tokens
             # overwhelmingly share a batch: tokens come back as ONE
@@ -2136,7 +2517,9 @@ class WireDataPlane:
                 for g in range(len(starts) - 1):
                     a, b = starts[g], starts[g + 1]
                     entry = pending[int(bids[a])]
-                    cur_list = setd((entry[0], entry[1]), [])
+                    key = (entry[0], entry[1])
+                    cur_list = setd(key, [])
+                    tmap = entry[5] if len(entry) > 5 else None
                     frames_l = entry[2]
                     lazy = type(frames_l) is _LazyFrames
                     n = b - a
@@ -2149,13 +2532,21 @@ class WireDataPlane:
                         # full batch, token order == index order (a lazy
                         # entry can only be whole: any earlier partial
                         # release would have materialized it)
+                        if tmap:
+                            base = len(cur_list)
+                            tdst = traced.setdefault(key, {})
+                            for slot, tid in tmap.items():
+                                tdst[base + slot] = tid
                         cur_list.extend(frames_l.materialize() if lazy
                                         else frames_l)
                         del pending[int(bids[a])]
                         continue
                     if lazy:
                         frames_l = entry[2] = frames_l.materialize()
+                    tdst = traced.setdefault(key, {}) if tmap else None
                     for i in idxs[a:b].tolist():
+                        if tmap and i in tmap:
+                            tdst[len(cur_list)] = tmap.pop(i)
                         cur_list.append(frames_l[i])
                         frames_l[i] = None
                     entry[4] -= n
@@ -2163,8 +2554,12 @@ class WireDataPlane:
                         del pending[int(bids[a])]
         else:
             while self._heap and self._heap[0][0] <= now_s:
-                _, _, pod_key, uid, frame = heapq.heappop(self._heap)
-                setd((pod_key, uid), []).append(frame)
+                (_, _, pod_key, uid, frame,
+                 tid) = heapq.heappop(self._heap)
+                lst = setd((pod_key, uid), [])
+                if tid:
+                    traced.setdefault((pod_key, uid), {})[len(lst)] = tid
+                lst.append(frame)
         if self._orphans:
             # wires that appeared since last release get their waiting
             # frames; expired waits are counted, never silently dropped
@@ -2183,6 +2578,7 @@ class WireDataPlane:
         cap = self.daemon.capture
         for wkey, frames in groups.items():
             wire = self.daemon.wires.get_by_key(*wkey)
+            tmap = traced.get(wkey)
             if wire is None:
                 expire = now_s + self.orphan_grace_s
                 self._orphans.extend(
@@ -2192,9 +2588,12 @@ class WireDataPlane:
                 # stage for the per-peer stream batch below
                 push = self._remote.push
                 addr, intf = wire.peer_ip, wire.peer_intf_id
-                for frame in frames:
-                    if push(addr, intf, frame):
+                for pos, frame in enumerate(frames):
+                    tid = tmap.get(pos, 0) if tmap else 0
+                    if push(addr, intf, frame, tid):
                         staged = True
+                        if tid:
+                            rec.record(tid, tele.ST_STAGED, peer=addr)
                     else:
                         # overflow: charge the drop to this frame's edge
                         # so it shows up in the interface metrics
@@ -2202,8 +2601,15 @@ class WireDataPlane:
                         row = self.engine._rows.get(wkey)
                         if row is not None:
                             ring_drops[row] = ring_drops.get(row, 0) + 1
+                        if tid:
+                            rec.record(tid, tele.ST_EGRESS_DROP,
+                                       reason="ring-overflow")
             else:
                 wire.egress.extend(frames)
+                if tmap:
+                    for _pos, tid in tmap.items():
+                        rec.record(tid, tele.ST_DELIVERED,
+                                   wire=wire.wire_id)
                 if cap is not None:
                     for frame in frames:
                         cap.record(*wkey, frame, "out")
@@ -2234,19 +2640,30 @@ class WireDataPlane:
         from kubedtn_tpu.wire import proto as pb
 
         by_peer: dict[str, list] = {}
+        traced_by_peer: dict[str, list] = {}
         while True:
             item = self._remote.pop()
             if item is None:
                 break
-            addr, intf, frame = item
-            by_peer.setdefault(addr, []).append(
-                pb.Packet(remot_intf_id=intf, frame=frame))
+            addr, intf, tid, frame = item
+            dst = by_peer.setdefault(addr, [])
+            if tid:
+                # sampled frame: the trace id rides the peer hop in
+                # Packet.trace_id (a proto extension reference daemons
+                # skip as an unknown field) so the remote delivery
+                # attaches to the same trace
+                dst.append(pb.Packet(remot_intf_id=intf, frame=frame,
+                                     trace_id=tid))
+                traced_by_peer.setdefault(addr, []).append(
+                    (len(dst) - 1, tid))
+            else:
+                dst.append(pb.Packet(remot_intf_id=intf, frame=frame))
         for addr, packets in by_peer.items():
             sender = self._peer_senders.get(addr)
             if sender is None:
                 sender = _PeerSender(self.daemon, addr)
                 self._peer_senders[addr] = sender
-            sender.enqueue(packets)
+            sender.enqueue(packets, traced=traced_by_peer.get(addr))
 
     @property
     def peer_queue_dropped(self) -> int:
@@ -2297,6 +2714,10 @@ class WireDataPlane:
                 return out
 
             self.counters = jax.tree.map(permute, self.counters)
+            if self.telemetry is not None:
+                # the window ring's per-edge rows follow the same
+                # renumbering as the cumulative counters
+                self.telemetry.remap_rows(old_rows, n_active, cap)
 
     # -- thread --------------------------------------------------------
 
@@ -2322,8 +2743,9 @@ class WireDataPlane:
             if self._last_shaped_s is not None:
                 self._last_shaped_s += delta
             if self._heap:  # non-wheel fallback holds absolute deadlines
-                self._heap = [(r + delta, seq, pk, uid, f)
-                              for (r, seq, pk, uid, f) in self._heap]
+                self._heap = [(r + delta, seq, pk, uid, f, tid)
+                              for (r, seq, pk, uid, f, tid)
+                              in self._heap]
                 heapq.heapify(self._heap)
             self.last_now_s += delta
             self._clock_ext = False

@@ -172,6 +172,13 @@ class Reconciler:
         """One reconcile pass for one Topology, mirroring Reconcile
         (topology_controller.go:61-156)."""
         key = f"{namespace or 'default'}/{name}"
+        from kubedtn_tpu.utils import tracing
+
+        with tracing.span("reconcile", key=key):
+            return self._reconcile_traced(namespace, name, key)
+
+    def _reconcile_traced(self, namespace: str, name: str,
+                          key: str) -> ReconcileResult:
         t_start = time.perf_counter()
         try:
             topo = self.store.get(namespace, name)

@@ -42,17 +42,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubedtn_tpu.metrics.metrics import BUCKETS
 from kubedtn_tpu.models.traffic import TrafficSpec
 from kubedtn_tpu.ops import edge_state as es
+# The latency reduction is the LINK TELEMETRY plane's: the same bucket
+# ladder and histogram_quantile the live plane's per-edge window ring
+# uses (kubedtn_tpu/telemetry.py), so a sweep's p99 and `cli top`'s p99
+# are the same statistic by construction.
+from kubedtn_tpu.telemetry import (BUCKET_EDGES_US, N_BINS,
+                                   percentiles_from_hist)
 from kubedtn_tpu.twin.snapshot import TwinSnapshot
 from kubedtn_tpu.twin.spec import ReplicaEdits, compile_scenarios
-
-# latency histogram bin upper edges in µs — the reference daemon's
-# request-duration bucket ladder (metrics.BUCKETS, milliseconds) scaled
-# to the data plane's native unit; one overflow bin past the last edge
-BUCKET_EDGES_US = tuple(float(b) * 1000.0 for b in BUCKETS[1:])
-N_BINS = len(BUCKET_EDGES_US) + 1
 
 _COUNTER_KEYS = ("tx_packets", "tx_bytes", "rx_packets", "rx_bytes",
                  "dropped_loss", "dropped_queue", "dropped_ring",
@@ -258,33 +257,9 @@ def _mesh_sig(mesh):
             tuple(d.id for d in mesh.devices.flat))
 
 
-# -- percentiles from bucket counts ------------------------------------
+# -- percentiles from bucket counts: telemetry.percentiles_from_hist --
 
-def _percentiles(hist_row: np.ndarray, qs=(0.5, 0.9, 0.99)) -> dict:
-    """histogram_quantile over the reference bucket ladder: linear
-    interpolation inside a bin, the overflow bin capped at the last
-    edge (Prometheus semantics)."""
-    edges = np.asarray(BUCKET_EDGES_US)
-    total = float(hist_row.sum())
-    out = {}
-    for q in qs:
-        key = f"p{int(q * 100)}_us"
-        if total <= 0:
-            out[key] = None
-            continue
-        target = q * total
-        cum = np.cumsum(hist_row)
-        b = int(np.searchsorted(cum, target, side="left"))
-        if b >= len(edges):
-            out[key] = float(edges[-1])
-            continue
-        lo = 0.0 if b == 0 else float(edges[b - 1])
-        hi = float(edges[b])
-        below = 0.0 if b == 0 else float(cum[b - 1])
-        inbin = float(hist_row[b])
-        frac = 0.0 if inbin <= 0 else (target - below) / inbin
-        out[key] = round(lo + (hi - lo) * frac, 3)
-    return out
+_percentiles = percentiles_from_hist
 
 
 def _replica_metrics(i: int, totals_np: dict, start: dict,

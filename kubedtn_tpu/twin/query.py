@@ -153,6 +153,14 @@ def scenarios_from_request(request, props_from_proto) -> list:
 def serve_whatif(daemon, request):
     """The Local.WhatIf handler body (imported lazily by the daemon so
     the twin engine costs nothing until the first query)."""
+    from kubedtn_tpu.utils import tracing
+
+    with tracing.span("whatif-sweep",
+                      scenarios=len(request.scenarios)):
+        return _serve_whatif_traced(daemon, request)
+
+
+def _serve_whatif_traced(daemon, request):
     from kubedtn_tpu.twin.engine import run_sweep
     from kubedtn_tpu.wire import proto as pb
 

@@ -20,7 +20,7 @@ import contextlib
 import json
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from dataclasses import dataclass, field
 
 
@@ -35,10 +35,15 @@ class Span:
 
 
 class Tracer:
-    def __init__(self, enabled: bool = True) -> None:
+    def __init__(self, enabled: bool = True,
+                 max_spans: int = 100_000) -> None:
         self.enabled = enabled
         self._lock = threading.Lock()
-        self._spans: list[Span] = []
+        # bounded: a long-lived daemon with spans around every
+        # reconcile/checkpoint must not grow without limit — the oldest
+        # spans fall off and `dropped_spans` records how many
+        self._spans: deque[Span] = deque(maxlen=max_spans)
+        self.dropped_spans = 0
         self._local = threading.local()
         self._t0 = time.perf_counter()
 
@@ -65,6 +70,8 @@ class Tracer:
             self._stack().pop()
             s.dur_us = self._now_us() - s.start_us
             with self._lock:
+                if len(self._spans) == self._spans.maxlen:
+                    self.dropped_spans += 1
                 self._spans.append(s)
 
     def traced(self, name: str | None = None):

@@ -105,9 +105,16 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
     f.message_type.append(_msg(
         "WireCreateResponse",
         _field("response", 1, B), _field("peer_intf_id", 2, I64)))
+    # Packet carries an OPTIONAL framework extension past the reference
+    # fields: trace_id (field 3) — the flight recorder's 64-bit trace id
+    # on hash-sampled frames (0 / absent on everything else). Reference
+    # daemons skip it as an unknown field; the native PacketBatch
+    # walker parses it without leaving the zero-copy path.
+    U64 = _T.TYPE_UINT64
     f.message_type.append(_msg(
         "Packet",
-        _field("remot_intf_id", 1, I64), _field("frame", 2, BY)))
+        _field("remot_intf_id", 1, I64), _field("frame", 2, BY),
+        _field("trace_id", 3, U64)))
     # Framework extension (absent from reference kube_dtn.proto): many
     # frames per gRPC message for the coalesced bulk transport — Python
     # gRPC tops out near ~25k streamed MESSAGES/s regardless of payload,
@@ -184,6 +191,53 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
         _field("run_s", 8, D),
         _field("replicas_steps_per_s", 9, D),
     ))
+    # Framework extension (absent from reference kube_dtn.proto): the
+    # link telemetry query surface — ranked per-edge window-ring rows
+    # (`cli top`) and flight-recorder trace export (`cli trace`).
+    # Reference clients never see these types.
+    f.message_type.append(_msg(
+        "ObserveLinksRequest",
+        _field("top_n", 1, I32),        # 0 = all (up to the guard)
+        _field("windows", 2, I32),      # closed windows to cover; 0=all
+    ))
+    f.message_type.append(_msg(
+        "LinkStats",
+        _field("pod", 1, S), _field("namespace", 2, S),
+        _field("uid", 3, I64), _field("row", 4, I32),
+        _field("tx", 5, D), _field("delivered", 6, D),
+        _field("delivered_pps", 7, D), _field("bytes_ps", 8, D),
+        _field("dropped_loss", 9, D), _field("dropped_queue", 10, D),
+        _field("corrupted", 11, D), _field("queue_depth", 12, D),
+        _field("mean_lat_us", 13, D),
+        _field("p50_us", 14, D),        # -1 = unknown/empty
+        _field("p99_us", 15, D),
+    ))
+    f.message_type.append(_msg(
+        "ObserveLinksResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("links", 3, None, REP, type_name="LinkStats"),
+        _field("covered_seconds", 4, D),
+        _field("truncated", 5, I32),
+        _field("windows_closed", 6, I64),
+    ))
+    f.message_type.append(_msg(
+        "ObserveTraceRequest",
+        _field("trace_id", 1, U64),     # 0 = newest events
+        _field("limit", 2, I32),
+    ))
+    f.message_type.append(_msg(
+        "TraceEvent",
+        _field("trace_id", 1, U64), _field("t", 2, D),
+        _field("node", 3, S), _field("stage", 4, S),
+        _field("detail", 5, S),         # compact k=v pairs
+    ))
+    f.message_type.append(_msg(
+        "ObserveTraceResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("events", 3, None, REP, type_name="TraceEvent"),
+        _field("recent_traces", 4, U64, REP),
+        _field("sampled", 5, I64),
+    ))
     return f
 
 
@@ -198,7 +252,10 @@ for _name in ("LinkProperties", "Link", "Pod", "PodQuery",
               "GenerateNodeInterfaceNameRequest",
               "GenerateNodeInterfaceNameResponse",
               "WhatIfPerturbation", "WhatIfScenario", "WhatIfRequest",
-              "WhatIfMetrics", "WhatIfResponse"):
+              "WhatIfMetrics", "WhatIfResponse",
+              "ObserveLinksRequest", "LinkStats", "ObserveLinksResponse",
+              "ObserveTraceRequest", "TraceEvent",
+              "ObserveTraceResponse"):
     _MESSAGES[_name] = message_factory.GetMessageClass(
         _pool.FindMessageTypeByName(f"{PACKAGE}.{_name}"))
 
@@ -223,6 +280,12 @@ WhatIfScenario = _MESSAGES["WhatIfScenario"]
 WhatIfRequest = _MESSAGES["WhatIfRequest"]
 WhatIfMetrics = _MESSAGES["WhatIfMetrics"]
 WhatIfResponse = _MESSAGES["WhatIfResponse"]
+ObserveLinksRequest = _MESSAGES["ObserveLinksRequest"]
+LinkStats = _MESSAGES["LinkStats"]
+ObserveLinksResponse = _MESSAGES["ObserveLinksResponse"]
+ObserveTraceRequest = _MESSAGES["ObserveTraceRequest"]
+TraceEvent = _MESSAGES["TraceEvent"]
+ObserveTraceResponse = _MESSAGES["ObserveTraceResponse"]
 
 # Service method tables: name -> (request class, response class, streaming)
 LOCAL_METHODS = {
@@ -241,6 +304,11 @@ LOCAL_METHODS = {
     # Framework extension: what-if sweeps served from the live daemon's
     # forked snapshot (kubedtn_tpu.twin.query; not in the reference IDL)
     "WhatIf": (WhatIfRequest, WhatIfResponse, False),
+    # Framework extensions: link telemetry query surface (ranked
+    # per-edge window-ring stats + flight-recorder traces; cli top /
+    # cli trace read these — not in the reference IDL)
+    "ObserveLinks": (ObserveLinksRequest, ObserveLinksResponse, False),
+    "ObserveTrace": (ObserveTraceRequest, ObserveTraceResponse, False),
 }
 REMOTE_METHODS = {
     "Update": (RemotePod, BoolResponse, False),

@@ -395,6 +395,13 @@ class Daemon:
         # observability stand-in for the reference's per-wire libpcap
         # handles (grpcwire.go:398-409); None = zero cost
         self.capture = None
+        # optional flight recorder (telemetry.FlightRecorder) — set by
+        # WireDataPlane.enable_telemetry on the sending side, or
+        # directly on a receive-only daemon: frames arriving with a
+        # nonzero Packet.trace_id attach their `received` event here,
+        # closing the cross-node half of a sampled trace. None = zero
+        # cost on every ingestion path.
+        self.recorder = None
         try:
             from kubedtn_tpu import native as _native
             # counts-only form: no per-frame Python on the drain path
@@ -511,6 +518,60 @@ class Daemon:
 
         return serve_whatif(self, request)
 
+    def ObserveLinks(self, request, context):
+        """Framework extension: ranked per-edge window-ring stats from
+        the link telemetry plane (`cli top` reads this)."""
+        plane = self.dataplane
+        tel = getattr(plane, "telemetry", None) if plane else None
+        if tel is None:
+            return pb.ObserveLinksResponse(
+                ok=False, error="link telemetry not enabled on this "
+                                "daemon (start with telemetry on)")
+        windows = int(request.windows) or None
+        try:
+            rows, secs, truncated = tel.link_rows(self.engine,
+                                                  last=windows)
+        except Exception as e:  # a query must never kill the daemon
+            return pb.ObserveLinksResponse(
+                ok=False, error=f"{type(e).__name__}: {e}")
+        top = int(request.top_n) or len(rows)
+        nn = lambda v: -1.0 if v is None else float(v)  # noqa: E731
+        return pb.ObserveLinksResponse(
+            ok=True, covered_seconds=secs, truncated=truncated,
+            windows_closed=tel.windows_closed,
+            links=[pb.LinkStats(
+                pod=r["pod"], namespace=r["namespace"], uid=r["uid"],
+                row=r["row"], tx=r["tx"], delivered=r["delivered"],
+                delivered_pps=r["delivered_pps"],
+                bytes_ps=r["bytes_ps"],
+                dropped_loss=r["dropped_loss"],
+                dropped_queue=r["dropped_queue"],
+                corrupted=r["corrupted"], queue_depth=r["queue_depth"],
+                mean_lat_us=nn(r["mean_lat_us"]),
+                p50_us=nn(r["p50_us"]), p99_us=nn(r["p99_us"]),
+            ) for r in rows[:top]])
+
+    def ObserveTrace(self, request, context):
+        """Framework extension: flight-recorder event export — one
+        trace's path (trace_id != 0) or the newest events (`cli trace`
+        merges several daemons' answers into a hop-by-hop view)."""
+        rec = self.recorder
+        if rec is None:
+            return pb.ObserveTraceResponse(
+                ok=False, error="flight recorder not enabled on this "
+                                "daemon")
+        limit = int(request.limit) or 1000
+        evs = rec.export(trace_id=int(request.trace_id), limit=limit)
+        return pb.ObserveTraceResponse(
+            ok=True, sampled=rec.sampled,
+            recent_traces=rec.recent_traces(limit=50),
+            events=[pb.TraceEvent(
+                trace_id=e["trace_id"], t=e["t"], node=e["node"],
+                stage=e["stage"],
+                detail=" ".join(f"{k}={v}" for k, v in
+                                sorted(e["detail"].items())),
+            ) for e in evs])
+
     # -- Remote --------------------------------------------------------
 
     def Update(self, request, context):
@@ -589,23 +650,46 @@ class Daemon:
             if self.capture is not None:
                 self.capture.record(wire.pod_key, wire.uid, frame, "in")
 
+    def _record_received(self, trace_id: int, wire_id: int,
+                         delivered: bool) -> None:
+        """Attach a cross-node sampled frame's arrival to its trace
+        (Packet.trace_id extension): `received` always, and
+        `delivered-remote` when the frame landed on the pod-side
+        egress queue in the same call."""
+        from kubedtn_tpu import telemetry as tele
+
+        rec = self.recorder
+        if rec is None:
+            return
+        rec.record(trace_id, tele.ST_RECEIVED, wire=wire_id)
+        if delivered:
+            rec.record(trace_id, tele.ST_DELIVERED_REMOTE, wire=wire_id)
+
     def SendToOnce(self, request, context):
         wire = self.wires.get_by_id(int(request.remot_intf_id))
         if wire is None:
             context.abort(grpc.StatusCode.NOT_FOUND,
                           f"no wire {request.remot_intf_id}")
         self._frame_in(wire, bytes(request.frame))
+        if self.recorder is not None and request.trace_id:
+            self._record_received(int(request.trace_id), wire.wire_id,
+                                  bool(wire.peer_ip))
         return pb.BoolResponse(response=True)
 
     def SendToStream(self, request_iterator, context):
         """Client-streaming frame ingestion — implemented (the reference
         never implements this RPC; kube_dtn.proto:171)."""
         n = 0
+        rec = self.recorder
         for pkt in request_iterator:
             wire = self.wires.get_by_id(int(pkt.remot_intf_id))
             if wire is not None:
                 self._frame_in(wire, bytes(pkt.frame))
                 n += 1
+                if rec is not None and pkt.trace_id:
+                    self._record_received(int(pkt.trace_id),
+                                          wire.wire_id,
+                                          bool(wire.peer_ip))
         return pb.BoolResponse(response=n > 0)
 
     def InjectFrame(self, request, context):
@@ -686,13 +770,24 @@ class Daemon:
             for pkt in item.packets:
                 # pkt.frame is already a bytes object — no copy
                 groups.setdefault(pkt.remot_intf_id, []).append(pkt.frame)
+                if self.recorder is not None and pkt.trace_id:
+                    self._record_received(int(pkt.trace_id),
+                                          int(pkt.remot_intf_id), False)
             yield from groups.items()
             return
         from kubedtn_tpu import native as _nat
 
         blob = bytes(item)
         try:
-            ids, offs, lens = _nat.parse_packet_batch(blob)
+            # the traced walk decodes Packet.trace_id in the SAME
+            # native pass — sampled frames keep their cross-node trace
+            # without the zero-copy path ever building message objects
+            if self.recorder is not None:
+                ids, offs, lens, traces = \
+                    _nat.parse_packet_batch_traced(blob)
+            else:
+                ids, offs, lens = _nat.parse_packet_batch(blob)
+                traces = None
         except ValueError:
             # malformed per the native walker: let the protobuf runtime
             # be the arbiter (it raises its own error on true garbage)
@@ -703,6 +798,11 @@ class Daemon:
         if len(ids) == 0:
             return
         import numpy as np
+
+        if traces is not None and traces.any():
+            for k in np.nonzero(traces)[0].tolist():
+                self._record_received(int(traces[k]), int(ids[k]),
+                                      False)
 
         offs_u = np.ascontiguousarray(offs, np.uint64)
         lens_u = np.ascontiguousarray(lens, np.uint64)

@@ -320,9 +320,10 @@ inline bool kdt_skip_field(const uint8_t* b, uint64_t len, uint64_t* p,
 }
 }  // namespace
 
-int64_t kdt_parse_packet_batch(const uint8_t* blob, uint64_t len,
-                               int64_t* out_ids, uint64_t* out_off,
-                               uint64_t* out_len, int64_t max) {
+int64_t kdt_parse_packet_batch_t(const uint8_t* blob, uint64_t len,
+                                 int64_t* out_ids, uint64_t* out_off,
+                                 uint64_t* out_len, uint64_t* out_trace,
+                                 int64_t max) {
   uint64_t p = 0;
   int64_t n = 0;
   while (p < len) {
@@ -338,7 +339,7 @@ int64_t kdt_parse_packet_batch(const uint8_t* blob, uint64_t len,
     const uint64_t pend = p + plen;
     if (n >= max) return -1;
     int64_t id = 0;
-    uint64_t foff = 0, flen = 0;
+    uint64_t foff = 0, flen = 0, trace = 0;
     while (p < pend) {
       uint64_t ptag;
       if (!kdt_read_varint(blob, pend, &p, &ptag)) return -1;
@@ -353,6 +354,8 @@ int64_t kdt_parse_packet_batch(const uint8_t* blob, uint64_t len,
         foff = p;
         flen = v;
         p += v;
+      } else if (ptag == 0x18) {  // trace_id, varint (flight recorder)
+        if (!kdt_read_varint(blob, pend, &p, &trace)) return -1;
       } else if (!kdt_skip_field(blob, pend, &p, ptag & 7)) {
         return -1;
       }
@@ -360,9 +363,17 @@ int64_t kdt_parse_packet_batch(const uint8_t* blob, uint64_t len,
     out_ids[n] = id;
     out_off[n] = foff;
     out_len[n] = flen;
+    if (out_trace) out_trace[n] = trace;
     ++n;
   }
   return n;
+}
+
+int64_t kdt_parse_packet_batch(const uint8_t* blob, uint64_t len,
+                               int64_t* out_ids, uint64_t* out_off,
+                               uint64_t* out_len, int64_t max) {
+  return kdt_parse_packet_batch_t(blob, len, out_ids, out_off, out_len,
+                                  nullptr, max);
 }
 
 // ===================== 2. bypass flow table =====================

@@ -1,0 +1,220 @@
+"""Per-cause drop accounting: the partition invariant, end to end.
+
+Every shaping kernel computes its drop causes separately (netem loss in
+netem_packet, TBF 50ms-queue overflow in tbf_packet) and the outcomes
+are mutually exclusive BY CONSTRUCTION — including at the
+duplicate/loss interaction (netem.py `loss_hit & ~dup_hit`: a packet
+that hits BOTH duplicate and loss transmits exactly once, counting in
+neither cause). These property tests pin, over random specs:
+
+- kernel level: delivered + dropped_loss + dropped_queue == offered,
+  exactly, for all three batch kernels (slot-independent, max-plus TBF
+  incl. its fallback flag, sequential scan), and `cause_codes` encodes
+  the same partition;
+- plane level: the live plane's total `dropped` equals the per-edge
+  dropped_loss + dropped_queue counter sums exactly (no double count,
+  no uncounted drop), with the window ring agreeing when telemetry is
+  on — through mixed kernel classes and the TBF fallback re-shape.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubedtn_tpu import telemetry as tele
+from kubedtn_tpu.api.types import Link, LinkProperties, Topology, \
+    TopologySpec
+from kubedtn_tpu.ops import netem
+from kubedtn_tpu.runtime import WireDataPlane
+from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+
+
+def _rand_props(rng) -> LinkProperties:
+    """A random spec drawn from the whole shaping vocabulary: loss /
+    duplicate / corrupt / reorder (with correlations), jitter, and TBF
+    rates low enough to force queue drops."""
+    kw = {}
+    if rng.random() < 0.8:
+        kw["latency"] = f"{rng.integers(0, 5000)}us"
+    if rng.random() < 0.5:
+        kw["jitter"] = f"{rng.integers(1, 1000)}us"
+    if rng.random() < 0.6:
+        kw["loss"] = str(round(float(rng.uniform(0, 40)), 1))
+        if rng.random() < 0.5:
+            kw["loss_corr"] = str(rng.integers(1, 80))
+    if rng.random() < 0.4:
+        kw["duplicate"] = str(round(float(rng.uniform(0, 30)), 1))
+    if rng.random() < 0.4:
+        kw["corrupt_prob"] = str(round(float(rng.uniform(0, 20)), 1))
+    if rng.random() < 0.3:
+        kw["reorder_prob"] = str(round(float(rng.uniform(0, 30)), 1))
+        kw["gap"] = int(rng.integers(0, 4))
+    if rng.random() < 0.5:
+        # 256Kbit..4Mbit: burst ~5KB, so dense 64-1500B batches
+        # regularly overflow the 50ms queue → dropped_queue exercised
+        kw["rate"] = f"{int(rng.integers(256, 4000))}Kbit"
+    return LinkProperties(**kw)
+
+
+def _plane_with_links(specs, prefix):
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=4 * len(specs) + 8)
+    for i, props in enumerate(specs):
+        a, b = f"{prefix}a{i}", f"{prefix}b{i}"
+        store.create(Topology(name=a, spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=b,
+                 uid=i + 1, properties=props)])))
+        store.create(Topology(name=b, spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=a,
+                 uid=i + 1, properties=props)])))
+        engine.setup_pod(a)
+        engine.setup_pod(b)
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    win = [daemon._add_wire(pb.WireDef(
+        local_pod_name=f"{prefix}a{i}", kube_ns="default",
+        link_uid=i + 1, intf_name_in_pod="eth1"))
+        for i in range(len(specs))]
+    for i in range(len(specs)):
+        daemon._add_wire(pb.WireDef(
+            local_pod_name=f"{prefix}b{i}", kube_ns="default",
+            link_uid=i + 1, intf_name_in_pod="eth1"))
+    return daemon, engine, win
+
+
+# -- kernel-level partition --------------------------------------------
+
+def _assert_partition(res, act):
+    deliv = np.asarray(res.delivered)
+    loss = np.asarray(res.dropped_loss)
+    queue = np.asarray(res.dropped_queue)
+    act = np.asarray(act)
+    # mutually exclusive and exhaustive over active lanes
+    assert not (deliv & loss).any()
+    assert not (deliv & queue).any()
+    assert not (loss & queue).any()
+    assert ((deliv | loss | queue) == act).all()
+    # cause_codes is the same partition, encoded
+    codes = np.asarray(netem.cause_codes(res))
+    assert ((codes == 0) == ~act).all()
+    assert ((codes == 1) == deliv).all()
+    assert ((codes == 2) == loss).all()
+    assert ((codes == 3) == queue).all()
+
+
+def test_kernel_partition_property():
+    """delivered + dropped_loss + dropped_queue == offered, exactly,
+    for each batch kernel over random states and random specs."""
+    from kubedtn_tpu.models import topologies as T
+
+    rng = np.random.default_rng(7)
+    for trial in range(6):
+        el = T.random_mesh(8, 12, seed=int(rng.integers(1 << 30)))
+        state, rows = T.load_edge_list_into_state(el)
+        # randomize the props columns directly: loss/corr/dup/rate mixes
+        props = np.asarray(state.props).copy()
+        E = props.shape[0]
+        from kubedtn_tpu.ops import edge_state as es
+
+        props[:, es.P_LOSS] = rng.uniform(0, 40, E)
+        props[:, es.P_DUPLICATE] = rng.uniform(0, 30, E)
+        props[:, es.P_CORRUPT_PROB] = rng.uniform(0, 20, E)
+        props[:, es.P_RATE_BPS] = np.where(
+            rng.random(E) < 0.5, rng.uniform(2e5, 4e6, E), 0.0)
+        corr_on = rng.random(E) < 0.3
+        props[:, es.P_LOSS_CORR] = np.where(corr_on,
+                                            rng.uniform(0, 80, E), 0.0)
+        state = dataclasses.replace(state,
+                                    props=jnp.asarray(props,
+                                                      jnp.float32))
+        R, K = 6, 32
+        row_idx = jnp.asarray(rng.choice(len(rows), R, replace=False)
+                              .astype(np.int32))
+        sizes = jnp.asarray(rng.integers(64, 1500, (R, K))
+                            .astype(np.float32))
+        valid = jnp.asarray(rng.random((R, K)) < 0.9)
+        key = jax.random.key(trial)
+        act = np.asarray(valid) & np.asarray(
+            state.active)[np.asarray(row_idx)][:, None]
+
+        # sequential scan (handles every spec)
+        _st, res = netem.shape_slots_nodonate(state, row_idx, sizes,
+                                              valid, key)
+        _assert_partition(res, act)
+
+        # slot-independent kernel on its eligible rows
+        indep = np.asarray(netem.slot_independent_rows(
+            np.asarray(state.props)[np.asarray(row_idx)]))
+        if indep.any():
+            sub_rows = row_idx[jnp.asarray(np.nonzero(indep)[0])]
+            res2, _cnt = netem.shape_slots_indep_nodonate(
+                state, sub_rows, sizes[jnp.asarray(
+                    np.nonzero(indep)[0])],
+                valid[jnp.asarray(np.nonzero(indep)[0])], key)
+            _assert_partition(res2, act[indep])
+
+        # max-plus TBF kernel on its eligible rows (fallback rows are
+        # flagged, not mis-partitioned)
+        tbfb = np.asarray(netem.tbf_batch_rows(
+            np.asarray(state.props)[np.asarray(row_idx)]))
+        if tbfb.any():
+            sel = jnp.asarray(np.nonzero(tbfb)[0])
+            res3, _tok, _dep, _dl, _ha, _fb = \
+                netem.shape_slots_tbf_nodonate(
+                    state, row_idx[sel], sizes[sel], valid[sel], key)
+            _assert_partition(res3, act[tbfb])
+
+
+# -- plane-level accounting --------------------------------------------
+
+def test_plane_drop_causes_sum_to_total_property():
+    """Random spec mix through the LIVE plane: per-edge cause counters
+    sum exactly to the plane's `dropped` total, per-edge tx equals
+    delivered + causes, and the telemetry window ring agrees — over
+    both pipeline depths (the TBF fallback path included)."""
+    rng = np.random.default_rng(23)
+    for depth in (1, 2):
+        specs = [_rand_props(rng) for _ in range(5)]
+        daemon, engine, win = _plane_with_links(specs, f"pc{depth}")
+        plane = WireDataPlane(daemon, dt_us=2000.0,
+                              pipeline_depth=depth)
+        plane.pipeline_explicit_clock = True
+        tel, _rec = plane.enable_telemetry(window_s=10.0,
+                                           sample_period=16)
+        fed = 0
+        t = 100.0
+        for burst in range(3):
+            for k, w in enumerate(win):
+                n = int(rng.integers(20, 200))
+                w.ingress.extend([bytes([k]) + b"\x00" * 63] * n)
+                fed += n
+            for _ in range(15):
+                t += 0.002
+                plane.tick(now_s=t)
+        plane.flush()
+        plane.tick(now_s=t + 10.0)
+        assert plane.tick_errors == 0
+        c = plane.counters
+        tx = np.asarray(c.tx_packets)
+        rx = np.asarray(c.rx_packets)
+        loss = np.asarray(c.dropped_loss)
+        queue = np.asarray(c.dropped_queue)
+        # global: every fed frame shaped or dropped, causes exact
+        assert tx.sum() == fed
+        assert rx.sum() == plane.shaped
+        assert loss.sum() + queue.sum() == plane.dropped
+        assert plane.shaped + plane.dropped == fed
+        # per-edge: delivered + causes == offered on every row
+        np.testing.assert_array_equal(rx + loss + queue, tx)
+        # the window ring tells the same story
+        total, _secs = tel.window_sum()
+        assert total[:, tele.T_TX].sum() == fed
+        assert total[:, tele.T_DELIVERED].sum() == plane.shaped
+        np.testing.assert_allclose(total[:, tele.T_DROP_LOSS], loss)
+        np.testing.assert_allclose(total[:, tele.T_DROP_QUEUE], queue)
+        assert total[:, tele.T_HIST0:].sum() == plane.shaped

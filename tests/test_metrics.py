@@ -170,3 +170,145 @@ def test_dataplane_stats_series():
     assert val("shaped") == 1.0
     assert val("undeliverable") == 0.0
     assert val("tick_errors") == 0.0
+
+
+# -- MetricsServer robustness (round 8) --------------------------------
+
+def test_server_unknown_path_404_plain_registry():
+    """404 on unknown paths needs no engine or reference YAML."""
+    registry, _ = make_registry()
+    srv = MetricsServer(registry, port=0)
+    srv.start()
+    try:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/definitely-not-metrics")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()
+
+
+def test_server_concurrent_scrapes():
+    """Many simultaneous scrapes (ThreadingHTTPServer) all succeed and
+    all see the same complete exposition."""
+    import threading
+
+    registry, hist = make_registry()
+    hist.observe("add", 2.0)
+    srv = MetricsServer(registry, port=0)
+    srv.start()
+    results: list = []
+
+    def scrape():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics") as resp:
+            results.append(resp.read().decode())
+
+    try:
+        threads = [threading.Thread(target=scrape) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert len(results) == 8
+        assert all("kubedtnd_request_duration_milliseconds" in r
+                   for r in results)
+    finally:
+        srv.stop()
+
+
+class _FlakyCollector:
+    """Raises on the first N collects, then behaves."""
+
+    def __init__(self, failures: int) -> None:
+        self.failures = failures
+        self.calls = 0
+
+    def collect(self):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise RuntimeError("collector exploded mid-scrape")
+        from prometheus_client.core import GaugeMetricFamily
+
+        g = GaugeMetricFamily("flaky_ok", "recovered")
+        g.add_metric([], 1.0)
+        yield g
+
+
+def test_collector_raising_mid_scrape_does_not_kill_server():
+    """A collector raising mid-scrape costs THAT scrape a 500 — the
+    handler thread survives and subsequent scrapes succeed (including
+    the same collector recovering)."""
+    registry, _ = make_registry()
+    flaky = _FlakyCollector(failures=2)
+    registry.register(flaky)
+    srv = MetricsServer(registry, port=0)
+    srv.start()
+    url = f"http://127.0.0.1:{srv.port}/metrics"
+    try:
+        for _ in range(2):
+            try:
+                urllib.request.urlopen(url)
+                assert False, "expected 500"
+            except urllib.error.HTTPError as e:
+                assert e.code == 500
+                assert "scrape failed" in e.read().decode()
+        # server not wedged: the recovered collector now scrapes clean
+        with urllib.request.urlopen(url) as resp:
+            body = resp.read().decode()
+        assert "flaky_ok" in body
+        assert flaky.calls == 3
+    finally:
+        srv.stop()
+
+
+def test_link_telemetry_collector_series():
+    """kubedtn_link_* per-edge series appear once the plane's telemetry
+    is on, with the coverage gauges and the truncation guard."""
+    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    props = LinkProperties(latency="2ms")
+    store.create(Topology(name="ma", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="mb",
+             uid=1, properties=props)])))
+    store.create(Topology(name="mb", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="ma",
+             uid=1, properties=props)])))
+    engine.setup_pod("ma")
+    engine.setup_pod("mb")
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=1000.0)
+    registry, _ = make_registry(engine, plane.counters_fn,
+                                dataplane=plane)
+    # telemetry off: no kubedtn_link_ series at all
+    assert "kubedtn_link_" not in generate_latest(registry).decode()
+    plane.enable_telemetry(window_s=0.05, sample_period=4)
+    w1 = daemon._add_wire(pb.WireDef(local_pod_name="ma",
+                                     kube_ns="default", link_uid=1,
+                                     intf_name_in_pod="eth1"))
+    daemon._add_wire(pb.WireDef(local_pod_name="mb", kube_ns="default",
+                                link_uid=1, intf_name_in_pod="eth1"))
+    w1.ingress.extend([b"\x01" * 60] * 50)
+    t = 0.0
+    for _ in range(30):
+        plane.tick(now_s=t)
+        t += 0.01
+    text = generate_latest(registry).decode()
+    assert "kubedtn_link_delivered" in text
+    assert "kubedtn_link_dropped_loss" in text
+    assert "kubedtn_link_dropped_queue" in text
+    assert "kubedtn_link_p99_us" in text
+    assert "kubedtn_link_window_seconds" in text
+    assert "kubedtn_link_series_truncated 0.0" in text
+    assert 'pod="ma"' in text
+    line = [l for l in text.splitlines()
+            if l.startswith("kubedtn_link_delivered{")][0]
+    assert float(line.rsplit(" ", 1)[1]) == 50.0

@@ -67,13 +67,17 @@ def _tagged_frames(wire_i: int, n: int, size: int = 64):
 
 def _run_plane(depth: int, props, n_per_wire: int, pairs: int = 2,
                ticks: int = 40, dt: float = 0.002, seq_slots: int = 64,
-               feed_every: int | None = None):
+               feed_every: int | None = None, telemetry: bool = False):
     """Drive one freshly-built plane through an identical deterministic
     schedule; returns the per-wire delivered frame sequences."""
     daemon, _engine, win, wout = _daemon_with_pairs(pairs, props)
     plane = WireDataPlane(daemon, dt_us=dt * 1e6, pipeline_depth=depth)
     plane.pipeline_explicit_clock = True
     plane.seq_slots = seq_slots
+    if telemetry:
+        # window ring + flight recorder ON: the telemetry reductions
+        # ride the fused dispatch and must not perturb delivery
+        plane.enable_telemetry(window_s=0.01, sample_period=4)
     t = 100.0
     for k, wa in enumerate(win):
         wa.ingress.extend(_tagged_frames(k, n_per_wire))
@@ -117,6 +121,34 @@ def test_depth2_delivery_order_matches_depth1(props, n, kwargs):
         assert w1 == w2  # byte-identical, in order
     # the workload actually delivered something (guards a vacuous pass)
     assert sum(len(w) for w in got1) > 0
+
+
+@pytest.mark.parametrize("props,n,kwargs", [
+    (INDEP, 200, {}),
+    (TBF_OVERLOAD, 300, {}),
+    (SEQ, 150, dict(seq_slots=16)),
+], ids=["indep", "tbf-fallback", "seq-holdback"])
+def test_depth2_matches_depth1_with_telemetry_on(props, n, kwargs):
+    """The link telemetry plane adds NO per-tick host sync and changes
+    NOTHING the plane computes: with the window ring + flight recorder
+    enabled, depth 1 and depth 2 still deliver byte-identical per-wire
+    sequences (incl. the TBF fallback re-shape, whose telemetry goes
+    through the host-side window patch)."""
+    got1, p1 = _run_plane(1, props, n, telemetry=True, **kwargs)
+    got2, p2 = _run_plane(2, props, n, telemetry=True, **kwargs)
+    assert p1.shaped == p2.shaped
+    assert p1.dropped == p2.dropped
+    for w1, w2 in zip(got1, got2):
+        assert w1 == w2  # byte-identical, in order
+    assert sum(len(w) for w in got1) > 0
+    # telemetry ON vs OFF delivers the same bytes too (has_tel is a
+    # separate jit variant; the shaping math is shared)
+    got_off, p_off = _run_plane(2, props, n, **kwargs)
+    assert p_off.shaped == p2.shaped
+    for w1, w2 in zip(got_off, got2):
+        assert w1 == w2
+    # both recorders saw the deterministic sampling schedule
+    assert p1.recorder.sampled == p2.recorder.sampled > 0
 
 
 def test_depth2_sustained_tbf_overload_matches_depth1():

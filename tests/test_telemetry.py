@@ -1,0 +1,450 @@
+"""Link telemetry plane: window-ring accounting, sampling determinism,
+flight-recorder lifecycle, and cross-node trace correlation.
+
+The contracts pinned here (ARCHITECTURE.md "Observability"):
+
+- the per-edge window ring's counts are EXACT: tx == frames offered,
+  delivered == plane.shaped, and the per-cause drop columns sum to
+  plane.dropped — including through the TBF 50ms-queue fallback
+  re-shape, whose stats arrive via the host-side window patch;
+- sampling is deterministic counter arithmetic: the i-th frame ever
+  drained onto row r is sampled iff (i + phase(r)) % period == 0, so
+  two recorders replay identically;
+- a sampled frame's lifecycle is complete: ingress → shaped →
+  delivered | dropped(cause) locally, plus staged-peer → peer-sent and
+  the remote daemon's received event over a real gRPC hop
+  (Packet.trace_id), reconstructable via merge_trace / Local.ObserveTrace;
+- the query surfaces (link_rows, Local.ObserveLinks) rank by rate and
+  serve bucket-ladder percentiles — the same percentile code the
+  what-if plane uses.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubedtn_tpu import telemetry as tele
+from kubedtn_tpu.api.types import Link, LinkProperties, Topology, \
+    TopologySpec
+from kubedtn_tpu.runtime import WireDataPlane
+from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+
+
+def _daemon_with_pairs(pairs, props, prefix="t"):
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=4 * pairs + 8)
+    for i in range(pairs):
+        a, b = f"{prefix}a{i}", f"{prefix}b{i}"
+        store.create(Topology(name=a, spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=b,
+                 uid=i + 1, properties=props)])))
+        store.create(Topology(name=b, spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=a,
+                 uid=i + 1, properties=props)])))
+        engine.setup_pod(a)
+        engine.setup_pod(b)
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    win, wout = [], []
+    for i in range(pairs):
+        win.append(daemon._add_wire(pb.WireDef(
+            local_pod_name=f"{prefix}a{i}", kube_ns="default",
+            link_uid=i + 1, intf_name_in_pod="eth1")))
+        wout.append(daemon._add_wire(pb.WireDef(
+            local_pod_name=f"{prefix}b{i}", kube_ns="default",
+            link_uid=i + 1, intf_name_in_pod="eth1")))
+    return daemon, engine, win, wout
+
+
+def _run(plane, win, frames_per_wire, ticks=40, dt=0.002, start=100.0):
+    for k, w in enumerate(win):
+        w.ingress.extend(
+            [bytes([k]) + i.to_bytes(4, "big") + b"\x00" * 59
+             for i in range(frames_per_wire)])
+    t = start
+    for _ in range(ticks):
+        t += dt
+        plane.tick(now_s=t)
+    plane.flush()
+    plane.tick(now_s=t + 10.0)
+    assert plane.tick_errors == 0
+    return t + 10.0
+
+
+# -- window ring accounting --------------------------------------------
+
+def test_window_ring_exact_accounting_lossy():
+    daemon, engine, win, wout = _daemon_with_pairs(
+        2, LinkProperties(latency="3ms", jitter="1ms", loss="10"))
+    plane = WireDataPlane(daemon, dt_us=2000.0)
+    tel, rec = plane.enable_telemetry(window_s=0.01, sample_period=8)
+    _run(plane, win, 300)
+    total, secs = tel.window_sum()
+    assert secs > 0
+    assert tel.windows_closed > 0
+    assert total[:, tele.T_TX].sum() == 600
+    assert total[:, tele.T_DELIVERED].sum() == plane.shaped
+    assert (total[:, tele.T_DROP_LOSS].sum()
+            + total[:, tele.T_DROP_QUEUE].sum()) == plane.dropped
+    assert total[:, tele.T_DROP_QUEUE].sum() == 0  # no TBF here
+    # bucket counts partition the delivered population exactly
+    assert total[:, tele.T_HIST0:].sum() == plane.shaped
+    # delivered frames reached the far wires
+    assert sum(len(w.egress) for w in wout) == plane.shaped
+
+
+def test_window_ring_tbf_fallback_patch_exact():
+    """TBF overload trips the max-plus kernel's exact-scan fallback;
+    the fallback rows' telemetry arrives via the host-side window
+    patch and the per-cause totals must STILL sum exactly."""
+    daemon, engine, win, wout = _daemon_with_pairs(
+        1, LinkProperties(rate="512Kbit"))
+    plane = WireDataPlane(daemon, dt_us=2000.0, pipeline_depth=2)
+    plane.pipeline_explicit_clock = True
+    tel, rec = plane.enable_telemetry(window_s=0.01, sample_period=4)
+    # 300 64-byte frames ≈ 300ms of service at 512Kbit vs the 50ms
+    # queue cap: most of the batch must drop dropped_queue
+    _run(plane, win, 300, ticks=30)
+    total, _secs = tel.window_sum()
+    assert total[:, tele.T_TX].sum() == 300
+    assert plane.dropped > 0
+    assert total[:, tele.T_DROP_QUEUE].sum() > 0
+    assert total[:, tele.T_DELIVERED].sum() == plane.shaped
+    assert (total[:, tele.T_DROP_LOSS].sum()
+            + total[:, tele.T_DROP_QUEUE].sum()) == plane.dropped
+    assert total[:, tele.T_HIST0:].sum() == plane.shaped
+    # the recorder attributed sampled drops to the queue cause
+    causes = [e[4].get("cause") for e in list(rec.events)
+              if e[3] == tele.ST_DROPPED]
+    assert causes and all(c == "dropped_queue" for c in causes)
+
+
+def test_window_ring_bounded_and_idle_rollover():
+    daemon, engine, win, wout = _daemon_with_pairs(
+        1, LinkProperties(latency="1ms"))
+    plane = WireDataPlane(daemon, dt_us=2000.0)
+    tel, _rec = plane.enable_telemetry(window_s=0.004, windows=3)
+    t = _run(plane, win, 50, ticks=10)
+    # idle ticks keep closing windows (touch())
+    for _ in range(40):
+        t += 0.002
+        plane.tick(now_s=t)
+    assert tel.windows_closed > 3
+    assert len(tel._ring) == 3  # bounded ring
+    # restricting the query window restricts coverage
+    _tot_all, secs_all = tel.window_sum()
+    _tot_1, secs_1 = tel.window_sum(last=1, include_open=False)
+    assert 0 < secs_1 < secs_all
+
+
+# -- sampling determinism ----------------------------------------------
+
+def test_sampling_contract_deterministic_and_periodic():
+    a = tele.FlightRecorder(node="n1", sample_period=16)
+    b = tele.FlightRecorder(node="n1", sample_period=16)
+    seq = [(3, 10), (3, 25), (7, 40), (3, 7), (7, 1)]
+    got_a = [a.sample_batch(r, m) for r, m in seq]
+    got_b = [b.sample_batch(r, m) for r, m in seq]
+    assert got_a == got_b  # replays exactly
+    # exactly every 16th frame of row 3 is sampled, at the row's phase
+    offs = []
+    base = 0
+    for (r, m), sm in zip(seq, got_a):
+        if r == 3:
+            offs.extend(base + o for o, _t in sm)
+            base += m
+    phase = (3 * 2654435761) % 16
+    expect = [i for i in range(base) if (i + phase) % 16 == 0]
+    assert offs == expect
+    # trace ids are stable, nonzero, and distinct per (row, seq)
+    tids = [t for sm in got_a for _o, t in sm]
+    assert len(set(tids)) == len(tids)
+    assert all(t for t in tids)
+    # a different node samples the SAME offsets but mints DIFFERENT ids
+    # (cross-node uniqueness of the correlation key)
+    c = tele.FlightRecorder(node="n2", sample_period=16)
+    c.sample_batch(3, 10)
+    got_c = c.sample_batch(3, 25)
+    assert [o for o, _t in got_c] == [o for o, _t in got_a[1]]
+    assert [t for _o, t in got_c] != [t for _o, t in got_a[1]]
+
+
+def test_recorder_lifecycle_local_delivery():
+    daemon, engine, win, wout = _daemon_with_pairs(
+        1, LinkProperties(latency="2ms"))
+    plane = WireDataPlane(daemon, dt_us=2000.0)
+    tel, rec = plane.enable_telemetry(window_s=1.0, sample_period=4)
+    _run(plane, win, 64, ticks=20)
+    assert rec.sampled == 16
+    by_tid = {}
+    for tid, _t, _n, stage, _d in list(rec.events):
+        by_tid.setdefault(tid, []).append(stage)
+    assert len(by_tid) == 16
+    for stages in by_tid.values():
+        assert stages[0] == tele.ST_INGRESS
+        assert tele.ST_SHAPED in stages
+        assert stages[-1] == tele.ST_DELIVERED
+    # merge_trace renders a coherent single-node path
+    tid = next(iter(by_tid))
+    path = tele.merge_trace(tid, rec)
+    assert [e["stage"] for e in path][0] == tele.ST_INGRESS
+    assert tele.render_trace(path).startswith("trace ")
+
+
+# -- cross-node correlation over real gRPC -----------------------------
+
+def _two_daemons(props, pairs=1):
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon, make_server
+
+    nodes = []
+    for _ in range(2):
+        store = TopologyStore()
+        engine = SimEngine(store, capacity=4 * pairs + 8)
+        daemon = Daemon(engine)
+        server, port = make_server(daemon, port=0, host="127.0.0.1",
+                                   log_rpcs=False)
+        server.start()
+        addr = f"127.0.0.1:{port}"
+        engine.node_ip = addr
+        nodes.append((store, engine, daemon, server, addr))
+    (store_a, engine_a, daemon_a, server_a, addr_a) = nodes[0]
+    (store_b, engine_b, daemon_b, server_b, addr_b) = nodes[1]
+    for store in (store_a, store_b):
+        for i in range(pairs):
+            ta = Topology(name=f"xa{i}", spec=TopologySpec(links=[
+                Link(local_intf="eth1", peer_intf="eth1",
+                     peer_pod=f"xb{i}", uid=i + 1, properties=props)]))
+            tb = Topology(name=f"xb{i}", spec=TopologySpec(links=[
+                Link(local_intf="eth1", peer_intf="eth1",
+                     peer_pod=f"xa{i}", uid=i + 1, properties=props)]))
+            ta.status.src_ip, ta.status.net_ns = addr_a, "/ns/a"
+            tb.status.src_ip, tb.status.net_ns = addr_b, "/ns/b"
+            store.create(ta)
+            store.create(tb)
+    for i in range(pairs):
+        t = store_a.get("default", f"xa{i}")
+        assert engine_a.add_links(t, t.spec.links)
+    wires_in, wires_out = [], []
+    for i in range(pairs):
+        wb = daemon_b._add_wire(pb.WireDef(
+            local_pod_name=f"xb{i}", kube_ns="default", link_uid=i + 1,
+            intf_name_in_pod="eth1", peer_ip=addr_a))
+        wa = daemon_a._add_wire(pb.WireDef(
+            local_pod_name=f"xa{i}", kube_ns="default", link_uid=i + 1,
+            intf_name_in_pod="eth1", peer_ip=addr_b,
+            peer_intf_id=wb.wire_id))
+        wires_in.append(wa)
+        wires_out.append(wb)
+    return nodes, wires_in, wires_out
+
+
+def test_cross_node_trace_and_observe_rpcs():
+    nodes, wires_in, wires_out = _two_daemons(
+        LinkProperties(latency="1ms"))
+    (_sa, _ea, daemon_a, server_a, addr_a) = nodes[0]
+    (_sb, _eb, daemon_b, server_b, addr_b) = nodes[1]
+    plane = WireDataPlane(daemon_a, dt_us=2000.0)
+    _tel, rec_a = plane.enable_telemetry(window_s=0.5, sample_period=4,
+                                         node=addr_a)
+    rec_b = tele.FlightRecorder(node=addr_b)
+    daemon_b.recorder = rec_b
+    plane.start()
+    try:
+        frame = b"\x02" * 12 + b"\x07\x77" + b"\x00" * 50
+        for w in wires_in:
+            w.ingress.extend([frame] * 64)
+        deadline = time.monotonic() + 60.0
+        while (sum(len(w.egress) for w in wires_out) < 64
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert sum(len(w.egress) for w in wires_out) == 64
+        # the sampled frames crossed with their trace ids: B recorded
+        # `received` for ids A staged/sent
+        deadline = time.monotonic() + 10.0
+        while not rec_b.events and time.monotonic() < deadline:
+            time.sleep(0.02)
+        a_sent = {e[0] for e in list(rec_a.events)
+                  if e[3] == tele.ST_SENT}
+        b_recv = {e[0] for e in list(rec_b.events)
+                  if e[3] == tele.ST_RECEIVED}
+        assert a_sent and b_recv
+        assert a_sent & b_recv
+        tid = next(iter(a_sent & b_recv))
+        path = tele.merge_trace(tid, rec_a, rec_b)
+        stages = [e["stage"] for e in path]
+        assert tele.ST_INGRESS in stages
+        assert tele.ST_STAGED in stages
+        assert {e["node"] for e in path} == {addr_a, addr_b}
+
+        # -- the wire query surface over real gRPC ---------------------
+        from kubedtn_tpu.wire import proto as pb
+        from kubedtn_tpu.wire.client import DaemonClient
+
+        client_a = DaemonClient(addr_a)
+        client_b = DaemonClient(addr_b)
+        try:
+            links = client_a.ObserveLinks(
+                pb.ObserveLinksRequest(top_n=10), timeout=10.0)
+            assert links.ok, links.error
+            assert len(links.links) >= 1
+            assert links.links[0].delivered > 0
+            tr_a = client_a.ObserveTrace(
+                pb.ObserveTraceRequest(trace_id=tid), timeout=10.0)
+            tr_b = client_b.ObserveTrace(
+                pb.ObserveTraceRequest(trace_id=tid), timeout=10.0)
+            assert tr_a.ok and tr_b.ok
+            merged = sorted(
+                [{"trace_id": int(e.trace_id), "t": e.t,
+                  "node": e.node, "stage": e.stage,
+                  "detail": e.detail}
+                 for e in list(tr_a.events) + list(tr_b.events)],
+                key=lambda e: e["t"])
+            assert [e["stage"] for e in merged][0] == tele.ST_INGRESS
+            assert {e["node"] for e in merged} == {addr_a, addr_b}
+            # a daemon WITHOUT telemetry answers ok=False, not an error
+            resp = client_b.ObserveLinks(pb.ObserveLinksRequest(),
+                                         timeout=10.0)
+            assert not resp.ok and "not enabled" in resp.error
+        finally:
+            client_a.close()
+            client_b.close()
+
+        # -- the CLI verbs, end to end ---------------------------------
+        from kubedtn_tpu import cli
+
+        assert cli.main(["top", "--daemon", addr_a, "--json"]) == 0
+        assert cli.main(["top", "--daemon", addr_a, "-n", "5"]) == 0
+        assert cli.main(["trace", "latest", "--daemon", addr_a,
+                         "--daemon", addr_b]) == 0
+        assert cli.main(["trace", f"{tid:#x}", "--daemon", addr_a,
+                         "--daemon", addr_b, "--json"]) == 0
+        # a bogus id is a clean one-line error, not a traceback
+        assert cli.main(["trace", "not-a-tid",
+                         "--daemon", addr_a]) == 1
+    finally:
+        plane.stop()
+        server_a.stop(0)
+        server_b.stop(0)
+
+
+# -- query surface details ---------------------------------------------
+
+def test_link_rows_ranked_and_percentiles():
+    daemon, engine, win, wout = _daemon_with_pairs(
+        2, LinkProperties(latency="3ms"))
+    plane = WireDataPlane(daemon, dt_us=2000.0)
+    tel, _rec = plane.enable_telemetry(window_s=10.0)
+    # wire 0 carries 3x the traffic of wire 1
+    win[0].ingress.extend([b"\x00" * 60] * 150)
+    win[1].ingress.extend([b"\x00" * 60] * 50)
+    t = 100.0
+    for _ in range(30):
+        t += 0.002
+        plane.tick(now_s=t)
+    plane.flush()
+    plane.tick(now_s=t + 10.0)
+    rows, secs, trunc = tel.link_rows(engine)
+    assert trunc == 0
+    assert len(rows) == 2
+    assert rows[0]["delivered"] == 150  # busiest first
+    assert rows[1]["delivered"] == 50
+    # 3ms fixed latency → p50 and p99 in the (1ms, 5ms] bucket
+    assert 1000.0 < rows[0]["p50_us"] <= 5000.0
+    assert 1000.0 < rows[0]["p99_us"] <= 5000.0
+    assert rows[0]["mean_lat_us"] == pytest.approx(3000.0, rel=0.1)
+
+
+def test_percentiles_shared_with_twin():
+    """ONE histogram_quantile implementation: the what-if plane's sweep
+    percentiles and the link telemetry surface are the same function."""
+    from kubedtn_tpu.twin import engine as twin_engine
+
+    assert twin_engine._percentiles is tele.percentiles_from_hist
+    assert twin_engine.BUCKET_EDGES_US == tele.BUCKET_EDGES_US
+    assert twin_engine.N_BINS == tele.N_BINS
+    hist = np.zeros(tele.N_BINS)
+    hist[1] = 100.0  # all mass in (1ms, 5ms]
+    p = tele.percentiles_from_hist(hist)
+    assert 1000.0 < p["p50_us"] <= 5000.0
+    assert tele.percentiles_from_hist(np.zeros(tele.N_BINS))["p99_us"] \
+        is None
+
+
+def test_determinism_depth_parity_with_telemetry_ring():
+    """The ring's totals are identical at depth 1 vs depth 2 — the
+    device reductions ride the chained dispatches without changing
+    them (the delivery-order parity lives in
+    test_pipeline_determinism; this pins the telemetry outputs)."""
+    totals = {}
+    for depth in (1, 2):
+        daemon, engine, win, wout = _daemon_with_pairs(
+            2, LinkProperties(latency="2ms", loss="20"),
+            prefix=f"d{depth}")
+        plane = WireDataPlane(daemon, dt_us=2000.0,
+                              pipeline_depth=depth)
+        plane.pipeline_explicit_clock = True
+        tel, _rec = plane.enable_telemetry(window_s=10.0,
+                                           sample_period=8)
+        _run(plane, win, 200)
+        total, _secs = tel.window_sum()
+        totals[depth] = total
+    assert np.array_equal(totals[1], totals[2])
+
+
+def test_dispatch_fault_rolls_sampling_back():
+    """A failed dispatch requeues undecided frames to the ingress
+    front; the recorder's per-row counters roll back so the retry
+    replays the SAME sampling schedule and trace ids (the determinism
+    contract holds across tick faults), and nothing is lost. The fault
+    is injected at the DECIDE stage — after sampling, before the
+    exactly-once decide verdict — the exact window the rollback
+    exists for (a pre-sampling chaos fault never advances counters)."""
+    daemon, engine, win, wout = _daemon_with_pairs(
+        1, LinkProperties(latency="1ms"), prefix="df")
+    plane = WireDataPlane(daemon, dt_us=2000.0)
+    tel, rec = plane.enable_telemetry(window_s=10.0, sample_period=4)
+    if plane._flowtable is None:
+        pytest.skip("native flow table unavailable")
+    orig = plane._flowtable.decide_classify_ptrs
+    fails = [2]
+
+    def flaky(*a, **kw):
+        if fails[0] > 0:
+            fails[0] -= 1
+            raise RuntimeError("injected decide fault")
+        return orig(*a, **kw)
+
+    plane._flowtable.decide_classify_ptrs = flaky
+    win[0].ingress.extend([b"\x00" * 60] * 64)
+    t = 100.0
+    for _ in range(10):
+        t += 0.002
+        try:
+            plane.tick(now_s=t)
+        except Exception:
+            pass  # the runner would survive; explicit ticks surface it
+    plane.flush()
+    plane.tick(now_s=t + 10.0)
+    # every frame still delivered exactly once after the faults
+    assert sum(len(w.egress) for w in wout) == 64
+    # sampling replayed, not double-counted: 64 frames / period 4
+    assert rec.sampled == 16
+    ingress_tids = [e[0] for e in list(rec.events)
+                    if e[3] == tele.ST_INGRESS]
+    assert len(set(ingress_tids)) == 16  # same ids re-recorded, no new
+    delivered_tids = {e[0] for e in list(rec.events)
+                      if e[3] == tele.ST_DELIVERED}
+    assert delivered_tids == set(ingress_tids)
+    # the retry is visible as a requeued marker between the attempts
+    requeued = [e for e in list(rec.events)
+                if e[3] == tele.ST_REQUEUED
+                and e[4].get("reason") == "dispatch-fault-retry"]
+    assert requeued
+    total, _secs = tel.window_sum()
+    assert total[:, tele.T_TX].sum() == 64
+    assert total[:, tele.T_DELIVERED].sum() == 64

@@ -1,0 +1,36 @@
+"""Tier-1 smoke for the `telemetry_overhead` bench phase: the probe
+runs end to end and the measured cost of the link telemetry plane
+(window ring + flight recorder at the default 1/256 sampling) stays
+under the 5% acceptance bar on the plane-only probe.
+
+The probe interleaves off/on rounds and reports the MEDIAN paired
+overhead (host drift cancels pair-by-pair; the probe re-measures once
+when a stall inflates the median past the bar while the best pair sits
+under it — the same rule as bench's _soak_stall_retry). On a shared
+1-core CI host the noise floor is still a few percent, so this smoke
+retries the whole probe up to three times and asserts the BEST trial —
+a pass proves the telemetry cost itself is under the bar; repeated
+failures would mean the cost is real.
+"""
+
+from kubedtn_tpu.scenarios import telemetry_overhead
+
+
+def test_telemetry_overhead_under_5pct():
+    last = None
+    for _trial in range(3):
+        r = telemetry_overhead(pairs=2, frames_per_wire=6_000,
+                               rounds=3)
+        last = r
+        # the phase's own integrity: both planes ran clean and the
+        # telemetry side actually recorded
+        assert r["tick_errors_off"] == 0
+        assert r["tick_errors_on"] == 0
+        assert r["sampled_frames"] > 0
+        assert r["recorder_events"] > 0
+        assert r["telemetry_link_rows"] == 2
+        assert r["frames_per_s_off"] > 0
+        assert r["frames_per_s_on"] > 0
+        if r["meets_5pct_target"]:
+            break
+    assert last["meets_5pct_target"], last
