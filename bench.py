@@ -523,7 +523,8 @@ def main() -> None:
         }
 
     def _isolated_scenario(func: str, kwargs: dict,
-                           timeout_s: float = 900.0) -> dict:
+                           timeout_s: float = 900.0,
+                           env_extra: dict | None = None) -> dict:
         """Run one live-plane scenario in a FRESH subprocess. The live
         phases measure a steady-state plane, but by the time they run,
         this process carries every earlier phase's jit caches, device
@@ -546,6 +547,8 @@ def main() -> None:
                    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="1.0")
         if degraded:
             env["JAX_PLATFORMS"] = "cpu"
+        if env_extra:
+            env.update(env_extra)
         p = subprocess.run(
             [sys.executable, "-c", src, func, json.dumps(kwargs)],
             capture_output=True, text=True, timeout=timeout_s, env=env)
@@ -563,7 +566,9 @@ def main() -> None:
         extras["live_plane"] = {
             k: r[k] for k in ("pairs", "frames_per_wire", "frames_per_s",
                               "frames_per_s_best", "rounds_frames_per_s",
-                              "warmup_rounds", "dropped", "tick_errors")
+                              "warmup_rounds", "dropped", "tick_errors",
+                              "mesh_shape", "shard_imbalance")
+            if k in r
         }
 
     SOAK_KEYS = ("shaping", "injector_chunk", "settle_s", "seconds",
@@ -571,7 +576,8 @@ def main() -> None:
                  "flatness", "windows_frames_per_s",
                  "end_ingress_backlog", "gc_pause_s", "host_steal_s",
                  "stage_breakdown", "dropped", "tick_errors",
-                 "stalled_first_attempt")
+                 "stalled_first_attempt", "mesh_shape",
+                 "shard_imbalance")
 
     def _soak_stall_retry(run):
         """One re-measure when a SINGLE window collapsed ≥25% below the
@@ -615,6 +621,46 @@ def main() -> None:
             {"pairs": 8, "rate": "2Gbit",
              "seconds": 12.0 if degraded else 25.0, "chunk": 512}))
         extras["live_soak_tbf"] = {k: r[k] for k in SOAK_KEYS if k in r}
+
+    def run_sharded_soak():
+        # MULTICHIP record: the edge-sharded live plane vs the same
+        # plane on one device (no-regression headline), plus mesh
+        # shape, per-shard imbalance, cross-shard frames/tick and the
+        # mailbox/exchange counters. On a TPU backend the mesh is the
+        # real chips and the exchange is the Pallas remote-DMA ring;
+        # on a CPU host the subprocess forces 8 virtual devices so the
+        # mailbox layout/accounting are exercised end to end with the
+        # ppermute ring (same bits, no bandwidth claim).
+        env_extra: dict = {}
+        if extras.get("backend") != "tpu":
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "--xla_force_host_platform_device_count" not in flags:
+                env_extra["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+            env_extra["JAX_PLATFORMS"] = "cpu"
+        r = _isolated_scenario(
+            "sharded_soak",
+            {"pairs": 24 if degraded else 48,
+             "frames_per_wire": 2_000 if degraded else 6_000},
+            timeout_s=1800.0, env_extra=env_extra)
+        extras["sharded_soak"] = {
+            k: r[k] for k in (
+                "record", "backend", "remote_dma", "pairs", "devices",
+                "mesh_shape", "edges_per_shard", "shard_imbalance",
+                "colocated_frac", "xshard_frames_total",
+                "xshard_frames_per_tick", "mailbox_hwm",
+                "exchange_seconds", "single_device_frames_per_s",
+                "sharded_frames_per_s", "sharded_over_single",
+                "dropped", "tick_errors") if k in r}
+        # standalone MULTICHIP record beside the driver's dryrun ones
+        try:
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "MULTICHIP_sharded_soak.json"), "w") as f:
+                json.dump(r, f, indent=1)
+        except OSError as e:
+            log(f"MULTICHIP record write failed: {e!r}")
 
     def run_chaos_soak():
         # fault-domain evidence: peer flapping at 1 Hz under live load
@@ -740,6 +786,7 @@ def main() -> None:
     phase("live_plane", run_live_plane)
     phase("live_soak", run_live_soak)
     phase("live_soak_tbf", run_live_soak_tbf)
+    phase("sharded_soak", run_sharded_soak)
     phase("chaos_soak", run_chaos_soak)
     phase("telemetry_overhead", run_telemetry_overhead)
     phase("whatif_sweep", run_whatif_sweep)

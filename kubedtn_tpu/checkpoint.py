@@ -256,6 +256,11 @@ def _load_npz(dirpath: str, manifest: dict, fname: str):
 # -- checkpoint save/load ---------------------------------------------
 
 def _arrays_to_npz(path: str, obj) -> None:
+    # np.asarray GATHERS to host first: a sharded live plane's
+    # edge-state columns (NamedSharding over the edge mesh) serialize
+    # as plain host arrays, so a checkpoint written under an N-way mesh
+    # restores on any device count — and vice versa
+    # (tests/test_sharded_plane.py round-trips 8-way ↔ 1-way bit-exact)
     fields = {f.name: np.asarray(getattr(obj, f.name))
               for f in dataclasses.fields(obj)}
     np.savez_compressed(path, **fields)
@@ -471,17 +476,18 @@ def _load_traced(path: str) -> tuple[TopologyStore, SimEngine]:
 
 
 def load_or_rebuild(path: str, store: TopologyStore | None = None,
-                    capacity: int = 1024, node_ip: str = "10.0.0.1"
-                    ) -> tuple[TopologyStore, SimEngine, str]:
+                    capacity: int = 1024, node_ip: str = "10.0.0.1",
+                    mesh=None) -> tuple[TopologyStore, SimEngine, str]:
     """`load` with the documented corruption fallback: on any
     CheckpointError, reconstruct via `rebuild_engine` from `store` (the
     CR source of truth — the reference's restart rescan) instead of
     raising mid-restore. Returns (store, engine, source) with source in
     {"checkpoint", "rebuild"}; re-raises only when no fallback store was
-    provided."""
+    provided. `mesh` re-shards the restored edge state onto the CURRENT
+    device mesh (checkpoints are device-count-agnostic host arrays —
+    `_arrays_to_npz` gathered them at save time)."""
     try:
-        s, e = load(path)
-        return s, e, "checkpoint"
+        s, e, src = *load(path), "checkpoint"
     except CheckpointError as err:
         if store is None:
             raise
@@ -490,8 +496,20 @@ def load_or_rebuild(path: str, store: TopologyStore | None = None,
         get_logger("checkpoint").warning(
             "checkpoint unusable; rebuilding from store %s",
             fields(path=path, error=f"{type(err).__name__}: {err}"))
-        return store, rebuild_engine(store, capacity=capacity,
-                                     node_ip=node_ip), "rebuild"
+        s, e, src = store, rebuild_engine(store, capacity=capacity,
+                                          node_ip=node_ip), "rebuild"
+    if mesh is not None:
+        from kubedtn_tpu.parallel.mesh import shard_edge_state
+
+        S = int(mesh.devices.size)
+        with e._lock:
+            e._flush_device_locked()
+            st = e._state
+            if st.capacity % S:
+                st = es.grow_state(st, -(-st.capacity // S) * S)
+            e._state = shard_edge_state(st, mesh)
+            e.shard_count = S
+    return s, e, src
 
 
 def save_pending(path: str, dataplane) -> int:

@@ -385,6 +385,16 @@ def cmd_daemon(args) -> int:
         log.info("link telemetry on %s", fields(
             window_s=getattr(args, "telemetry_window", 1.0),
             sample_period=getattr(args, "telemetry_sample", 256)))
+    shard = getattr(args, "shard_mesh", 0)
+    if shard:
+        # edge-sharded live plane: SoA columns block-shard across the
+        # device mesh, cross-shard row state rides the mailbox ring
+        # (ARCHITECTURE.md "Sharded live plane"); -1 = largest
+        # power-of-two count of local devices, 0 = off (guard above)
+        mesh = dataplane.enable_sharding(
+            n_devices=None if shard < 0 else shard)
+        log.info("sharded live plane %s", fields(
+            mesh_devices=int(mesh.devices.size)))
     trace_out = getattr(args, "trace_out", None)
     jax_profile = getattr(args, "jax_profile", None)
     if jax_profile:
@@ -990,6 +1000,11 @@ def main(argv=None) -> int:
     dp.add_argument("--telemetry-sample", type=int, default=256,
                     metavar="N", help="flight-recorder sampling period: "
                                       "1 frame in N (default 256)")
+    dp.add_argument("--shard-mesh", type=int, default=0,
+                    metavar="N",
+                    help="shard the live plane's edge state across N "
+                         "devices (-1 = all local devices; 0 = off; "
+                         "power of two)")
     dp.add_argument("--trace-out", default=None, metavar="JSON",
                     help="dump catapult/Perfetto trace JSON (spans "
                          "around reconcile / checkpoint / what-if "

@@ -284,6 +284,51 @@ class DataPlaneStatsCollector:
                 buffered_g.add_metric(lab, float(s["buffered"]))
             out.extend([state_g, opens_c, cycles_c, retries_c,
                         buffered_g])
+        # sharded-plane series (kubedtn_plane_shard_*): emitted only
+        # while the edge-state SoA is sharded across a mesh — mesh
+        # size, per-shard edge counts, cross-shard frame volume, the
+        # bounded mailbox's high-water mark, and the sampled
+        # exchange-kernel seconds (extends the stage_seconds pattern
+        # for work that rides INSIDE the one fused dispatch)
+        shard = plane.shard_summary()
+        if shard.get("enabled"):
+            n_g = GaugeMetricFamily(
+                "kubedtn_plane_shard_count",
+                "Devices in the live plane's edge mesh")
+            n_g.add_metric([], float(shard.get("n_shards", 1)))
+            out.append(n_g)
+            edges_g = GaugeMetricFamily(
+                "kubedtn_plane_shard_edges",
+                "Active edge rows owned by each shard of the edge "
+                "mesh", labels=["shard"])
+            for i, n in enumerate(shard.get("edges_per_shard") or []):
+                edges_g.add_metric([str(i)], float(n))
+            out.append(edges_g)
+            imb_g = GaugeMetricFamily(
+                "kubedtn_plane_shard_imbalance",
+                "Per-shard edge-count imbalance (max/mean - 1)")
+            imb_g.add_metric([], float(shard.get("imbalance", 0.0)))
+            out.append(imb_g)
+            x_c = CounterMetricFamily(
+                "kubedtn_plane_shard_xshard_frames",
+                "Frames whose next hop's edge row lives on a "
+                "different shard (moved via the mailbox exchange)")
+            x_c.add_metric([], float(shard.get("xshard_frames", 0)))
+            out.append(x_c)
+            hwm_g = GaugeMetricFamily(
+                "kubedtn_plane_shard_mailbox_high_water",
+                "Most mailbox rows any tick's ring exchange carried")
+            hwm_g.add_metric([], float(shard.get("mailbox_hwm", 0)))
+            out.append(hwm_g)
+            ex_c = CounterMetricFamily(
+                "kubedtn_plane_shard_exchange_seconds",
+                "Sampled standalone re-executions of the tick's "
+                "mailbox exchange, cumulative seconds (1/64 dispatch "
+                "sampling — the ring itself rides inside the fused "
+                "dispatch)")
+            ex_c.add_metric([], float(shard.get("exchange_seconds",
+                                                0.0)))
+            out.append(ex_c)
         return out
 
 

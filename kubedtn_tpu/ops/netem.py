@@ -419,6 +419,165 @@ def _iid_random_rows(props):
             & (props[:, es.P_REORDER_PROB] == 0))
 
 
+# -- row-level kernel cores --------------------------------------------
+#
+# Each shaping class is split into a ROW CORE that operates on
+# pre-gathered per-row state and the existing shape_slots_* wrapper that
+# gathers from the full EdgeState and scatters the write-back. The cores
+# draw their uniforms with the SAME key and the SAME (R, K[, NU]) shapes
+# as the historical fused kernels, so wrapper-vs-core composition is
+# byte-identical — which is what lets the SHARDED live plane (runtime
+# `_make_sharded_fused`) assemble the gathered rows via a cross-shard
+# mailbox exchange, run the identical core on every shard, and scatter
+# each shard's owned rows locally while staying bit-equal to the
+# unsharded plane.
+
+
+def shape_rows_indep(props_rows, active_rows, sizes, valid, key):
+    """Slot-independent class core over pre-gathered rows: returns
+    (ShapeResult[R, K], delta_count int32[R]) — the per-row pkt_count
+    increments the caller scatter-adds (the only state this class
+    advances). Gathered tokens/t_last/backlog/corr are NOT needed: the
+    class predicate guarantees they are never read."""
+    R, K = sizes.shape
+    u = jax.random.uniform(key, (R, K, NU), dtype=jnp.float32)
+    t_arr = jnp.zeros((R,), jnp.float32)
+    zeros = jnp.zeros((R,), jnp.float32)
+    zcorr = jnp.zeros((R, NCORR), jnp.float32)
+    zcnt = jnp.zeros((R,), jnp.int32)
+    over_slots = jax.vmap(
+        _shape_vmapped,
+        in_axes=(None, None, None, None, None, None, 1, None, 1),
+        out_axes=1)
+    res, _tk, _tl, _nf, _corr, _cnt = over_slots(
+        props_rows, zeros, zeros, zeros, zcorr, zcnt,
+        sizes, t_arr, u)
+    act = valid & active_rows[:, None]
+    inf = jnp.float32(jnp.inf)
+    res = ShapeResult(
+        depart_us=jnp.where(act, res.depart_us, inf),
+        delivered=res.delivered & act,
+        dropped_loss=res.dropped_loss & act,
+        dropped_queue=res.dropped_queue & act,
+        corrupted=res.corrupted & act,
+        duplicated=res.duplicated & act,
+        reordered=res.reordered & act,
+    )
+    delta = (act & ~res.dropped_loss).sum(axis=1).astype(jnp.int32)
+    return res, delta
+
+
+def shape_rows_seq(props_rows, active_rows, carry0, sizes, valid, key):
+    """Sequential (correlated / reorder / general-TBF) class core over
+    pre-gathered rows. `carry0` = (tokens[R], t_last[R], backlog[R],
+    corr[R, NCORR], pkt_count[R]). Returns (carry', ShapeResult[R, K])
+    — the caller scatters carry' back at the batch rows."""
+    R, K = sizes.shape
+    u_all = jax.random.uniform(key, (K, R, NU), dtype=jnp.float32)
+    t_arr = jnp.zeros((R,), jnp.float32)
+    active = active_rows
+
+    def body(carry, xs):
+        tk0, tl0, nf0, corr0, cnt0 = carry
+        sz, va, u = xs
+        res, tk, tl, nf, corr, cnt = _shape_vmapped(
+            props_rows, tk0, tl0, nf0, corr0, cnt0, sz, t_arr, u)
+        act = va & active
+        keep = lambda new, old: jnp.where(act, new, old)  # noqa: E731
+        carry = (keep(tk, tk0), keep(tl, tl0), keep(nf, nf0),
+                 jnp.where(act[:, None], corr, corr0),
+                 keep(cnt, cnt0))
+        inf = jnp.float32(jnp.inf)
+        res = ShapeResult(
+            depart_us=jnp.where(act, res.depart_us, inf),
+            delivered=res.delivered & act,
+            dropped_loss=res.dropped_loss & act,
+            dropped_queue=res.dropped_queue & act,
+            corrupted=res.corrupted & act,
+            duplicated=res.duplicated & act,
+            reordered=res.reordered & act)
+        return carry, res
+
+    xs = (jnp.moveaxis(sizes, 1, 0), jnp.moveaxis(valid, 1, 0), u_all)
+    carry, res = jax.lax.scan(body, carry0, xs)
+    res = jax.tree.map(lambda a: jnp.moveaxis(a, 0, 1), res)
+    return carry, res
+
+
+def shape_rows_tbf(props_rows, active_rows, corr_rows, cnt_rows,
+                   tokens_rows, t_last_rows, backlog_rows,
+                   sizes, valid, key):
+    """Exact max-plus TBF class core over pre-gathered rows (the full
+    derivation lives on shape_slots_tbf_nodonate). Returns
+    (res ShapeResult[R, K], tok_row f32[R], dep_row f32[R],
+    delta_count i32[R], has_accept bool[R], fallback bool[R])."""
+    R, K = sizes.shape
+    u = jnp.moveaxis(
+        jax.random.uniform(key, (K, R, NU), dtype=jnp.float32), 0, 1)
+    props = props_rows
+    active = active_rows
+    over_slots = jax.vmap(netem_packet, in_axes=(None, None, None, 0))
+    over_rows = jax.vmap(over_slots, in_axes=(0, 0, 0, 0))
+    (delay, loss, dup, corrupt, reorder, _corr, _cnt) = over_rows(
+        props, corr_rows, cnt_rows, u)
+    act = valid & active[:, None]
+    live = act & ~loss
+    t_ready = delay
+
+    rate = props[:, P_RATE_BPS]
+    r_us = (rate / 8e6)[:, None]
+    q = sizes / r_us
+    b = (burst_bytes(rate)[:, None] / r_us)
+    neg = jnp.float32(_MP_NEG)
+    qb = q - b
+    qb0 = jnp.maximum(qb, 0.0)
+    a11 = jnp.where(live, qb0, 0.0)
+    a12 = jnp.where(live, q, neg)
+    a21 = jnp.where(live, qb, neg)
+    a22 = jnp.where(live, q, 0.0)
+    c1 = jnp.where(live, t_ready + qb0, neg)
+    c2 = jnp.where(live, t_ready + qb, neg)
+
+    def combine(x, y):
+        xa11, xa12, xa21, xa22, xc1, xc2 = x
+        ya11, ya12, ya21, ya22, yc1, yc2 = y
+        return (
+            jnp.maximum(ya11 + xa11, ya12 + xa21),
+            jnp.maximum(ya11 + xa12, ya12 + xa22),
+            jnp.maximum(ya21 + xa11, ya22 + xa21),
+            jnp.maximum(ya21 + xa12, ya22 + xa22),
+            jnp.maximum(jnp.maximum(ya11 + xc1, ya12 + xc2), yc1),
+            jnp.maximum(jnp.maximum(ya21 + xc1, ya22 + xc2), yc2),
+        )
+
+    pa11, pa12, pa21, pa22, pc1, pc2 = jax.lax.associative_scan(
+        combine, (a11, a12, a21, a22, c1, c2), axis=1)
+    x1_0 = backlog_rows[:, None]
+    x2_0 = (t_last_rows - tokens_rows / (rate / 8e6))[:, None]
+    dep = jnp.maximum(jnp.maximum(pa11 + x1_0, pa12 + x2_0), pc1)
+    v = jnp.maximum(jnp.maximum(pa21 + x1_0, pa22 + x2_0), pc2)
+
+    drop_q = live & (dep - t_ready > TBF_QUEUE_LATENCY_US)
+    fallback = drop_q.any(axis=1)
+    delivered = live & ~drop_q
+    inf = jnp.float32(jnp.inf)
+    res = ShapeResult(
+        depart_us=jnp.where(delivered, dep, inf),
+        delivered=delivered,
+        dropped_loss=loss & act,
+        dropped_queue=drop_q,
+        corrupted=corrupt & delivered,
+        duplicated=dup & delivered,
+        reordered=reorder & delivered,
+    )
+    dep_row = dep[:, -1]
+    tok_row = jnp.clip((dep_row - v[:, -1]) * (rate / 8e6),
+                       0.0, burst_bytes(rate))
+    delta = live.sum(axis=1).astype(jnp.int32)
+    has_accept = live.any(axis=1)
+    return (res, tok_row, dep_row, delta, has_accept, fallback)
+
+
 _shape_slots_ind = None
 
 
@@ -443,33 +602,11 @@ def shape_slots_indep_nodonate(state: EdgeState, row_idx: jax.Array,
     global _shape_slots_ind
     if _shape_slots_ind is None:
         def _ind(state, row_idx, sizes, valid, key):
-            R, K = sizes.shape
-            u = jax.random.uniform(key, (R, K, NU), dtype=jnp.float32)
-            t_arr = jnp.zeros((R,), jnp.float32)
-            over_slots = jax.vmap(
-                _shape_vmapped,
-                in_axes=(None, None, None, None, None, None, 1, None, 1),
-                out_axes=1)
-            res, _tk, _tl, _nf, _corr, _cnt = over_slots(
-                state.props[row_idx], state.tokens[row_idx],
-                state.t_last[row_idx], state.backlog_until[row_idx],
-                state.corr[row_idx], state.pkt_count[row_idx],
-                sizes, t_arr, u)
-            act = valid & state.active[row_idx][:, None]
-            inf = jnp.float32(jnp.inf)
-            res = ShapeResult(
-                depart_us=jnp.where(act, res.depart_us, inf),
-                delivered=res.delivered & act,
-                dropped_loss=res.dropped_loss & act,
-                dropped_queue=res.dropped_queue & act,
-                corrupted=res.corrupted & act,
-                duplicated=res.duplicated & act,
-                reordered=res.reordered & act,
-            )
-            delta = (act & ~res.dropped_loss).sum(axis=1) \
-                .astype(state.pkt_count.dtype)
-            new_count = state.pkt_count.at[row_idx].add(delta,
-                                                          mode="drop")
+            res, delta = shape_rows_indep(
+                state.props[row_idx], state.active[row_idx],
+                sizes, valid, key)
+            new_count = state.pkt_count.at[row_idx].add(
+                delta.astype(state.pkt_count.dtype), mode="drop")
             return res, new_count
 
         _shape_slots_ind = jax.jit(_ind)
@@ -545,88 +682,20 @@ def shape_slots_tbf_nodonate(state: EdgeState, row_idx: jax.Array,
     global _shape_slots_tbf
     if _shape_slots_tbf is None:
         def _tbf(state, row_idx, sizes, valid, key):
-            R, K = sizes.shape
-            # drawn [K, R, NU] then transposed: the SAME stream
+            # the core draws [K, R, NU] then transposes: the SAME stream
             # shape_slots_nodonate draws for a given (key, R, K), which
             # is what the parity tests compare against. (The runtime's
             # fallback re-shape uses a different key and packing — the
             # detection run's netem outcomes are discarded, not reused.)
-            u = jnp.moveaxis(
-                jax.random.uniform(key, (K, R, NU), dtype=jnp.float32),
-                0, 1)
-            props = state.props[row_idx]
-            active = state.active[row_idx]
-            # netem stage, elementwise over [R, K]: every AR(1) rho is
-            # zero in this class, so corr state passes through and slots
-            # draw iid — same independence the indep kernel relies on
-            over_slots = jax.vmap(netem_packet,
-                                  in_axes=(None, None, None, 0))
-            over_rows = jax.vmap(over_slots, in_axes=(0, 0, 0, 0))
-            (delay, loss, dup, corrupt, reorder, _corr, _cnt) = over_rows(
-                props, state.corr[row_idx], state.pkt_count[row_idx], u)
-            act = valid & active[:, None]
-            live = act & ~loss           # slots that reach the bucket
-            t_ready = delay              # t_arrival == 0 (tick epoch)
-
-            rate = props[:, P_RATE_BPS]
-            r_us = (rate / 8e6)[:, None]             # bytes per µs
-            q = sizes / r_us                         # service time, µs
-            b = (burst_bytes(rate)[:, None] / r_us)  # burst credit, µs
-            neg = jnp.float32(_MP_NEG)
-            qb = q - b
-            qb0 = jnp.maximum(qb, 0.0)
-            a11 = jnp.where(live, qb0, 0.0)
-            a12 = jnp.where(live, q, neg)
-            a21 = jnp.where(live, qb, neg)
-            a22 = jnp.where(live, q, 0.0)
-            c1 = jnp.where(live, t_ready + qb0, neg)
-            c2 = jnp.where(live, t_ready + qb, neg)
-
-            def combine(x, y):
-                # y ∘ x (x applied first: scan runs slot 0 → K-1)
-                xa11, xa12, xa21, xa22, xc1, xc2 = x
-                ya11, ya12, ya21, ya22, yc1, yc2 = y
-                return (
-                    jnp.maximum(ya11 + xa11, ya12 + xa21),
-                    jnp.maximum(ya11 + xa12, ya12 + xa22),
-                    jnp.maximum(ya21 + xa11, ya22 + xa21),
-                    jnp.maximum(ya21 + xa12, ya22 + xa22),
-                    jnp.maximum(jnp.maximum(ya11 + xc1, ya12 + xc2),
-                                yc1),
-                    jnp.maximum(jnp.maximum(ya21 + xc1, ya22 + xc2),
-                                yc2),
-                )
-
-            pa11, pa12, pa21, pa22, pc1, pc2 = jax.lax.associative_scan(
-                combine, (a11, a12, a21, a22, c1, c2), axis=1)
-            x1_0 = state.backlog_until[row_idx][:, None]   # next_free
-            x2_0 = (state.t_last[row_idx]
-                    - state.tokens[row_idx]
-                    / (rate / 8e6))[:, None]               # V_0
-            dep = jnp.maximum(jnp.maximum(pa11 + x1_0, pa12 + x2_0),
-                              pc1)                         # [R, K]
-            v = jnp.maximum(jnp.maximum(pa21 + x1_0, pa22 + x2_0),
-                            pc2)
-
-            drop_q = live & (dep - t_ready > TBF_QUEUE_LATENCY_US)
-            fallback = drop_q.any(axis=1)
-            delivered = live & ~drop_q
-            inf = jnp.float32(jnp.inf)
-            res = ShapeResult(
-                depart_us=jnp.where(delivered, dep, inf),
-                delivered=delivered,
-                dropped_loss=loss & act,
-                dropped_queue=drop_q,
-                corrupted=corrupt & delivered,
-                duplicated=dup & delivered,
-                reordered=reorder & delivered,
-            )
-            dep_row = dep[:, -1]
-            tok_row = jnp.clip((dep_row - v[:, -1]) * (rate / 8e6),
-                               0.0, burst_bytes(rate))
-            delta = live.sum(axis=1).astype(state.pkt_count.dtype)
-            has_accept = live.any(axis=1)
-            return (res, tok_row, dep_row, delta, has_accept, fallback)
+            out = shape_rows_tbf(
+                state.props[row_idx], state.active[row_idx],
+                state.corr[row_idx], state.pkt_count[row_idx],
+                state.tokens[row_idx], state.t_last[row_idx],
+                state.backlog_until[row_idx], sizes, valid, key)
+            res, tok_row, dep_row, delta, has_accept, fallback = out
+            return (res, tok_row, dep_row,
+                    delta.astype(state.pkt_count.dtype), has_accept,
+                    fallback)
 
         _shape_slots_tbf = jax.jit(_tbf)
     return _shape_slots_tbf(state, row_idx, sizes, valid, key)
@@ -666,39 +735,12 @@ def shape_slots_nodonate(state: EdgeState, row_idx: jax.Array,
     global _shape_slots_nd
     if _shape_slots_nd is None:
         def _slots(state, row_idx, sizes, valid, key):
-            R, K = sizes.shape
-            u_all = jax.random.uniform(key, (K, R, NU), dtype=jnp.float32)
-            props = state.props[row_idx]
-            active = state.active[row_idx]
-            t_arr = jnp.zeros((R,), jnp.float32)
             carry0 = (state.tokens[row_idx], state.t_last[row_idx],
                       state.backlog_until[row_idx], state.corr[row_idx],
                       state.pkt_count[row_idx])
-
-            def body(carry, xs):
-                tk0, tl0, nf0, corr0, cnt0 = carry
-                sz, va, u = xs
-                res, tk, tl, nf, corr, cnt = _shape_vmapped(
-                    props, tk0, tl0, nf0, corr0, cnt0, sz, t_arr, u)
-                act = va & active
-                keep = lambda new, old: jnp.where(act, new, old)  # noqa: E731
-                carry = (keep(tk, tk0), keep(tl, tl0), keep(nf, nf0),
-                         jnp.where(act[:, None], corr, corr0),
-                         keep(cnt, cnt0))
-                inf = jnp.float32(jnp.inf)
-                res = ShapeResult(
-                    depart_us=jnp.where(act, res.depart_us, inf),
-                    delivered=res.delivered & act,
-                    dropped_loss=res.dropped_loss & act,
-                    dropped_queue=res.dropped_queue & act,
-                    corrupted=res.corrupted & act,
-                    duplicated=res.duplicated & act,
-                    reordered=res.reordered & act)
-                return carry, res
-
-            xs = (jnp.moveaxis(sizes, 1, 0), jnp.moveaxis(valid, 1, 0),
-                  u_all)
-            (tk, tl, nf, corr, cnt), res = jax.lax.scan(body, carry0, xs)
+            (tk, tl, nf, corr, cnt), res = shape_rows_seq(
+                state.props[row_idx], state.active[row_idx], carry0,
+                sizes, valid, key)
             new_state = dataclasses.replace(
                 state,
                 tokens=state.tokens.at[row_idx].set(tk, mode="drop"),
@@ -708,7 +750,6 @@ def shape_slots_nodonate(state: EdgeState, row_idx: jax.Array,
                 corr=state.corr.at[row_idx].set(corr, mode="drop"),
                 pkt_count=state.pkt_count.at[row_idx]
                 .set(cnt, mode="drop"))
-            res = jax.tree.map(lambda a: jnp.moveaxis(a, 0, 1), res)
             return new_state, res
 
         _shape_slots_nd = jax.jit(_slots)

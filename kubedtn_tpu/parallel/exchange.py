@@ -1,0 +1,141 @@
+"""Cross-shard mailbox exchange for the sharded live data plane.
+
+The sharded fused tick (runtime `_make_sharded_fused`) keeps the
+edge-state SoA block-sharded along the edge axis; each tick's busy rows
+are scattered across shards, but every shard must run the SAME shaping
+program over the SAME gathered per-row state for the results to stay
+byte-identical to the unsharded plane (the kernels draw their uniforms
+over the whole padded [R, K] batch). This module moves that per-row
+state between shards as a bounded per-tick MAILBOX:
+
+- Each shard packs the rows it OWNS into fixed-size mailbox blocks
+  (`[R, Wf]` float32 payload + `[R, Wi]` int32 payload whose column 0 is
+  the ownership flag) and zeroes the rest.
+- The mailbox travels the ring: S-1 steps, each step one bounded
+  neighbor-pair transfer (shard s → shard s+1 mod S). After the full
+  ring every shard holds every row's owner payload.
+- The combine is a SELECT, not a sum: exactly one shard owns each row,
+  so `where(owned, incoming, acc)` moves the owner's bits verbatim —
+  no floating-point arithmetic ever touches the payload, which is what
+  makes the N-shard plane bit-identical to the 1-shard plane.
+
+Backends:
+
+- **TPU**: each ring step is a Pallas `make_async_remote_copy` remote
+  DMA (`_dma_right_shift`) with send/recv DMA semaphores in scratch —
+  the SNIPPETS right-permute pattern — so cross-shard frame-state
+  movement stays on the ICI fabric, never the host.
+- **everywhere else** (the tier-1 CPU mesh under
+  `--xla_force_host_platform_device_count`): the identical ring with
+  each DMA swapped for a `lax.ppermute` — same mailbox layout, same
+  step count, same select-combine, same bits.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kubedtn_tpu.parallel.mesh import EDGE_AXIS
+
+__all__ = ["use_remote_dma", "make_ring_exchange", "dma_right_shift"]
+
+
+def use_remote_dma(mesh=None) -> bool:
+    """True when the Pallas remote-DMA ring should carry the exchange:
+    every device of the mesh (default: all local devices) is a TPU.
+    The ppermute ring is the fallback everywhere else — identical
+    mailbox layout and bits, different transport."""
+    try:
+        devs = (list(mesh.devices.flat) if mesh is not None
+                else jax.devices())
+        return bool(devs) and all(d.platform == "tpu" for d in devs)
+    except Exception:
+        return False
+
+
+# -- TPU remote-DMA ring step ------------------------------------------
+
+def _right_permute_kernel(in_ref, out_ref, send_sem, recv_sem, *,
+                          axis: str, n_shards: int):
+    """One ring step: DMA this shard's mailbox block into the right
+    neighbor's output buffer. DMA semaphores live in scratch; the wait
+    covers both the local send completing and the left neighbor's copy
+    landing in `out_ref` (recv_sem)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    my_id = lax.axis_index(axis)
+    right = lax.rem(my_id + 1, n_shards)
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=in_ref,
+        dst_ref=out_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=(right,),
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    rdma.start()
+    rdma.wait()
+
+
+def dma_right_shift(x, axis: str = EDGE_AXIS, n_shards: int | None = None):
+    """`lax.ppermute(x, axis, [(s, s+1 mod S)])` as a Pallas remote-DMA
+    kernel — must be called inside a shard_map over `axis` on a TPU
+    mesh. `x` is one shard's mailbox block `[R, W]`."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if n_shards is None:
+        n_shards = lax.axis_size(axis)
+    return pl.pallas_call(
+        functools.partial(_right_permute_kernel, axis=axis,
+                          n_shards=n_shards),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 2,
+    )(x)
+
+
+# -- the ring exchange --------------------------------------------------
+
+def make_ring_exchange(n_shards: int, axis: str = EDGE_AXIS,
+                       use_dma: bool = False):
+    """Build the per-tick mailbox exchange for an `n_shards` ring.
+
+    Returns `exch(fmail, imail) -> (fmail', imail')` to be called
+    INSIDE a shard_map body over `axis`:
+
+    - `fmail` float32 `[R, Wf]`: the shard's owned rows' float payload
+      (props / clocks / correlation memory), zero elsewhere.
+    - `imail` int32 `[R, Wi]`: integer payload with **column 0 the
+      ownership flag** (1 on the owner shard, 0 elsewhere).
+
+    After the call both mailboxes hold, on EVERY shard, each row's
+    owner payload — assembled by S-1 bounded neighbor-pair transfers
+    with a bitwise select-combine (module docstring)."""
+    if n_shards <= 1:
+        return lambda fmail, imail: (fmail, imail)
+    perm = [(s, (s + 1) % n_shards) for s in range(n_shards)]
+    if use_dma:
+        def shift(x):
+            return dma_right_shift(x, axis=axis, n_shards=n_shards)
+    else:
+        def shift(x):
+            return lax.ppermute(x, axis, perm)
+
+    def exch(fmail, imail):
+        accf, acci = fmail, imail
+        rf, ri = fmail, imail
+        for _ in range(n_shards - 1):
+            rf = shift(rf)
+            ri = shift(ri)
+            own = ri[:, :1] > 0
+            accf = jnp.where(own, rf, accf)
+            acci = jnp.where(own, ri, acci)
+        return accf, acci
+
+    return exch

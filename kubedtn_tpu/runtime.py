@@ -855,6 +855,247 @@ def _fused_tick(state, dyn, key, elapsed_us, seq_args, tbf_args,
     return key, sub, _dyn_of(state), outs, tel
 
 
+# -- sharded live plane (round 9) --------------------------------------
+#
+# The edge-state SoA block-shards along the edge axis across a device
+# mesh (parallel/mesh.edge_sharding) and the fused tick becomes a
+# shard_map program: each shard rolls its clock slice and scatters its
+# owned rows' write-backs LOCALLY, while the tick's busy-row state is
+# assembled across shards by the bounded per-tick mailbox ring exchange
+# (parallel/exchange.py — Pallas make_async_remote_copy remote DMA on
+# TPU, the identical lax.ppermute ring elsewhere). The batch arrays and
+# per-tick key stay REPLICATED, so every shard draws the very same
+# uniforms over the very same padded [R, K] shapes the unsharded kernels
+# draw — which is what makes a mesh-N plane byte-identical to mesh-1 and
+# mesh-1 byte-identical to the unsharded plane (tests/test_sharded_plane
+# pins all three, per kernel class, at both pipeline depths).
+
+_CLASS_FOLD = {"seq": 0, "ind": 1, "tbf": 2}  # _shape_class's fold_in
+
+
+def _needs_placement(arr, sharding) -> bool:
+    """Does `arr` need a device_put to land on `sharding`?"""
+    cur = getattr(arr, "sharding", None)
+    if cur is None:
+        return True
+    if cur == sharding:
+        return False
+    try:
+        return not cur.is_equivalent_to(sharding, arr.ndim)
+    except Exception:
+        return True
+
+
+def _make_sharded_fused(mesh):
+    """Build the shard_map-wrapped `_fused_tick` for `mesh` (same
+    signature, same outputs — `outs` replicated, `dyn`/`tel` sharded
+    along the edge axis)."""
+    from jax.sharding import PartitionSpec as P
+
+    from kubedtn_tpu.ops.edge_state import NCORR, NPROP
+    from kubedtn_tpu.parallel import exchange as pex
+    from kubedtn_tpu.parallel.mesh import EDGE_AXIS, shard_map
+
+    S = int(mesh.devices.size)
+    edge = P(EDGE_AXIS)
+    rep = P()
+    exch = pex.make_ring_exchange(S, EDGE_AXIS,
+                                  use_dma=pex.use_remote_dma(mesh))
+
+    def class_local(kind, args, sub, work, off, E):
+        """One kernel class on one shard: mailbox-pack the owned rows'
+        state, ring-exchange to assemble the full gathered batch, run
+        the row core (identical program on every shard), scatter the
+        owned rows' write-back locally. Returns (work', out, res) with
+        `out` exactly `_shape_class`'s transfer set."""
+        props_l, act_l, tok_l, tl_l, nf_l, corr_l, cnt_l = work
+        rows, sizes, valid = args
+        rows = rows.astype(jnp.int32)
+        E_loc = tok_l.shape[0]
+        # padding rows carry index E: clamp for the GATHER (the
+        # unsharded kernels' OOB gathers clamp to row E-1 the same
+        # way), keep the raw index for the scatter (which must drop)
+        rows_c = jnp.minimum(rows, E - 1)
+        owned = (rows_c >= off) & (rows_c < off + E_loc)
+        li = jnp.where(owned, rows_c - off, 0)
+        fmail = jnp.concatenate([
+            props_l[li],
+            tok_l[li][:, None], tl_l[li][:, None], nf_l[li][:, None],
+            corr_l[li]], axis=1)
+        fmail = jnp.where(owned[:, None], fmail, 0.0)
+        imail = jnp.stack([owned.astype(jnp.int32), cnt_l[li],
+                           act_l[li].astype(jnp.int32)], axis=1)
+        imail = jnp.where(owned[:, None], imail, 0)
+        fg, ig = exch(fmail, imail)
+        props_r = fg[:, :NPROP]
+        tok_r = fg[:, NPROP]
+        tl_r = fg[:, NPROP + 1]
+        nf_r = fg[:, NPROP + 2]
+        corr_r = fg[:, NPROP + 3:NPROP + 3 + NCORR]
+        cnt_r = ig[:, 1]
+        act_r = ig[:, 2].astype(bool)
+        keyc = jax.random.fold_in(sub, _CLASS_FOLD[kind])
+        tgt = jnp.where(owned & (rows < E), li, E_loc)
+        if kind == "tbf":
+            res, tok_row, dep_row, delta, hacc, fbk = \
+                netem.shape_rows_tbf(props_r, act_r, corr_r, cnt_r,
+                                     tok_r, tl_r, nf_r, sizes, valid,
+                                     keyc)
+            apply = hacc & ~fbk
+            tok_l = tok_l.at[tgt].set(
+                jnp.where(apply, tok_row, tok_l[li]), mode="drop")
+            tl_l = tl_l.at[tgt].set(
+                jnp.where(apply, dep_row, tl_l[li]), mode="drop")
+            nf_l = nf_l.at[tgt].set(
+                jnp.where(apply, dep_row, nf_l[li]), mode="drop")
+            cnt_l = cnt_l.at[tgt].add(
+                jnp.where(apply, delta.astype(cnt_l.dtype), 0),
+                mode="drop")
+            out = (res.delivered, res.depart_us, *_row_counts(res), fbk)
+        elif kind == "seq":
+            carry0 = (tok_r, tl_r, nf_r, corr_r, cnt_r)
+            (tk, tl, nf, co, cn), res = netem.shape_rows_seq(
+                props_r, act_r, carry0, sizes, valid, keyc)
+            tok_l = tok_l.at[tgt].set(tk, mode="drop")
+            tl_l = tl_l.at[tgt].set(tl, mode="drop")
+            nf_l = nf_l.at[tgt].set(nf, mode="drop")
+            corr_l = corr_l.at[tgt].set(co, mode="drop")
+            cnt_l = cnt_l.at[tgt].set(cn.astype(cnt_l.dtype),
+                                      mode="drop")
+            out = (res.delivered, res.depart_us, *_row_counts(res))
+        else:
+            res, delta = netem.shape_rows_indep(props_r, act_r, sizes,
+                                                valid, keyc)
+            cnt_l = cnt_l.at[tgt].add(delta.astype(cnt_l.dtype),
+                                      mode="drop")
+            out = (res.delivered, res.depart_us, *_row_counts(res))
+        return ((props_l, act_l, tok_l, tl_l, nf_l, corr_l, cnt_l),
+                out, res)
+
+    def tel_local(tel_l, kind, args, out, res, off, E):
+        """`_tel_class` on one shard: the [R, KCOLS] contribution is
+        computed replicated (tele.tel_matrix), each shard scatter-adds
+        only its owned rows — the adds landing on a logical row are
+        bit-identical to the unsharded accumulate."""
+        rows, sizes, valid = args
+        rows = rows.astype(jnp.int32)
+        if kind == "tbf":
+            fbk = out[5]
+            rows = jnp.where(fbk, jnp.int32(E), rows)
+        mat = tele.tel_matrix(sizes, valid, res, row_counts=out[2:5])
+        E_loc = tel_l.shape[0]
+        owned = (rows >= off) & (rows < off + E_loc)
+        tgt = jnp.where(owned, rows - off, E_loc)
+        return tel_l.at[tgt].add(mat, mode="drop"), out
+
+    @partial(jax.jit, static_argnames=("has_seq", "has_tbf", "has_ind",
+                                       "has_dyn", "has_tel"))
+    def fused(state, dyn, key, elapsed_us, seq_args, tbf_args,
+              ind_args, tel, *, has_seq, has_tbf, has_ind, has_dyn,
+              has_tel=False):
+        E = state.capacity
+        if has_dyn:
+            state = _with_dyn(state, dyn)
+        key, sub = jax.random.split(key)
+        cols = (state.props, state.active, state.tokens, state.t_last,
+                state.backlog_until, state.corr, state.pkt_count)
+        kinds = tuple(k for k, has in (("tbf", has_tbf),
+                                       ("seq", has_seq),
+                                       ("ind", has_ind)) if has)
+        class_args = tuple({"tbf": tbf_args, "seq": seq_args,
+                            "ind": ind_args}[k] for k in kinds)
+
+        def body(cols, sub, elapsed, *rest):
+            if has_tel:
+                tel_l = rest[0]
+                cargs = rest[1:]
+            else:
+                tel_l = None
+                cargs = rest
+            E_loc = cols[2].shape[0]
+            off = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32) * E_loc
+            props_l, act_l, tok_l, tl_l, nf_l, corr_l, cnt_l = cols
+            floor = jnp.float32(-1e7)
+            tl_l = jnp.maximum(tl_l - elapsed, floor)
+            nf_l = jnp.maximum(nf_l - elapsed, floor)
+            work = (props_l, act_l, tok_l, tl_l, nf_l, corr_l, cnt_l)
+            outs = []
+            for kind, args in zip(kinds, cargs):
+                work, out, res = class_local(kind, args, sub, work,
+                                             off, E)
+                if has_tel:
+                    tel_l, out = tel_local(tel_l, kind, args, out, res,
+                                           off, E)
+                outs.append(out)
+            dyn_out = (work[2], work[3], work[4], work[5], work[6])
+            if has_tel:
+                return dyn_out, tuple(outs), tel_l
+            return dyn_out, tuple(outs)
+
+        arg_spec = (rep, rep, rep)
+        in_specs = [(edge,) * 7, rep, rep]
+        out_specs = [(edge,) * 5,
+                     tuple(tuple([rep] * (6 if k == "tbf" else 5))
+                           for k in kinds)]
+        call_args = [cols, sub, elapsed_us]
+        if has_tel:
+            in_specs.append(edge)
+            out_specs.append(edge)
+            call_args.append(tel)
+        in_specs.extend([arg_spec] * len(kinds))
+        call_args.extend(class_args)
+        res = shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                        out_specs=tuple(out_specs))(*call_args)
+        if has_tel:
+            dyn_out, outs_t, tel_out = res
+        else:
+            (dyn_out, outs_t), tel_out = res, tel
+        outs = dict(zip(kinds, outs_t))
+        return key, sub, dyn_out, outs, tel_out
+
+    return fused
+
+
+_SHARDED_FUSED_CACHE: dict = {}
+_EXCHANGE_PROBE_CACHE: dict = {}
+
+
+def _mesh_cache_key(mesh):
+    return (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
+
+
+def _sharded_fused_for(mesh):
+    key = _mesh_cache_key(mesh)
+    fn = _SHARDED_FUSED_CACHE.get(key)
+    if fn is None:
+        fn = _SHARDED_FUSED_CACHE[key] = _make_sharded_fused(mesh)
+    return fn
+
+
+def _exchange_probe_for(mesh):
+    """Standalone jitted mailbox exchange on `mesh` — the sampled
+    timing probe behind the `exchange_seconds` gauge (the ring rides
+    inside the one fused dispatch, so its cost is measured by
+    re-executing it alone on a representative mailbox, off the hot
+    path at 1/64 dispatch sampling)."""
+    from jax.sharding import PartitionSpec as P
+
+    from kubedtn_tpu.parallel import exchange as pex
+    from kubedtn_tpu.parallel.mesh import EDGE_AXIS, shard_map
+
+    key = _mesh_cache_key(mesh)
+    fn = _EXCHANGE_PROBE_CACHE.get(key)
+    if fn is not None:
+        return fn
+    S = int(mesh.devices.size)
+    exch = pex.make_ring_exchange(S, EDGE_AXIS,
+                                  use_dma=pex.use_remote_dma(mesh))
+    fn = jax.jit(shard_map(lambda f, i: exch(f, i), mesh=mesh,
+                           in_specs=(P(), P()), out_specs=(P(), P())))
+    _EXCHANGE_PROBE_CACHE[key] = fn
+    return fn
+
+
 @partial(jax.jit, static_argnames=("kind", "has_dyn", "has_tel"))
 def _class_tick(state, dyn, sub, elapsed_us, args, tel, *, kind,
                 has_dyn, has_tel=False):
@@ -1163,6 +1404,109 @@ class WireDataPlane:
         # the pre-telemetry fused tick (has_tel is a static jit flag)
         self.telemetry: tele.LinkTelemetry | None = None
         self.recorder: tele.FlightRecorder | None = None
+        # -- sharded live plane (round 9) ------------------------------
+        # None until enable_sharding(): the edge-state SoA (and the
+        # telemetry accumulator / chained dyn columns) block-shard
+        # along the edge axis across the mesh and the fused tick runs
+        # as the shard_map program built by _make_sharded_fused
+        self._shard_mesh = None
+        self._edge_shard = None        # NamedSharding for the SoA
+        self._sharded_fused = None
+        self.shard_xfrm = 0            # cumulative cross-shard frames
+        self.shard_xfrm_last = 0       # cross-shard frames, last tick
+        self.shard_mailbox_hwm = 0     # mailbox rows high-water mark
+        self.shard_exchange_s = 0.0    # sampled exchange-probe seconds
+        self._exchange_probe = None
+        self._exchange_count = 0
+
+    def enable_sharding(self, mesh=None, n_devices: int | None = None):
+        """Shard the live plane's edge-state SoA along the edge axis
+        across a device mesh: every [E]-leading column (and the
+        telemetry window accumulator and the pipeline's chained dynamic
+        columns) block-shards over the mesh, the fused tick runs as the
+        shard_map program of `_make_sharded_fused`, and cross-shard row
+        state moves through the bounded per-tick mailbox ring exchange
+        (Pallas remote DMA on TPU, lax.ppermute elsewhere — same bits).
+
+        Mesh size must be a power of two so block sharding keeps
+        dividing the engine's power-of-two capacity growth. Capacity is
+        padded up to a mesh multiple here if needed. Crossing the
+        flush() barrier keeps the program switch off any in-flight
+        dispatch; delivery bits are unchanged (the sharded determinism
+        suite pins mesh 1/2/8 ≡ unsharded). Returns the mesh."""
+        from kubedtn_tpu.ops import edge_state as es
+        from kubedtn_tpu.parallel import mesh as pmesh
+
+        with self._tick_lock:
+            self.flush()
+            if mesh is None:
+                if n_devices is None:
+                    # default mesh: the largest power-of-two device
+                    # count available
+                    n_devices, avail = 1, len(jax.devices())
+                    while n_devices * 2 <= avail:
+                        n_devices *= 2
+                mesh = pmesh.make_mesh(n_devices)
+            S = int(mesh.devices.size)
+            if S & (S - 1):
+                raise ValueError(
+                    f"mesh size must be a power of two (block sharding "
+                    f"must keep dividing the engine's power-of-two "
+                    f"capacity growth); got {S}")
+            engine = self.engine
+            with engine._lock:
+                engine._flush_device_locked()
+                st = engine._state
+                if st.capacity % S:
+                    st = es.grow_state(st, -(-st.capacity // S) * S)
+                engine._state = pmesh.shard_edge_state(st, mesh)
+                engine.shard_count = S
+            self._shard_mesh = mesh
+            self._edge_shard = pmesh.edge_sharding(mesh)
+            self._sharded_fused = _sharded_fused_for(mesh)
+            self._exchange_probe = (_exchange_probe_for(mesh)
+                                    if S > 1 else None)
+            self.shard_xfrm = 0
+            self.shard_xfrm_last = 0
+            self.shard_mailbox_hwm = 0
+            self.shard_exchange_s = 0.0
+            self._exchange_count = 0
+        return mesh
+
+    def shard_summary(self) -> dict:
+        """Sharding posture + partition quality + mailbox counters —
+        the `kubedtn_plane_shard_*` metrics feed and the bench phases'
+        record fields."""
+        if self._shard_mesh is None:
+            return {"enabled": False, "mesh_shape": [1],
+                    "n_shards": 1}
+        from kubedtn_tpu.parallel.partition import colocation_stats
+
+        S = int(self._shard_mesh.devices.size)
+        # partition stats take engine._lock, flush pending control ops
+        # and walk every peered link — at 100k+ links that must not run
+        # on every Prometheus scrape (the tick's dispatch snapshot
+        # shares the lock). They only change on reconcile/compact, so a
+        # short TTL cache bounds the cost to once per window.
+        cached = getattr(self, "_shard_stats_cache", None)
+        now = time.monotonic()
+        if cached is not None and cached[0] == S and now < cached[1]:
+            out = dict(cached[2])
+        else:
+            try:
+                out = colocation_stats(self.engine, S)
+            except ValueError:
+                out = {"n_shards": S}
+            self._shard_stats_cache = (S, now + 5.0, dict(out))
+        out.update({
+            "enabled": True,
+            "mesh_shape": list(self._shard_mesh.devices.shape),
+            "xshard_frames": int(self.shard_xfrm),
+            "xshard_frames_last": int(self.shard_xfrm_last),
+            "mailbox_hwm": int(self.shard_mailbox_hwm),
+            "exchange_seconds": round(self.shard_exchange_s, 6),
+        })
+        return out
 
     def enable_telemetry(self, window_s: float = 1.0, windows: int = 12,
                          sample_period: int = 256,
@@ -1792,6 +2136,24 @@ class WireDataPlane:
                 rowinfo[row] = (engine._peer.get(key, key)
                                 if key is not None else None)
             shaped_rows = set(engine._shaped_rows)
+            dstrow: dict[int, int] = {}
+            if self._shard_mesh is not None:
+                # destination (peer) edge rows, for the cross-shard
+                # frame accounting: a frame is cross-shard when its
+                # ingress row and its next hop's row live in different
+                # shard blocks (parallel.partition)
+                for row, target in rowinfo.items():
+                    dr = (engine._rows.get(target)
+                          if target is not None else None)
+                    dstrow[row] = -1 if dr is None else dr
+                # keep the SoA resident on the mesh: growth and some
+                # control-plane outputs come back unsharded
+                if _needs_placement(state.tokens, self._edge_shard):
+                    from kubedtn_tpu.parallel.mesh import \
+                        shard_edge_state
+
+                    state = shard_edge_state(state, self._shard_mesh)
+                    engine._state = state
             # chained dynamic columns must match the snapshot capacity;
             # engine growth mid-pipeline drains the ring right here
             # (those write-backs skip on the same capacity check) and
@@ -2015,6 +2377,19 @@ class WireDataPlane:
         if not batches:
             return None
 
+        # -- cross-shard frame accounting (sharded planes) -------------
+        if self._shard_mesh is not None:
+            n_sh = int(self._shard_mesh.devices.size)
+            if n_sh > 1 and E % n_sh == 0:
+                loc = E // n_sh
+                x = 0
+                for _w, row, lens_i, _fr, _pd in batches:
+                    dr = dstrow.get(row, -1)
+                    if dr >= 0 and dr // loc != row // loc:
+                        x += len(lens_i)
+                self.shard_xfrm += x
+                self.shard_xfrm_last = x
+
         # -- route rows: slot-independent vs TBF-batch vs sequential ---
         # via a HOST mirror of the props table (cached per device-array
         # identity): the old per-tick `np.asarray(state.props[rows])`
@@ -2087,6 +2462,14 @@ class WireDataPlane:
                             ("ind", ind_group)):
             if group:
                 args[kind] = _build_group(batches, group, E)
+        if self._shard_mesh is not None and args:
+            # every padded batch row rides the mailbox once per ring
+            # step: the per-step block size is the tick's padded row
+            # count — the bounded per-tick mailbox the partitioner's
+            # layout describes
+            mail_rows = sum(a[0].shape[0] for a in args.values())
+            if mail_rows > self.shard_mailbox_hwm:
+                self.shard_mailbox_hwm = mail_rows
         # link-telemetry window accumulator: fetched under the tick
         # lock (window rollover happens here, on the dispatch clock, so
         # each dispatch's reductions land wholly in one window) and
@@ -2102,6 +2485,7 @@ class WireDataPlane:
         # as a stalled runner
         bucket = (E, self._pipe_state is not None,
                   self.degrade_level >= 2, has_tel,
+                  self._shard_mesh is not None,
                   tuple(sorted((kind, a[1].shape)
                                for kind, a in args.items())))
         if bucket not in self._seen_buckets:
@@ -2129,7 +2513,11 @@ class WireDataPlane:
                 el = jnp.float32(0.0)  # the clock roll applies once
             dyn_after = dyn
         else:
-            key, sub, dyn_after, outs, tel_out = _fused_tick(
+            # the sharded plane swaps in the shard_map program built
+            # for its mesh — same signature, byte-identical outputs
+            fused_fn = (self._sharded_fused
+                        if self._shard_mesh is not None else _fused_tick)
+            key, sub, dyn_after, outs, tel_out = fused_fn(
                 state, self._pipe_state, self._key,
                 jnp.float32(elapsed_us),
                 args.get("seq"), args.get("tbf"), args.get("ind"),
@@ -2145,6 +2533,21 @@ class WireDataPlane:
         job.dyn_after = dyn_after
         self._pipe_state = dyn_after
         self._chain_shaped_s = now_s
+        if self._exchange_probe is not None and args:
+            # exchange-kernel seconds, sampled: the ring rides inside
+            # the one fused dispatch, so its cost is measured by
+            # re-executing it alone on a matching mailbox once per 64
+            # dispatches (documented as a sampled standalone probe)
+            self._exchange_count += 1
+            if self._exchange_count % 64 == 1:
+                from kubedtn_tpu.ops.edge_state import NCORR, NPROP
+
+                Rp = max(a[1].shape[0] for a in args.values())
+                fm = jnp.zeros((Rp, NPROP + 3 + NCORR), jnp.float32)
+                im = jnp.zeros((Rp, 3), jnp.int32)
+                t0p = time.perf_counter()
+                jax.block_until_ready(self._exchange_probe(fm, im))
+                self.shard_exchange_s += time.perf_counter() - t0p
         for kind, group in (("tbf", tbf_group), ("seq", seq_group),
                             ("ind", ind_group)):
             if group:

@@ -828,11 +828,14 @@ def live_plane(pairs: int = 8, frames_per_wire: int = 40_000,
         round(pairs * (-(-frames_per_wire // INJECTOR_CHUNK)
                        * INJECTOR_CHUNK) / r[2], 1)
         for r in results if r[2] > 0]
+    shard = plane.shard_summary()
     return {
         "scenario": "live_plane",
         "pairs": pairs,
         "frames_per_wire": frames_per_wire,
         "latency": latency,
+        "mesh_shape": shard.get("mesh_shape", [1]),
+        "shard_imbalance": shard.get("imbalance", 0.0),
         "frames_delivered": results[-1][1],
         "warmup_rounds": 1,  # full-size, untimed, excluded below
         "rounds_frames_per_s": [round(r[0], 1) for r in results],
@@ -1035,10 +1038,13 @@ def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
         server.stop(0)
     rates = sorted(windows)
     med = statistics.median(rates) if rates else 0.0
+    shard = plane.shard_summary()
     return {
         "scenario": "live_plane_soak",
         "pairs": pairs,
         "shaping": f"rate={rate}" if rate else f"latency={latency}",
+        "mesh_shape": shard.get("mesh_shape", [1]),
+        "shard_imbalance": shard.get("imbalance", 0.0),
         "injector_chunk": chunk,
         "settle_s": settle_used,
         "seconds": seconds,
@@ -1521,6 +1527,84 @@ def whatif_sweep(replicas: int = 64, steps: int = 10_000,
     }
 
 
+def sharded_soak(pairs: int = 48, frames_per_wire: int = 6_000,
+                 rounds: int = 3, devices: int = 0,
+                 latency: str = "2ms", dt_us: float = 2_000.0):
+    """MULTICHIP evidence for the edge-sharded live plane: the SAME
+    plane-only probe workload (frames fed straight into wire ingress,
+    explicit-clock ticks, drain → decide → fused dispatch → schedule →
+    release and nothing else) measured twice — on a 1-device plane and
+    on a plane whose edge-state SoA is sharded across the largest
+    power-of-two device mesh available. Reports mesh shape, per-shard
+    edge counts + imbalance, cross-shard frames/tick (rows whose hop
+    straddles a shard block — pairs=48 pads capacity to 200, E_loc=25,
+    so 2 of the 48 consecutive-row link pairs straddle a boundary and
+    generate genuine inter-chip mailbox deliveries), the mailbox
+    high-water mark, and the sampled exchange-kernel seconds. The
+    sharded:single rate ratio is the no-regression headline; on real
+    TPU meshes the exchange rides the Pallas remote-DMA ring
+    (parallel/exchange.py), on forced-host CPU devices the identical
+    ppermute ring — same mailbox bits, so this phase validates layout
+    and accounting everywhere and bandwidth on chips."""
+    import jax
+
+    from kubedtn_tpu.parallel.exchange import use_remote_dma
+    from kubedtn_tpu.parallel.mesh import make_mesh
+
+    t0 = time.perf_counter()
+    n_dev = devices or len(jax.devices())
+    S = 1
+    while S * 2 <= n_dev:
+        S *= 2
+
+    def measure(mesh_n: int, prefix: str):
+        import statistics
+
+        daemon, engine, plane, win, wout = _plane_only_setup(
+            pairs, latency, dt_us, prefix)
+        if mesh_n > 1:
+            plane.enable_sharding(make_mesh(mesh_n))
+        t = 0.0
+        rates = []
+        for r in range(rounds + 1):  # round 0 warms the jit buckets
+            rate, t = _probe_round(plane, win, wout, frames_per_wire,
+                                   t, dt_us / 1e6)
+            if r:
+                rates.append(rate)
+        return statistics.median(rates), rates, plane
+
+    base_med, base_rates, base_plane = measure(1, "ss1")
+    sh_med, sh_rates, plane = measure(S, "ssN")
+    shard = plane.shard_summary()
+    xpt = plane.shard_xfrm / max(plane.ticks, 1)
+    return {
+        "scenario": "sharded_soak",
+        "record": "MULTICHIP_SHARDED_SOAK",
+        "backend": jax.default_backend(),
+        "remote_dma": bool(use_remote_dma()),
+        "pairs": pairs,
+        "frames_per_wire": frames_per_wire,
+        "devices": n_dev,
+        "mesh_shape": shard.get("mesh_shape", [S]),
+        "edges_per_shard": shard.get("edges_per_shard"),
+        "shard_imbalance": shard.get("imbalance"),
+        "colocated_frac": shard.get("colocated_frac"),
+        "xshard_frames_total": int(plane.shard_xfrm),
+        "xshard_frames_per_tick": round(xpt, 2),
+        "mailbox_hwm": int(plane.shard_mailbox_hwm),
+        "exchange_seconds": shard.get("exchange_seconds", 0.0),
+        "single_device_frames_per_s": round(base_med, 1),
+        "single_rounds": [round(r, 1) for r in base_rates],
+        "sharded_frames_per_s": round(sh_med, 1),
+        "sharded_rounds": [round(r, 1) for r in sh_rates],
+        "sharded_over_single": round(sh_med / base_med, 3)
+        if base_med else None,
+        "dropped": plane.dropped + base_plane.dropped,
+        "tick_errors": plane.tick_errors + base_plane.tick_errors,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 LADDER = {
     "3node": three_node,
     "fat_tree_64": fat_tree_64,
@@ -1536,4 +1620,5 @@ LADDER = {
     "chaos_soak": chaos_soak,
     "whatif_sweep": whatif_sweep,
     "telemetry_overhead": telemetry_overhead,
+    "sharded_soak": sharded_soak,
 }

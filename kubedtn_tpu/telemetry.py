@@ -89,29 +89,16 @@ CAUSE_NAMES = {CAUSE_INVALID: "invalid", CAUSE_DELIVERED: "delivered",
                CAUSE_LOSS: "dropped_loss", CAUSE_QUEUE: "dropped_queue"}
 
 
-def tel_accumulate(acc, row_idx, sizes, valid, res, row_counts=None):
-    """Fold one shaped group's results into the open window accumulator
-    — traced INSIDE the fused tick (and the ladder's per-class
-    dispatches), so telemetry rides the existing device program with no
-    extra dispatch and no host sync. `acc` is the `[E, KCOLS]` open
-    window; `row_idx` `[R]` (padding rows index >= E and drop out of
-    every scatter); `sizes`/`valid` `[R, K]`; `res` the group's
-    ShapeResult with `[R, K]` leaves; `row_counts` the fused tick's
-    already-reduced (loss[R], queue[R], corrupt[R]) sums — passing them
-    reuses the transfer-set reductions instead of re-reducing (XLA
-    would CSE anyway; this keeps the dependency explicit). Returns the
-    advanced accumulator.
-
-    Cost discipline (the <5% overhead acceptance): everything here is
-    elementwise compare/reduce over the class's [R, K] batch plus ONE
-    [R]-indexed row scatter — no [R, K] scatters (XLA lowers element
-    scatters to a serial loop on CPU: ~0.5 ms/tick at K=4096, the
-    whole overhead budget) and no searchsorted (its binary-search
-    gather measured 2× the cost of comparing against all 11 edges)."""
+def tel_matrix(sizes, valid, res, row_counts=None):
+    """The per-row `[R, KCOLS]` window contribution of one shaped group
+    — the compute half of `tel_accumulate`, split out so the SHARDED
+    fused tick can compute the matrix replicated and scatter only each
+    shard's owned rows into its local accumulator slice (runtime
+    `_make_sharded_fused`). Bitwise: the adds that land on a row are
+    identical to the unsharded scatter's."""
     import jax.numpy as jnp
 
     f32 = jnp.float32
-    rows = row_idx
     deliv = res.delivered.astype(f32)
     vald = valid.astype(f32)
     # delivered lanes' depart is finite; dropped lanes are +inf — the
@@ -138,7 +125,7 @@ def tel_accumulate(acc, row_idx, sizes, valid, res, row_counts=None):
     hist = jnp.concatenate(
         [cum[:, :1], cum[:, 1:] - cum[:, :-1],
          (deliv_total - cum[:, -1])[:, None]], axis=1)  # [R, N_BINS]
-    mat = jnp.concatenate([jnp.stack([
+    return jnp.concatenate([jnp.stack([
         vald.sum(1),
         deliv_total,
         (sizes * deliv).sum(1),
@@ -148,8 +135,30 @@ def tel_accumulate(acc, row_idx, sizes, valid, res, row_counts=None):
         lat.sum(1),
         jnp.zeros_like(deliv_total),               # T_QDEPTH: host-side
     ], axis=1), hist], axis=1)                     # [R, KCOLS]
+
+
+def tel_accumulate(acc, row_idx, sizes, valid, res, row_counts=None):
+    """Fold one shaped group's results into the open window accumulator
+    — traced INSIDE the fused tick (and the ladder's per-class
+    dispatches), so telemetry rides the existing device program with no
+    extra dispatch and no host sync. `acc` is the `[E, KCOLS]` open
+    window; `row_idx` `[R]` (padding rows index >= E and drop out of
+    every scatter); `sizes`/`valid` `[R, K]`; `res` the group's
+    ShapeResult with `[R, K]` leaves; `row_counts` the fused tick's
+    already-reduced (loss[R], queue[R], corrupt[R]) sums — passing them
+    reuses the transfer-set reductions instead of re-reducing (XLA
+    would CSE anyway; this keeps the dependency explicit). Returns the
+    advanced accumulator.
+
+    Cost discipline (the <5% overhead acceptance): everything here is
+    elementwise compare/reduce over the class's [R, K] batch plus ONE
+    [R]-indexed row scatter — no [R, K] scatters (XLA lowers element
+    scatters to a serial loop on CPU: ~0.5 ms/tick at K=4096, the
+    whole overhead budget) and no searchsorted (its binary-search
+    gather measured 2× the cost of comparing against all 11 edges)."""
+    mat = tel_matrix(sizes, valid, res, row_counts=row_counts)
     # ONE row-indexed scatter-add per class (padding rows drop)
-    return acc.at[rows].add(mat, mode="drop")
+    return acc.at[row_idx].add(mat, mode="drop")
 
 
 def tel_row_host(sizes, valid, delivered, depart_us) -> np.ndarray:

@@ -157,6 +157,10 @@ class SimEngine:
         self._row_owner: dict[int, tuple[str, int]] = {}
         self._peer: dict[tuple[str, int], tuple[str, int]] = {}
         self._free: list[int] = list(range(capacity - 1, -1, -1))
+        # >1 when a sharded data plane is attached (set by
+        # WireDataPlane.enable_sharding): row allocation colocates link
+        # pairs inside one shard block (parallel.partition)
+        self.shard_count: int = 1
         self._topology_manager: set[str] = set()  # alive pods (metrics/TopologyManager)
         # placement answers cached per store placement generation
         self._placement_cache: dict[str, tuple[str, str]] = {}
@@ -613,13 +617,16 @@ class SimEngine:
                     # both ends realized (common/veth.go:73-76)
                     continue
                 # both alive same-node: plumb BOTH directions
-                # (common/veth.go:44-62, common/utils.go:39-68)
+                # (common/veth.go:44-62, common/utils.go:39-68).
+                # Sharded planes colocate the pair in one shard block
+                # (_alloc_link_pair) so a link's two directed rows never
+                # straddle the cross-shard mailbox boundary.
                 props, shaped = props_pack(link.properties)
                 peer_pid = pod_id(peer_key)
-                row = alloc(local_key, uid_)
+                row, prow = self._alloc_link_pair(local_key, peer_key,
+                                                  uid_)
                 entries_append((row, uid_, local_pid, peer_pid, props,
                                 shaped))
-                prow = alloc(peer_key, uid_)
                 entries_append((prow, uid_, peer_pid, local_pid, props,
                                 shaped))
                 peer_map[lk] = pk
@@ -728,6 +735,29 @@ class SimEngine:
         self._rows[k] = row
         self._row_owner[row] = k
         return row
+
+    def _alloc_link_pair(self, k1: str, k2: str, uid: int):
+        """Allocate both directed rows of one link, colocated in one
+        shard block when the data plane is sharded (shard_count > 1,
+        set by WireDataPlane.enable_sharding): frames between colocated
+        endpoints never ride the cross-shard mailbox. Idempotent like
+        _alloc; unsharded behavior is byte-for-byte the historical
+        two-pop path."""
+        a = self._rows.get((k1, uid))
+        b = self._rows.get((k2, uid))
+        if a is not None and b is not None:
+            return a, b
+        S = getattr(self, "shard_count", 1)
+        if (a is None and b is None and S > 1 and len(self._free) >= 2
+                and self._state.capacity % S == 0):
+            from kubedtn_tpu.parallel.partition import pick_pair_rows
+
+            r1, r2 = pick_pair_rows(self._free, self._state.capacity, S)
+            for k, r in ((k1, r1), (k2, r2)):
+                self._rows[(k, uid)] = r
+                self._row_owner[r] = (k, uid)
+            return r1, r2
+        return self._alloc(k1, uid), self._alloc(k2, uid)
 
     def on_rows_remapped(self, cb) -> None:
         """Register cb(old_rows_np, n_active): called after compact()

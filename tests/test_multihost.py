@@ -15,6 +15,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = r"""
@@ -31,6 +33,10 @@ import jax
 # the axon TPU-tunnel platform overrides JAX_PLATFORMS; the explicit
 # config update is what actually pins the CPU backend (see conftest.py)
 jax.config.update("jax_platforms", "cpu")
+# CPU multiprocess collectives ride the gloo transport; without this the
+# stock CPU client refuses with "Multiprocess computations aren't
+# implemented on the CPU backend" (the pre-round-9 env failure)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 import jax.numpy as jnp
 import numpy as np
@@ -103,6 +109,7 @@ def _run_two_workers(script_text, tmp_path, timeout, hang_msg):
     return outs
 
 
+@pytest.mark.requires_multihost
 def test_two_process_multihost_mesh(tmp_path):
     outs = _run_two_workers(WORKER, tmp_path, 180, "multihost worker hung")
 
@@ -129,6 +136,7 @@ sys.path.insert(0, sys.argv[3])
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 import jax.numpy as jnp
 import numpy as np
@@ -193,6 +201,7 @@ print(json.dumps({
 """
 
 
+@pytest.mark.requires_multihost
 def test_two_process_sharded_step(tmp_path):
     """The FULL sharded sim step (batched updates -> shaping -> psum'd
     node stats) jitted across two OS processes' device meshes — the DCN
@@ -221,6 +230,7 @@ sys.path.insert(0, sys.argv[3])
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 from kubedtn_tpu.parallel.mesh import init_distributed, make_multihost_mesh
 
@@ -339,6 +349,7 @@ def _run_workers(script_text, tmp_path, timeout, hang_msg, n_procs):
     return outs
 
 
+@pytest.mark.requires_multihost
 def test_four_process_sharded_router_steps(tmp_path):
     """FOUR processes x 2 devices run the full sharded ROUTER step
     (generate -> shape -> all_to_all cross-shard exchange -> deliver)

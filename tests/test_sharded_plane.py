@@ -1,0 +1,334 @@
+"""Sharded live data plane: determinism, exchange, partitioner,
+checkpoint round-trip.
+
+The contract that keeps the sharded plane honest (ISSUE 6 /
+ARCHITECTURE.md "Sharded live plane"):
+
+- mesh size 1 is byte-identical to the unsharded plane;
+- an N-shard plane is byte-identical to mesh-1 (and hence to the
+  unsharded plane) at small scale — delivery order, drop causes,
+  telemetry window-ring totals — across every kernel class (including
+  the TBF 50ms-queue fallback re-shape) and at pipeline depths 1 and 2;
+- `twin/snapshot.snapshot_from_plane` captures bit-exact state from a
+  sharded live plane;
+- a checkpoint written under an 8-way forced-host mesh restores
+  bit-exact on a 1-device plane, and vice versa.
+
+Tier-1 runs the whole suite on the CPU backend's 8 forced host devices
+(tests/conftest.py), with the Pallas remote-DMA exchange swapped for
+the lax.ppermute ring — same mailbox layout, same bits.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from test_pipeline_determinism import (INDEP, SEQ, TBF, TBF_OVERLOAD,
+                                       _daemon_with_pairs,
+                                       _tagged_frames)
+
+from kubedtn_tpu.parallel import partition
+from kubedtn_tpu.parallel.exchange import make_ring_exchange
+from kubedtn_tpu.parallel.mesh import (EDGE_AXIS, edge_sharding,
+                                       make_mesh, shard_map)
+from kubedtn_tpu.runtime import WireDataPlane
+
+pytestmark = pytest.mark.sharded_plane
+
+
+def _run_plane(props, n_per_wire, depth=1, mesh_n=None, pairs=2,
+               ticks=40, dt=0.002, seq_slots=64, telemetry=True):
+    """One fresh plane through a deterministic schedule; returns
+    (per-wire delivered byte sequences, plane)."""
+    daemon, _engine, win, wout = _daemon_with_pairs(pairs, props)
+    plane = WireDataPlane(daemon, dt_us=dt * 1e6, pipeline_depth=depth)
+    plane.pipeline_explicit_clock = True
+    plane.seq_slots = seq_slots
+    if telemetry:
+        plane.enable_telemetry(window_s=0.01, sample_period=4)
+    if mesh_n is not None:
+        plane.enable_sharding(make_mesh(mesh_n))
+    t = 100.0
+    for k, wa in enumerate(win):
+        wa.ingress.extend(_tagged_frames(k, n_per_wire))
+    for _ in range(ticks):
+        t += dt
+        plane.tick(now_s=t)
+    plane.flush()
+    plane.tick(now_s=t + 10.0)
+    assert plane.tick_errors == 0
+    assert not plane._inflight
+    return [list(w.egress) for w in wout], plane
+
+
+def _tel_totals(plane) -> np.ndarray:
+    total, _secs = plane.telemetry.window_sum()
+    return total
+
+
+CASES = [
+    (INDEP, 200, {}),
+    (TBF, 200, {}),
+    (TBF_OVERLOAD, 300, {}),
+    (SEQ, 150, dict(seq_slots=16)),
+]
+CASE_IDS = ["indep", "tbf", "tbf-fallback", "seq-holdback"]
+
+
+@pytest.mark.parametrize("props,n,kwargs", CASES, ids=CASE_IDS)
+@pytest.mark.parametrize("mesh_n,depth", [
+    (1, 1), (2, 1), (8, 1), (2, 2), (8, 2),
+], ids=["mesh1-d1", "mesh2-d1", "mesh8-d1", "mesh2-d2", "mesh8-d2"])
+def test_sharded_byte_identical(props, n, kwargs, mesh_n, depth):
+    """mesh-1 ≡ unsharded and mesh-N ≡ mesh-1: delivery order, shaped/
+    dropped counts, and telemetry ring totals, byte-for-byte, with the
+    window ring + flight recorder ON — per kernel class (including the
+    TBF fallback re-shape) at both pipeline depths."""
+    if len(jax.devices()) < mesh_n:
+        pytest.skip(f"needs {mesh_n} devices")
+    base, pb = _run_plane(props, n, depth=1, mesh_n=None, **kwargs)
+    got, pg = _run_plane(props, n, depth=depth, mesh_n=mesh_n, **kwargs)
+    assert got == base
+    assert pg.shaped == pb.shaped
+    assert pg.dropped == pb.dropped
+    tb, tg = _tel_totals(pb), _tel_totals(pg)
+    np.testing.assert_array_equal(tg[:tb.shape[0]], tb)
+    assert float(tg[tb.shape[0]:].sum()) == 0.0  # padded rows stay empty
+
+
+def test_cross_shard_frames_and_mailbox(sharded_mesh):
+    """Pairs whose directed rows straddle a shard boundary count as
+    cross-shard traffic; delivery stays byte-identical regardless.
+    pairs=3 → capacity 20 padded to 24 on an 8-way mesh → E_loc=3, so
+    link rows (2,3) split across blocks 0|1."""
+    del sharded_mesh  # the fixture provisions/validates the device mesh
+    base, _pb = _run_plane(INDEP, 120, pairs=3, mesh_n=None)
+    got, pg = _run_plane(INDEP, 120, pairs=3, mesh_n=8)
+    assert got == base
+    assert pg.shard_xfrm > 0
+    assert pg.shard_mailbox_hwm > 0
+    s = pg.shard_summary()
+    assert s["enabled"] and s["n_shards"] == 8
+    assert s["xshard_frames"] == pg.shard_xfrm
+    assert 0.0 <= s["colocated_frac"] <= 1.0
+
+
+@pytest.mark.parametrize("sharded_mesh", [8], indirect=True)
+def test_snapshot_from_sharded_plane_bit_exact(sharded_mesh):
+    """twin/snapshot.snapshot_from_plane from a sharded live plane is
+    bit-identical to the capture from an unsharded plane that ran the
+    same schedule."""
+    from kubedtn_tpu.checkpoint import flatten_sim_arrays
+    from kubedtn_tpu.twin.snapshot import snapshot_from_plane
+
+    _base, pb = _run_plane(SEQ, 150, mesh_n=None, seq_slots=16)
+    _got, pg = _run_plane(SEQ, 150, mesh_n=None, seq_slots=16)
+    # sanity: two identical unsharded runs snapshot identically
+    sb = flatten_sim_arrays(snapshot_from_plane(pb).sim,
+                            include_edges=True)
+    sg = flatten_sim_arrays(snapshot_from_plane(pg).sim,
+                            include_edges=True)
+    for k in sb:
+        np.testing.assert_array_equal(np.asarray(sb[k]),
+                                      np.asarray(sg[k]), err_msg=k)
+    _shard, ps = _run_plane(SEQ, 150, mesh_n=int(
+        sharded_mesh.devices.size), seq_slots=16)
+    ss = flatten_sim_arrays(snapshot_from_plane(ps).sim,
+                            include_edges=True)
+    for k in sb:
+        a, b = np.asarray(sb[k]), np.asarray(ss[k])
+        # the sharded plane padded capacity to a mesh multiple: the
+        # common prefix must be bit-equal, the padding rows zero/fresh
+        n = min(a.shape[0], b.shape[0]) if a.ndim else None
+        if a.ndim == 0:
+            np.testing.assert_array_equal(a, b, err_msg=k)
+        else:
+            np.testing.assert_array_equal(a[:n], b[:n], err_msg=k)
+
+
+def test_checkpoint_roundtrip_8way_to_1device(tmp_path):
+    """A checkpoint written under an 8-way forced-host mesh restores
+    bit-exact on a 1-device (unsharded) engine, and an unsharded
+    checkpoint restores bit-exact re-sharded onto the mesh."""
+    import dataclasses
+
+    from kubedtn_tpu import checkpoint as ckpt
+
+    _got, pg = _run_plane(TBF, 150, mesh_n=8)
+    store = pg.daemon.engine.store
+    engine = pg.engine
+    path = str(tmp_path / "ckpt")
+    ckpt.save(path, store, engine)
+    # 1-device restore: loaded arrays are plain host→default-device
+    s2, e2 = ckpt.load(path)
+    ref = engine.state
+    got = e2.state
+    for f in dataclasses.fields(type(ref)):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, f.name)),
+            np.asarray(getattr(got, f.name)), err_msg=f.name)
+    # and back onto the mesh: load_or_rebuild(mesh=) re-shards
+    mesh = make_mesh(8)
+    s3, e3, src = ckpt.load_or_rebuild(path, store=s2, mesh=mesh)
+    assert src == "checkpoint"
+    st3 = e3.state
+    assert st3.tokens.sharding.is_equivalent_to(
+        edge_sharding(mesh), st3.tokens.ndim)
+    for f in dataclasses.fields(type(ref)):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, f.name)),
+            np.asarray(getattr(st3, f.name)), err_msg=f.name)
+    assert e3.shard_count == 8
+
+
+def test_fast_forward_on_sharded_plane():
+    """Virtual-time advance works unchanged on a sharded plane."""
+    daemon, _e, win, _wout = _daemon_with_pairs(2, INDEP)
+    plane = WireDataPlane(daemon, dt_us=2000.0)
+    plane.enable_sharding(make_mesh(2))
+    for k, wa in enumerate(win):
+        wa.ingress.extend(_tagged_frames(k, 50))
+    plane.tick(now_s=0.0)
+    r = plane.fast_forward(1.0)
+    assert r["ticks"] > 0
+    assert plane.tick_errors == 0
+
+
+# -- exchange unit --------------------------------------------------------
+
+def test_ring_exchange_assembles_owner_payload():
+    """The select-combine ring delivers every row's OWNER payload to
+    every shard, bit-verbatim, for both the float and int mailboxes."""
+    S = 4
+    if len(jax.devices()) < S:
+        pytest.skip("needs 4 devices")
+    mesh = make_mesh(S)
+    R, W = 16, 5
+    rng = np.random.default_rng(0)
+    owner = rng.integers(0, S, size=R).astype(np.int32)
+    fvals = rng.standard_normal((R, W)).astype(np.float32)
+    ivals = rng.integers(1, 1 << 30, size=(R,)).astype(np.int32)
+    exch = make_ring_exchange(S, EDGE_AXIS)
+
+    def body():
+        sid = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
+        owned = jnp.asarray(owner) == sid
+        fmail = jnp.where(owned[:, None], jnp.asarray(fvals), 0.0)
+        imail = jnp.stack(
+            [owned.astype(jnp.int32),
+             jnp.where(owned, jnp.asarray(ivals), 0)], axis=1)
+        return exch(fmail, imail)
+
+    from jax.sharding import PartitionSpec as P
+
+    fg, ig = jax.jit(shard_map(body, mesh=mesh, in_specs=(),
+                               out_specs=(P(), P())))()
+    np.testing.assert_array_equal(np.asarray(fg), fvals)
+    np.testing.assert_array_equal(np.asarray(ig)[:, 1], ivals)
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="Pallas remote DMA needs real TPU devices")
+def test_ring_exchange_dma_matches_ppermute():
+    """On TPU the remote-DMA ring must move the same bits the ppermute
+    ring moves (the backend switch must be invisible)."""
+    S = min(len(jax.devices()), 4)
+    if S < 2:
+        pytest.skip("needs >= 2 TPU devices")
+    mesh = make_mesh(S)
+    R, W = 8, 128
+    rng = np.random.default_rng(1)
+    owner = rng.integers(0, S, size=R).astype(np.int32)
+    fvals = rng.standard_normal((R, W)).astype(np.float32)
+
+    def run(use_dma):
+        exch = make_ring_exchange(S, EDGE_AXIS, use_dma=use_dma)
+
+        def body():
+            sid = jax.lax.axis_index(EDGE_AXIS).astype(jnp.int32)
+            owned = jnp.asarray(owner) == sid
+            fmail = jnp.where(owned[:, None], jnp.asarray(fvals), 0.0)
+            imail = jnp.stack([owned.astype(jnp.int32),
+                               jnp.zeros_like(owned, jnp.int32)], axis=1)
+            return exch(fmail, imail)
+
+        from jax.sharding import PartitionSpec as P
+
+        return jax.jit(shard_map(body, mesh=mesh, in_specs=(),
+                                 out_specs=(P(), P())))()
+
+    f_pp, _ = run(False)
+    f_dma, _ = run(True)
+    np.testing.assert_array_equal(np.asarray(f_pp), np.asarray(f_dma))
+
+
+def test_shard_metrics_exported(sharded_mesh):
+    """kubedtn_plane_shard_* series appear (only) while the plane is
+    sharded, carrying the mailbox/cross-shard counters."""
+    from prometheus_client import generate_latest
+
+    from kubedtn_tpu.metrics.metrics import make_registry
+
+    del sharded_mesh
+    _got, plane = _run_plane(INDEP, 60, pairs=3, mesh_n=8, ticks=10)
+    registry, _ = make_registry(plane.engine, plane.counters_fn,
+                                dataplane=plane)
+    text = generate_latest(registry).decode()
+    assert "kubedtn_plane_shard_count 8.0" in text
+    assert 'kubedtn_plane_shard_edges{shard="0"}' in text
+    assert "kubedtn_plane_shard_xshard_frames_total" in text
+    assert "kubedtn_plane_shard_mailbox_high_water" in text
+    assert "kubedtn_plane_shard_exchange_seconds_total" in text
+    # and absent on an unsharded plane
+    _got2, plane2 = _run_plane(INDEP, 60, pairs=3, mesh_n=None, ticks=10)
+    registry2, _ = make_registry(plane2.engine, plane2.counters_fn,
+                                 dataplane=plane2)
+    assert "kubedtn_plane_shard_count" not in \
+        generate_latest(registry2).decode()
+
+
+# -- partitioner ----------------------------------------------------------
+
+def test_pick_pair_rows_colocates():
+    # fresh engine-style descending stack: consecutive pops = same block
+    free = list(range(23, -1, -1))
+    r1, r2 = partition.pick_pair_rows(free, 24, 8)
+    assert (r1, r2) == (0, 1)
+    assert r1 // 3 == r2 // 3
+    # no other free row in r1's block anywhere in scan reach: plain pop
+    free = [10, 4, 2]  # 2 → block 0; 4 → block 1; 10 → block 3
+    r1, r2 = partition.pick_pair_rows(free, 24, 8)
+    assert (r1, r2) == (2, 4)
+
+
+def test_pick_pair_rows_repairs_boundary():
+    # after popping 3 (block 1), the stack top is 1 (block 0) but 4
+    # (block 1) sits deeper: the scan pulls it out to keep the pair
+    # colocated
+    free = [9, 4, 1, 3]
+    r1, r2 = partition.pick_pair_rows(free, 24, 8)
+    assert (r1, r2) == (3, 4)
+    assert free == [9, 1]
+
+
+def test_mailbox_layout_counts_cross_pairs():
+    src = np.asarray([0, 3, 6, 7])
+    dst = np.asarray([1, 4, 7, -1])
+    out = partition.mailbox_layout(src, dst, 24, 8)
+    # 0→1 colocated (block 0); 3→4 colocated (block 1); 6→7 colocated
+    # (block 2); -1 unknown
+    assert out["cross_rows"] == 0
+    out2 = partition.mailbox_layout(np.asarray([2, 5]),
+                                    np.asarray([3, 4]), 24, 8)
+    assert out2["cross_rows"] == 1  # 2→3 straddles blocks 0|1
+    assert out2["pairs"] == {(0, 1): 1}
+
+
+def test_colocation_stats_on_engine():
+    _base, plane = _run_plane(INDEP, 10, pairs=3, mesh_n=8, ticks=5)
+    stats = partition.colocation_stats(plane.engine, 8)
+    assert stats["total_edges"] == 6
+    assert sum(stats["edges_per_shard"]) == 6
+    assert stats["links_paired"] == 3
