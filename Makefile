@@ -4,9 +4,30 @@
 
 PY ?= python
 
-.PHONY: all test test-fast bench native crd daemon scenario-% docker clean
+.PHONY: all test test-fast bench native crd daemon scenario-% docker clean \
+	lint typecheck verify
 
 all: native test
+
+lint:                      ## dtnlint contract suite (+ ruff when installed)
+	$(PY) -m kubedtn_tpu.analysis --json ANALYSIS.json
+	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
+		$(PY) -m ruff check kubedtn_tpu tests bench.py; \
+	else \
+		echo "ruff not installed; dtnlint's hygiene pass covered the floor"; \
+	fi
+
+typecheck:                 ## strict types over the contract core (when installed)
+	@if command -v pyright >/dev/null 2>&1; then \
+		pyright; \
+	elif $(PY) -m mypy --version >/dev/null 2>&1; then \
+		$(PY) -m mypy; \
+	else \
+		echo "pyright/mypy not installed; configs live in pyproject.toml"; \
+	fi
+
+verify: lint typecheck native  ## lint + types, then the tier-1 suite
+	$(PY) -m pytest tests/ -q -m "not slow"
 
 test: native               ## full suite (CPU, virtual 8-device mesh)
 	$(PY) -m pytest tests/ -q

@@ -1,0 +1,63 @@
+"""dtnlint — contract-checking static analysis for the kubedtn-tpu
+invariants.
+
+Five review rounds per PR kept re-finding violations of the same four
+contracts by hand; this package encodes them as machine-checkable
+passes (plus a hygiene floor), run as ``python -m kubedtn_tpu.analysis``
+and in tier-1 via ``tests/test_static_analysis.py``:
+
+========  ==============================================================
+rule      contract (waiver tag is ``<rule>-ok(reason)``)
+========  ==============================================================
+purity    no host effects (time/random/print/closure mutation) inside
+          jit/vmap/scan/shard_map-traced code
+key       every PRNG sample consumes a fresh split/fold_in product; no
+          key feeds two samplers (the PR 3 vmap-drift class)
+sync      no implicit device→host syncs (np.asarray/.item()/float()/
+          bool coercion) on the fused-tick/dispatch/complete hot paths
+lock      ``@guarded_by`` attributes only under ``with self.<lock>``
+          (static) + InstrumentedLock order-cycle detection (runtime)
+dtype     f32 casts on f64 clock anchors, f64 leaks into the f32 SoA
+          (the PR 3 ``clock_us`` freeze class)
+hygiene   unused imports, bare excepts, import-group order (the ruff
+          subset enforced even without ruff)
+========  ==============================================================
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from kubedtn_tpu.analysis.callgraph import CallGraph
+from kubedtn_tpu.analysis.core import (
+    ALL_RULES,
+    Finding,
+    Project,
+    apply_waivers,
+    summarize,
+    write_json,
+)
+from kubedtn_tpu.analysis.passes import PASSES
+
+__all__ = ["ALL_RULES", "Finding", "Project", "CallGraph", "PASSES",
+           "run_suite", "summarize", "write_json", "default_root"]
+
+
+def default_root() -> Path:
+    """The repo root (parent of the ``kubedtn_tpu`` package)."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def run_suite(root: Path | None = None,
+              rules: tuple[str, ...] | None = None,
+              packages: tuple[str, ...] = ("kubedtn_tpu",),
+              ) -> tuple[Project, list[Finding]]:
+    """Parse the tree, run the selected passes, apply waivers."""
+    root = root if root is not None else default_root()
+    project = Project(root, packages=packages)
+    graph = CallGraph(project)
+    findings: list[Finding] = []
+    for rule in (rules if rules is not None else tuple(PASSES)):
+        findings.extend(PASSES[rule](project, graph))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return project, apply_waivers(project, findings)

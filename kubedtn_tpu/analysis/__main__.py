@@ -1,0 +1,70 @@
+"""``python -m kubedtn_tpu.analysis`` — run dtnlint over the tree.
+
+Exit status 0 iff every finding is waived (``# dtnlint:
+<rule>-ok(reason)``). ``--json`` writes the machine-readable artifact
+(the tier-1 test writes ``ANALYSIS.json`` at the repo root so benches
+can track the findings-count trajectory).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from kubedtn_tpu.analysis import (
+    PASSES,
+    default_root,
+    run_suite,
+    summarize,
+    write_json,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kubedtn_tpu.analysis",
+        description="dtnlint: contract-checking static analysis for "
+                    "the determinism / key / host-sync / lock / dtype "
+                    "invariants")
+    ap.add_argument("--root", type=Path, default=None,
+                    help="repo root (default: the installed package's "
+                         "parent)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of: "
+                         + ",".join(PASSES))
+    ap.add_argument("--json", type=Path, default=None, metavar="PATH",
+                    help="write the machine-readable findings artifact")
+    ap.add_argument("--show-waived", action="store_true",
+                    help="print waived findings too")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="summary line only")
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = tuple(r.strip() for r in args.rules.split(",") if r.strip())
+        unknown = [r for r in rules if r not in PASSES]
+        if unknown:
+            ap.error(f"unknown rule(s): {', '.join(unknown)} "
+                     f"(have: {', '.join(PASSES)})")
+
+    root = args.root if args.root is not None else default_root()
+    _project, findings = run_suite(root=root, rules=rules)
+    if args.json is not None:
+        write_json(args.json, findings, root)
+
+    active = [f for f in findings if not f.waived]
+    if not args.quiet:
+        shown = findings if args.show_waived else active
+        for f in shown:
+            print(f.format())
+    s = summarize(findings)
+    by_rule = ", ".join(f"{k}={v}" for k, v in s["by_rule"].items())
+    print(f"dtnlint: {s['total']} finding(s), {s['waived']} waived, "
+          f"{s['unwaivered']} active ({by_rule or 'clean tree'})")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,233 @@
+"""Best-effort package-local call graph + traced-context discovery.
+
+The purity and host-sync passes need to know which functions execute
+*inside* a jax trace (jit / vmap / scan / shard_map bodies) or inside a
+configured hot path. Resolution is intentionally conservative and
+package-local:
+
+- bare names resolve to functions of the same module or explicit
+  ``from kubedtn_tpu.x import f`` imports;
+- dotted names resolve through ``import kubedtn_tpu.x as alias``
+  module aliases (one attribute hop);
+- ``self.method`` resolves to methods of the lexically enclosing class;
+- a trailing ``.__wrapped__`` (the repo's jit-unwrap idiom) is
+  stripped before resolution.
+
+Unresolvable calls are simply not followed — a static pass that guesses
+would drown the tree in false positives. Waivers cover the residue.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import deque
+from typing import Iterable
+
+from kubedtn_tpu.analysis.core import (
+    Project,
+    SourceFile,
+    call_name,
+    dotted,
+    iter_functions,
+)
+
+_FIRST_PARTY = "kubedtn_tpu"
+
+# callables whose function-valued arguments run under trace
+_TRACING_CALLS = {
+    "jax.jit", "jit", "jax.vmap", "vmap", "jax.pmap", "pmap",
+    "jax.lax.scan", "lax.scan", "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop", "jax.lax.cond", "lax.cond",
+    "jax.lax.switch", "lax.switch", "shard_map", "jax.checkpoint",
+    "jax.remat",
+}
+_TRACING_DECORATORS = {"jax.jit", "jit", "jax.vmap", "vmap", "jax.pmap"}
+
+
+@dataclasses.dataclass(frozen=True)
+class FuncRef:
+    """A function occurrence: (file rel path, qualname)."""
+    path: str
+    qual: str
+
+
+class CallGraph:
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        # (path, qualname) -> FunctionDef
+        self.functions: dict[FuncRef, ast.FunctionDef] = {}
+        # per file: alias -> module ("np" -> "numpy",
+        # "netem" -> "kubedtn_tpu.ops.netem") and
+        # name -> imported qualname ("shape_packets" ->
+        # "kubedtn_tpu.ops.queues.shape_packets")
+        self.module_aliases: dict[str, dict[str, str]] = {}
+        self.from_imports: dict[str, dict[str, str]] = {}
+        # qualname prefix of the class each method belongs to
+        for src in project:
+            self.module_aliases[src.rel] = {}
+            self.from_imports[src.rel] = {}
+            self._index_imports(src)
+            for qual, fn in iter_functions(src.tree):
+                self.functions[FuncRef(src.rel, qual)] = fn
+
+    # -- imports -------------------------------------------------------
+
+    def _index_imports(self, src: SourceFile) -> None:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    alias = al.asname or al.name.split(".")[0]
+                    self.module_aliases[src.rel][alias] = (
+                        al.name if al.asname else al.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mod = node.module
+                if node.level:  # relative import: anchor at the package
+                    base = src.module.rsplit(".", node.level)[0]
+                    mod = f"{base}.{mod}" if mod else base
+                for al in node.names:
+                    if al.name == "*":
+                        continue
+                    local = al.asname or al.name
+                    self.from_imports[src.rel][local] = f"{mod}.{al.name}"
+
+    def _module_file(self, module: str) -> SourceFile | None:
+        if not module.startswith(_FIRST_PARTY):
+            return None
+        return self.project.by_module(module)
+
+    # -- resolution ----------------------------------------------------
+
+    def resolve(self, src: SourceFile, scope_qual: str,
+                name: str) -> FuncRef | None:
+        """Resolve a (possibly dotted) callee name seen inside
+        ``scope_qual`` of ``src`` to a package function."""
+        if name.endswith(".__wrapped__"):
+            name = name[: -len(".__wrapped__")]
+        parts = name.split(".")
+        # self.method -> method of the enclosing class
+        if parts[0] == "self" and len(parts) == 2:
+            cls = scope_qual.split(".")[0]
+            ref = FuncRef(src.rel, f"{cls}.{parts[1]}")
+            return ref if ref in self.functions else None
+        if len(parts) == 1:
+            # the current scope's own nested defs first, then sibling
+            # nested functions, then module-level, then a from-import
+            ref = FuncRef(src.rel, f"{scope_qual}.<locals>.{parts[0]}")
+            if ref in self.functions:
+                return ref
+            if "." in scope_qual:
+                parent = scope_qual.rsplit(".", 1)[0]
+                ref = FuncRef(src.rel, f"{parent}.{parts[0]}")
+                if ref in self.functions:
+                    return ref
+            ref = FuncRef(src.rel, parts[0])
+            if ref in self.functions:
+                return ref
+            target = self.from_imports[src.rel].get(parts[0])
+            if target:
+                mod, _, fn = target.rpartition(".")
+                f = self._module_file(mod)
+                if f is not None:
+                    ref = FuncRef(f.rel, fn)
+                    return ref if ref in self.functions else None
+            return None
+        # module_alias.func  (one attribute hop)
+        mod = self.module_aliases[src.rel].get(parts[0])
+        if mod is None:
+            target = self.from_imports[src.rel].get(parts[0])
+            if target:  # `from kubedtn_tpu.ops import netem` style
+                mod = target
+        if mod is not None and len(parts) == 2:
+            f = self._module_file(mod)
+            if f is not None:
+                ref = FuncRef(f.rel, parts[1])
+                return ref if ref in self.functions else None
+        return None
+
+    # -- traced roots --------------------------------------------------
+
+    def traced_roots(self) -> set[FuncRef]:
+        """Every function that runs under a jax trace: jit-decorated
+        functions and functions passed (by name) to jit/vmap/scan/
+        shard_map call sites anywhere in the package."""
+        roots: set[FuncRef] = set()
+        for src in self.project:
+            for qual, fn in iter_functions(src.tree):
+                for dec in fn.decorator_list:
+                    if self._is_tracing_decorator(dec):
+                        roots.add(FuncRef(src.rel, qual))
+            for qual, fn in iter_functions(src.tree):
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    cn = call_name(node)
+                    if cn is None:
+                        continue
+                    is_tracer = (cn in _TRACING_CALLS
+                                 or cn.split(".")[-1] == "shard_map")
+                    if cn in ("functools.partial", "partial"):
+                        # partial(jax.jit, ...)(f) — treat the partial's
+                        # first arg being a tracer as a tracing call
+                        if node.args and isinstance(
+                                node.args[0], (ast.Name, ast.Attribute)):
+                            first = dotted(node.args[0])
+                            is_tracer = first in _TRACING_CALLS
+                    if not is_tracer:
+                        continue
+                    for arg in [*node.args,
+                                *(kw.value for kw in node.keywords)]:
+                        tgt = dotted(arg)
+                        if tgt is None:
+                            continue
+                        ref = self.resolve(src, qual, tgt)
+                        if ref is not None:
+                            roots.add(ref)
+        return roots
+
+    def _is_tracing_decorator(self, dec: ast.AST) -> bool:
+        name = dotted(dec)
+        if name in _TRACING_DECORATORS:
+            return True
+        if isinstance(dec, ast.Call):
+            cn = call_name(dec)
+            if cn in _TRACING_DECORATORS:
+                return True
+            if cn in ("functools.partial", "partial") and dec.args:
+                return dotted(dec.args[0]) in _TRACING_DECORATORS
+        return False
+
+    # -- closure -------------------------------------------------------
+
+    def closure(self, roots: Iterable[FuncRef],
+                max_depth: int = 6) -> set[FuncRef]:
+        """Roots plus everything reachable through resolvable calls and
+        lexically nested defs (nested functions execute at trace time)."""
+        seen: set[FuncRef] = set()
+        work: deque[tuple[FuncRef, int]] = deque(
+            (r, 0) for r in roots if r in self.functions)
+        while work:
+            ref, depth = work.popleft()
+            if ref in seen:
+                continue
+            seen.add(ref)
+            # nested defs belong to the traced scope
+            prefix = f"{ref.qual}.<locals>."
+            for other in self.functions:
+                if other.path == ref.path and \
+                        other.qual.startswith(prefix) and \
+                        other not in seen:
+                    work.append((other, depth))
+            if depth >= max_depth:
+                continue
+            src = self.project.files[ref.path]
+            fn = self.functions[ref]
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    cn = call_name(node)
+                    if cn is None:
+                        continue
+                    tgt = self.resolve(src, ref.qual, cn)
+                    if tgt is not None and tgt not in seen:
+                        work.append((tgt, depth + 1))
+        return seen

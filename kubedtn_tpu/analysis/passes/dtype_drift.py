@@ -1,0 +1,153 @@
+"""Pass 5 — dtype-drift: f32 casts on f64 anchors, f64 leaks into f32
+columns.
+
+The PR 3 ``clock_us`` freeze: a wall-clock anchor kept as float32
+stops advancing once ``dt * ulp`` rounds to zero (~2.4 h of uptime at
+µs resolution) — the fix pinned the host-side anchor to float64. The
+inverse leak also bites: a Python float / ``np.float64`` intermediate
+scattered into an f32 SoA column silently downcasts (fine) *per
+element* but drifts when it is an accumulator. Rules:
+
+- **anchor-f32**: a configured f64 anchor name (``clock_us`` et al.)
+  cast or constructed as float32 — the freeze bug class verbatim;
+- **column-f64**: a ``.at[...].set/add`` (or keyword construction) of
+  a known f32 SoA column fed by ``np.float64(...)`` / ``time.*()``
+  without an explicit f32 cast;
+- **f64-dtype-in-kernel**: a ``float64`` dtype request inside the
+  device-kernel modules (the SoA is f32 by contract; x64 is disabled
+  and the request silently yields f32 — stating an intent the runtime
+  ignores).
+
+Waiver: ``# dtnlint: dtype-ok(reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubedtn_tpu.analysis.core import (
+    RULE_DTYPE,
+    Finding,
+    Project,
+    call_name,
+    dotted,
+)
+
+# host-side wall-clock anchors that must stay float64
+ANCHOR_NAMES = {"clock_us", "clock0_us", "origin_us", "anchor_us"}
+
+# modules whose arrays are the f32 device SoA: float64 dtype requests
+# there are either silently ignored (x64 off) or a host leak
+KERNEL_MODULES = (
+    "kubedtn_tpu/ops/edge_state.py",
+    "kubedtn_tpu/ops/netem.py",
+    "kubedtn_tpu/ops/queues.py",
+    "kubedtn_tpu/ops/routing.py",
+    "kubedtn_tpu/ops/pallas/shaping.py",
+)
+
+_F32_CASTS = {"np.float32", "numpy.float32", "jnp.float32"}
+_F64_MAKERS = {"np.float64", "numpy.float64"}
+_TIME_CALLS = {"time.time", "time.monotonic", "time.perf_counter"}
+
+
+def run(project: Project, graph: object = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in project:
+        findings.extend(_check_file(src))
+    return findings
+
+
+def _mentions_anchor(node: ast.AST) -> str | None:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in ANCHOR_NAMES:
+            return n.id
+        if isinstance(n, ast.Attribute) and n.attr in ANCHOR_NAMES:
+            return n.attr
+    return None
+
+
+def _is_f32_expr(node: ast.AST) -> bool:
+    """Explicit float32 cast/construction?"""
+    if isinstance(node, ast.Call):
+        cn = call_name(node)
+        if cn in _F32_CASTS:
+            return True
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "astype":
+            return _names_f32(node.args[0]) if node.args else False
+        # np.asarray(x, np.float32) / jnp.zeros(shape, jnp.float32)
+        for arg in [*node.args[1:], *(kw.value for kw in node.keywords
+                                      if kw.arg == "dtype")]:
+            if _names_f32(arg):
+                return True
+    return False
+
+
+def _names_f32(node: ast.AST) -> bool:
+    d = dotted(node)
+    if d in _F32_CASTS:
+        return True
+    return isinstance(node, ast.Constant) and node.value == "float32"
+
+
+def _names_f64(node: ast.AST) -> bool:
+    d = dotted(node)
+    if d in _F64_MAKERS:
+        return True
+    return isinstance(node, ast.Constant) and node.value == "float64"
+
+
+def _check_file(src) -> list[Finding]:
+    out: list[Finding] = []
+    in_kernel = src.rel in KERNEL_MODULES
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call):
+            cn = call_name(node)
+            # anchor-f32: float32 cast whose payload or binding mentions
+            # a clock anchor
+            if _is_f32_expr(node):
+                anchor = _mentions_anchor(node)
+                if anchor is not None:
+                    out.append(Finding(
+                        RULE_DTYPE, src.rel, node.lineno,
+                        f"f32 cast/construction touching f64 clock "
+                        f"anchor `{anchor}` — the `clock_us` freeze "
+                        f"bug class (anchors stop advancing once "
+                        f"dt < ulp/2)"))
+            # anchor passed as keyword into a constructor while cast f32
+            if cn is not None:
+                for kw in node.keywords:
+                    if kw.arg in ANCHOR_NAMES and _is_f32_expr(kw.value):
+                        out.append(Finding(
+                            RULE_DTYPE, src.rel, kw.value.lineno,
+                            f"`{kw.arg}=` constructed as float32 in "
+                            f"`{cn}(...)` — f64 anchor contract"))
+            # column-f64: .at[...].set/add fed by float64 / wall clock
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("set", "add") and node.args:
+                payload = node.args[0]
+                for n in ast.walk(payload):
+                    if isinstance(n, ast.Call):
+                        pcn = call_name(n)
+                        if pcn in _F64_MAKERS or pcn in _TIME_CALLS:
+                            out.append(Finding(
+                                RULE_DTYPE, src.rel, node.lineno,
+                                f"`{pcn}(...)` feeds an f32 column "
+                                f"scatter — implicit f64→f32 downcast; "
+                                f"cast explicitly or keep host-side"))
+                            break
+            # f64 dtype requests inside kernel modules
+            if in_kernel and cn is not None:
+                f64 = (cn in _F64_MAKERS
+                       or any(_names_f64(kw.value) for kw in node.keywords
+                              if kw.arg == "dtype")
+                       or any(_names_f64(a) for a in node.args[1:]))
+                if f64:
+                    out.append(Finding(
+                        RULE_DTYPE, src.rel, node.lineno,
+                        f"float64 dtype request in kernel module "
+                        f"(`{cn}`): the SoA contract is f32 and x64 "
+                        f"is disabled — the request is a silent no-op "
+                        f"or a host leak"))
+    return out

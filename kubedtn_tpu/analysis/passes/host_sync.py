@@ -1,0 +1,200 @@
+"""Pass 3 — host-sync leaks: no implicit device→host syncs on hot paths.
+
+The fused tick's contract is ONE async dispatch per tick; any
+``np.asarray`` / ``.item()`` / ``float()`` / ``bool()`` coercion on a
+jax array inside the dispatch path blocks the host on the device and
+serializes the pipeline. The completion side (``_complete``) is the
+*designated* sync point and carries a def-level waiver saying so —
+everything else must stay future-shaped.
+
+Mechanics: the pass seeds from the configured hot-path roots, takes
+the same-module call closure, and runs a light device-taint over each
+function: parameters with device-ish names, results of ``jnp.*`` /
+``jax.*`` calls, and attribute/subscript projections of tainted names
+are device values; ``np.asarray``/``np.array``/``.item()``/
+``jax.device_get``/``block_until_ready`` on them — or ``float``/
+``int``/``bool``/``if``-tests over them — are findings. Untainted
+arguments (host lists, native-ring byte counts) pass untouched.
+
+Waiver: ``# dtnlint: sync-ok(reason)`` — line-level or on the ``def``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubedtn_tpu.analysis.callgraph import CallGraph, FuncRef
+from kubedtn_tpu.analysis.core import (
+    RULE_SYNC,
+    Finding,
+    Project,
+    call_name,
+)
+
+# (file rel path, qualname) roots of the fused-tick / dispatch /
+# complete hot paths. The closure over same-module resolvable calls
+# extends each root.
+HOT_ROOTS: tuple[tuple[str, str], ...] = (
+    ("kubedtn_tpu/runtime.py", "_fused_tick"),
+    ("kubedtn_tpu/runtime.py", "_class_tick"),
+    ("kubedtn_tpu/runtime.py", "_shape_class"),
+    ("kubedtn_tpu/runtime.py", "_tel_class"),
+    ("kubedtn_tpu/runtime.py", "_roll_clocks"),
+    ("kubedtn_tpu/runtime.py", "WireDataPlane._tick_inner"),
+    ("kubedtn_tpu/runtime.py", "WireDataPlane._dispatch"),
+    ("kubedtn_tpu/runtime.py", "WireDataPlane._dispatch_inner"),
+    ("kubedtn_tpu/runtime.py", "WireDataPlane._complete"),
+    ("kubedtn_tpu/runtime.py", "WireDataPlane._complete_or_requeue"),
+    ("kubedtn_tpu/runtime.py", "WireDataPlane._release"),
+    ("kubedtn_tpu/telemetry.py", "tel_matrix"),
+    ("kubedtn_tpu/telemetry.py", "tel_accumulate"),
+)
+
+# parameter names that carry device values on the hot paths
+_DEVICE_PARAMS = {"state", "dyn", "key", "sub", "tel", "acc", "res",
+                  "sim", "edges"}
+# attribute names that hold device values wherever they appear
+# (engine.state, self._pipe_state, job.outs, state.props, ...)
+_DEVICE_ATTRS = {"state", "_state", "_pipe_state", "props", "outs",
+                 "tokens", "t_last", "backlog_until", "corr",
+                 "pkt_count", "counters", "dropped_ring"}
+_DEVICE_CALL_PREFIXES = ("jnp.", "jax.", "lax.")
+_SYNC_METHODS = {"item", "block_until_ready", "tolist", "copy_to_host"}
+_MATERIALIZERS = {"np.asarray", "np.array", "numpy.asarray",
+                  "numpy.array", "jax.device_get", "device_get",
+                  "np.ascontiguousarray"}
+_COERCIONS = {"float", "int", "bool"}
+# array metadata: reading these never transfers
+_META_ATTRS = {"shape", "dtype", "ndim", "size", "capacity", "sharding"}
+
+
+def run(project: Project, graph: CallGraph,
+        hot_roots: tuple[tuple[str, str], ...] | None = None,
+        ) -> list[Finding]:
+    findings: list[Finding] = []
+    roots = [FuncRef(p, q) for p, q in (hot_roots if hot_roots is not None
+                                        else HOT_ROOTS)
+             if FuncRef(p, q) in graph.functions]
+    hot = {ref for ref in graph.closure(roots)
+           if any(ref.path == r.path for r in roots)}
+    for ref in sorted(hot, key=lambda r: (r.path, r.qual)):
+        findings.extend(_check_function(
+            project, graph.functions[ref], ref))
+    return findings
+
+
+def _check_function(project: Project, fn: ast.FunctionDef,
+                    ref: FuncRef) -> list[Finding]:
+    out: list[Finding] = []
+    tainted: set[str] = set()
+    for a in (*fn.args.posonlyargs, *fn.args.args, *fn.args.kwonlyargs):
+        if a.arg in _DEVICE_PARAMS:
+            tainted.add(a.arg)
+
+    def expr_tainted(node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id in tainted:
+                return True
+            if isinstance(n, ast.Attribute) and n.attr in _DEVICE_ATTRS:
+                return True
+            if isinstance(n, ast.Call):
+                cn = call_name(n)
+                if cn and (cn.startswith(_DEVICE_CALL_PREFIXES)):
+                    return True
+        return False
+
+    def materializes(value: ast.AST) -> bool:
+        """RHS that yields host data even from a device operand: an
+        explicit materializer/coercion call (the sync is flagged at its
+        own line; downstream is free), array *metadata* (shape/
+        capacity/dtype — no transfer), or an identity/membership test
+        (a host bool)."""
+        if isinstance(value, ast.Call):
+            cn = call_name(value)
+            if cn in _MATERIALIZERS or cn in _COERCIONS:
+                return True
+        if isinstance(value, ast.Attribute) and \
+                value.attr in _META_ATTRS:
+            return True
+        if isinstance(value, ast.Subscript) and \
+                isinstance(value.value, ast.Attribute) and \
+                value.value.attr in _META_ATTRS:
+            return True  # state.props.shape[0]
+        if isinstance(value, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in value.ops):
+            return True
+        return False
+
+    # forward pass in source order: propagate taint through assignments
+    for node in _own_nodes_ordered(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        goes_device = expr_tainted(node.value) and \
+            not materializes(node.value)
+        for t in node.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name) and \
+                        isinstance(n.ctx, ast.Store):
+                    (tainted.add if goes_device
+                     else tainted.discard)(n.id)
+
+    for node in _own_nodes_ordered(fn):
+        if isinstance(node, ast.Call):
+            cn = call_name(node)
+            if cn in _MATERIALIZERS and node.args and \
+                    expr_tainted(node.args[0]):
+                out.append(Finding(
+                    RULE_SYNC, ref.path, node.lineno,
+                    f"`{cn}` on a device value in hot `{ref.qual}` — "
+                    f"blocks the host on the dispatch"))
+            elif cn in _COERCIONS and node.args and \
+                    expr_tainted(node.args[0]):
+                out.append(Finding(
+                    RULE_SYNC, ref.path, node.lineno,
+                    f"`{cn}()` coerces a device value in hot "
+                    f"`{ref.qual}` — implicit device→host sync"))
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SYNC_METHODS and \
+                    expr_tainted(node.func.value):
+                out.append(Finding(
+                    RULE_SYNC, ref.path, node.lineno,
+                    f"`.{node.func.attr}()` on a device value in hot "
+                    f"`{ref.qual}` — implicit device→host sync"))
+        elif isinstance(node, (ast.If, ast.While)):
+            test = node.test
+            if isinstance(test, (ast.Name, ast.Compare, ast.UnaryOp)) \
+                    and expr_tainted(test) and _is_bare_device_test(test,
+                                                                    tainted):
+                out.append(Finding(
+                    RULE_SYNC, ref.path, test.lineno,
+                    f"branching on a device value in hot "
+                    f"`{ref.qual}` — bool coercion syncs the host"))
+    return out
+
+
+def _is_bare_device_test(test: ast.AST, tainted: set[str]) -> bool:
+    """Only flag direct truthiness of a tainted NAME (or `not name` /
+    comparison against one) — `if rows is None` style identity tests
+    never sync."""
+    if isinstance(test, ast.Name):
+        return test.id in tainted
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_bare_device_test(test.operand, tainted)
+    if isinstance(test, ast.Compare):
+        if any(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+               for op in test.ops):
+            return False
+        return any(isinstance(n, ast.Name) and n.id in tainted
+                   for n in [test.left, *test.comparators])
+    return False
+
+
+def _own_nodes_ordered(fn: ast.FunctionDef):
+    stack = list(reversed(list(ast.iter_child_nodes(fn))))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))

@@ -1,0 +1,211 @@
+"""Pass 2 — key-discipline: every PRNG sample comes off a fresh key.
+
+The PR 3 vmap-drift bug class: a key that feeds two sampling calls (or
+a raw ``jax.random.key(seed)`` handed straight into a sampling path)
+produces correlated draws — replicas that should be independent share
+entropy, and a replayed schedule silently diverges from the reference
+program. Contract, per function:
+
+- a sampling call's key must be a ``split``/``fold_in`` product, a key
+  parameter (the leaf-kernel idiom — the caller did the split), or key
+  state split in place;
+- no key name feeds two sampling calls without a rebinding between;
+- a sampler inside a loop must not reuse a loop-invariant key;
+- a raw root key (``jax.random.key(...)`` / ``PRNGKey(...)``) must
+  pass through ``split``/``fold_in`` before any other call consumes it.
+
+Waiver: ``# dtnlint: key-ok(reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubedtn_tpu.analysis.core import (
+    RULE_KEY,
+    Finding,
+    Project,
+    call_name,
+    iter_functions,
+)
+
+_SAMPLERS = {
+    "uniform", "normal", "bernoulli", "poisson", "randint", "choice",
+    "categorical", "gamma", "beta", "exponential", "truncated_normal",
+    "gumbel", "laplace", "cauchy", "dirichlet", "permutation",
+    "shuffle", "bits", "rademacher", "t", "loggamma", "multivariate_normal",
+}
+_KEY_OPS = {"split", "fold_in", "clone"}
+_KEY_ROOTS = {"key", "PRNGKey"}
+
+
+def _random_call_kind(cn: str | None) -> str | None:
+    """'sampler' | 'keyop' | 'root' for a jax.random.* call name."""
+    if cn is None:
+        return None
+    parts = cn.split(".")
+    tail = parts[-1]
+    if len(parts) >= 2 and parts[-2] == "random" or \
+            (len(parts) == 2 and parts[0] in ("jrandom", "jr")):
+        if tail in _SAMPLERS:
+            return "sampler"
+        if tail in _KEY_OPS:
+            return "keyop"
+        if tail in _KEY_ROOTS:
+            return "root"
+    return None
+
+
+def run(project: Project, graph: object = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in project:
+        for qual, fn in iter_functions(src.tree):
+            findings.extend(_check_function(src.rel, qual, fn))
+    return findings
+
+
+def _check_function(path: str, qual: str,
+                    fn: ast.FunctionDef) -> list[Finding]:
+    out: list[Finding] = []
+    params = {a.arg for a in (*fn.args.posonlyargs, *fn.args.args,
+                              *fn.args.kwonlyargs)}
+
+    # name -> list of (lineno, origin) bindings in source order, where
+    # origin is 'derived' (split/fold_in product), 'root'
+    # (jax.random.key/PRNGKey), or 'other'
+    binds: dict[str, list[tuple[int, str]]] = {}
+    sampler_uses: dict[str, list[int]] = {}
+    loop_spans: list[tuple[int, int, set[str]]] = []  # start, end, rebound
+
+    def origin_of(value: ast.AST) -> str:
+        if isinstance(value, ast.Call):
+            kind = _random_call_kind(call_name(value))
+            if kind == "keyop":
+                return "derived"
+            if kind == "root":
+                return "root"
+        return "other"
+
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Assign):
+            org = origin_of(node.value)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    binds.setdefault(t.id, []).append((node.lineno, org))
+                elif isinstance(t, ast.Tuple):
+                    # k1, k2 = split(key): every element is derived
+                    for el in t.elts:
+                        if isinstance(el, ast.Name):
+                            binds.setdefault(el.id, []).append(
+                                (node.lineno,
+                                 org if org != "other" else "other"))
+        elif isinstance(node, (ast.For, ast.While)):
+            rebound: set[str] = set()
+            if isinstance(node, ast.For):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        rebound.add(n.id)
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                    rebound.add(n.id)
+            loop_spans.append((node.lineno, node.end_lineno or node.lineno,
+                               rebound))
+
+    def key_arg(call: ast.Call) -> ast.AST | None:
+        for kw in call.keywords:
+            if kw.arg == "key":
+                return kw.value
+        return call.args[0] if call.args else None
+
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        cn = call_name(node)
+        kind = _random_call_kind(cn)
+        if kind == "sampler":
+            k = key_arg(node)
+            if k is None:
+                continue
+            if isinstance(k, ast.Call):
+                kk = _random_call_kind(call_name(k))
+                if kk == "root":
+                    out.append(Finding(
+                        RULE_KEY, path, node.lineno,
+                        f"`{cn}` in `{qual}` consumes a raw "
+                        f"`jax.random.key(...)` — fold a purpose in "
+                        f"(`fold_in`/`split`) before sampling"))
+                # keyop call inline: derived, fine
+                continue
+            name = k.id if isinstance(k, ast.Name) else None
+            if name is None:
+                continue  # attribute/subscript keys: trust the carrier
+            sampler_uses.setdefault(name, []).append(node.lineno)
+            last = _last_bind(binds.get(name, []), node.lineno)
+            if last == "root":
+                out.append(Finding(
+                    RULE_KEY, path, node.lineno,
+                    f"`{cn}` in `{qual}` samples from root key "
+                    f"`{name}` — derive a subkey via `split`/"
+                    f"`fold_in` first"))
+            elif last is None and name not in params:
+                out.append(Finding(
+                    RULE_KEY, path, node.lineno,
+                    f"`{cn}` in `{qual}` samples from `{name}`, which "
+                    f"is neither a parameter nor a `split`/`fold_in` "
+                    f"product in this scope"))
+            # loop-invariant reuse
+            for start, end, rebound in loop_spans:
+                if start <= node.lineno <= end and name not in rebound:
+                    out.append(Finding(
+                        RULE_KEY, path, node.lineno,
+                        f"`{cn}` in `{qual}` reuses loop-invariant "
+                        f"key `{name}` across iterations — every pass "
+                        f"draws the same bits"))
+                    break
+        elif kind is None and cn is not None:
+            # raw root key passed into an arbitrary call (the sampling
+            # path continues inside): jax.random.key(...) as an argument
+            for arg in [*node.args, *(kw.value for kw in node.keywords)]:
+                if isinstance(arg, ast.Call) and \
+                        _random_call_kind(call_name(arg)) == "root":
+                    out.append(Finding(
+                        RULE_KEY, path, node.lineno,
+                        f"raw `jax.random.key(...)` passed directly "
+                        f"into `{cn}` in `{qual}` — two call sites "
+                        f"with the same seed collide; `fold_in` a "
+                        f"purpose first"))
+
+    # a key name feeding two samplers with no rebinding in between
+    for name, uses in sampler_uses.items():
+        if len(uses) < 2:
+            continue
+        uses = sorted(uses)
+        rebinds = sorted(ln for ln, _ in binds.get(name, []))
+        for a, b in zip(uses, uses[1:]):
+            if not any(a < r <= b for r in rebinds):
+                out.append(Finding(
+                    RULE_KEY, path, b,
+                    f"key `{name}` feeds a second sampling call in "
+                    f"`{qual}` (first at line {a}) without an "
+                    f"intervening `split`/`fold_in` rebinding — "
+                    f"identical draws"))
+    return out
+
+
+def _last_bind(bindings: list[tuple[int, str]],
+               before: int) -> str | None:
+    last: str | None = None
+    for ln, org in sorted(bindings):
+        if ln <= before:
+            last = org
+    return last
+
+
+def _own_nodes(fn: ast.FunctionDef):
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

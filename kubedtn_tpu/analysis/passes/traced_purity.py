@@ -1,0 +1,104 @@
+"""Pass 1 — traced-purity: no host-side effects inside traced code.
+
+A function that runs under ``jax.jit`` / ``vmap`` / ``lax.scan`` /
+``shard_map`` executes its Python body once, at trace time; host
+effects inside it (wall-clock reads, host RNG draws, prints, mutation
+of closed-over containers) either bake a trace-time value into the
+compiled program or fire on a schedule unrelated to execution — both
+silently break the byte-identical-delivery contract. This pass walks
+the call-graph closure of every traced entry point and flags the
+banned effects. Waiver: ``# dtnlint: purity-ok(reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubedtn_tpu.analysis.callgraph import CallGraph
+from kubedtn_tpu.analysis.core import (
+    RULE_PURITY,
+    Finding,
+    Project,
+    call_name,
+    local_bindings,
+)
+
+# dotted-prefix -> human reason
+_BANNED_PREFIXES = {
+    "time.": "wall-clock read bakes a trace-time constant",
+    "random.": "host RNG draws once at trace time",
+    "np.random.": "host RNG draws once at trace time",
+    "numpy.random.": "host RNG draws once at trace time",
+    "os.urandom": "host RNG draws once at trace time",
+}
+_BANNED_CALLS = {
+    "print": "host I/O inside a traced function",
+    "open": "host I/O inside a traced function",
+    "input": "host I/O inside a traced function",
+}
+_MUTATORS = {"append", "extend", "insert", "update", "setdefault",
+             "pop", "popleft", "appendleft", "clear", "remove",
+             "add", "discard"}
+
+
+def run(project: Project, graph: CallGraph) -> list[Finding]:
+    findings: list[Finding] = []
+    traced = graph.closure(graph.traced_roots())
+    for ref in sorted(traced, key=lambda r: (r.path, r.qual)):
+        src = project.files[ref.path]
+        fn = graph.functions[ref]
+        local = local_bindings(fn)
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Call):
+                cn = call_name(node)
+                if cn is None:
+                    continue
+                reason = _BANNED_CALLS.get(cn)
+                if reason is None:
+                    for pref, why in _BANNED_PREFIXES.items():
+                        if cn == pref.rstrip(".") or cn.startswith(pref):
+                            reason = why
+                            break
+                if reason is not None:
+                    findings.append(Finding(
+                        RULE_PURITY, ref.path, node.lineno,
+                        f"`{cn}` in traced `{ref.qual}`: {reason}"))
+                    continue
+                # mutation of a closed-over / global container
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in _MUTATORS and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id not in local:
+                    findings.append(Finding(
+                        RULE_PURITY, ref.path, node.lineno,
+                        f"`{f.value.id}.{f.attr}(...)` mutates a "
+                        f"closed-over container inside traced "
+                        f"`{ref.qual}` — effects fire at trace time, "
+                        f"not per step"))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id not in local:
+                        findings.append(Finding(
+                            RULE_PURITY, ref.path, node.lineno,
+                            f"subscript store into closed-over "
+                            f"`{t.value.id}` inside traced "
+                            f"`{ref.qual}` — mutation happens at "
+                            f"trace time"))
+    return findings
+
+
+def _own_nodes(fn: ast.FunctionDef):
+    """Walk `fn` without descending into nested defs (those are traced
+    scopes of their own and get their own findings)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

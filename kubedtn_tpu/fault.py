@@ -19,6 +19,9 @@ from __future__ import annotations
 import random
 import threading
 import time
+from typing import Callable
+
+from kubedtn_tpu.contracts import guarded_by
 
 # CircuitBreaker states (exported through kubedtn_peer_breaker_state).
 CLOSED = 0
@@ -42,7 +45,7 @@ class CircuitBreaker:
     def __init__(self, failure_threshold: int = 3,
                  reset_timeout_s: float = 0.25,
                  max_reset_timeout_s: float = 10.0,
-                 clock=time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self.failure_threshold = max(1, int(failure_threshold))
         self.base_reset_timeout_s = float(reset_timeout_s)
         self.max_reset_timeout_s = float(max_reset_timeout_s)
@@ -134,6 +137,7 @@ class Backoff:
         self.attempt = 0
 
 
+@guarded_by("_lock", "_last", "_suppressed")
 class RateLimitedLog:
     """At-most-one-log-per-interval gate. `ready()` returns (fire,
     suppressed_since_last): persistent failures at data-plane cadence
@@ -141,7 +145,7 @@ class RateLimitedLog:
     status code must still reach the log."""
 
     def __init__(self, min_interval_s: float = 1.0,
-                 clock=time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self.min_interval_s = min_interval_s
         self._clock = clock
         self._last = -float("inf")

@@ -24,7 +24,6 @@ delivered packets across multiple hops (see kubedtn_tpu.ops.routing).
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 
 import jax
 import jax.numpy as jnp

@@ -17,7 +17,10 @@ are exactly the rows whose state rides the ring exchange
 
 from __future__ import annotations
 
+from typing import Any
+
 import numpy as np
+import numpy.typing as npt
 
 __all__ = ["shard_ranges", "shard_of_rows", "colocation_stats",
            "mailbox_layout", "pick_pair_rows"]
@@ -33,7 +36,8 @@ def shard_ranges(capacity: int, n_shards: int) -> list[tuple[int, int]]:
     return [(s * loc, (s + 1) * loc) for s in range(n_shards)]
 
 
-def shard_of_rows(rows, capacity: int, n_shards: int) -> np.ndarray:
+def shard_of_rows(rows: npt.ArrayLike, capacity: int,
+                  n_shards: int) -> np.ndarray:
     """Owner shard per row (block sharding)."""
     loc = capacity // n_shards
     return np.asarray(rows, np.int64) // loc
@@ -66,7 +70,7 @@ def pick_pair_rows(free: list[int], capacity: int, n_shards: int,
     return r1, free.pop()
 
 
-def colocation_stats(engine, n_shards: int) -> dict:
+def colocation_stats(engine: Any, n_shards: int) -> dict[str, object]:
     """Partition quality of the CURRENT topology: per-shard active edge
     counts, load imbalance (max/mean - 1 over non-empty planes), and
     the fraction of peered links whose two directed rows share a shard
@@ -108,8 +112,8 @@ def colocation_stats(engine, n_shards: int) -> dict:
     }
 
 
-def mailbox_layout(src_rows, dst_rows, capacity: int,
-                   n_shards: int) -> dict:
+def mailbox_layout(src_rows: npt.ArrayLike, dst_rows: npt.ArrayLike,
+                   capacity: int, n_shards: int) -> dict[str, object]:
     """Per-ordered-neighbor-pair mailbox slot counts for one tick's
     busy rows: src_rows are the rows with traffic, dst_rows the peer
     (destination) edge rows (-1 = unknown/none). Returns the non-zero

@@ -26,7 +26,6 @@ traffic the way the reference's gRPC channel capacity bounds its wires.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -34,11 +33,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kubedtn_tpu.models.traffic import TrafficSpec, generate
 from kubedtn_tpu.ops import netem
-from kubedtn_tpu.ops.edge_state import EdgeState
 from kubedtn_tpu.ops.queues import insert_inflight, pop_due, shape_packets
 from kubedtn_tpu.parallel.mesh import EDGE_AXIS, shard_map
 from kubedtn_tpu.router import RouterState, _group_into_lanes
-from kubedtn_tpu.sim import SimState, _add, init_sim
+from kubedtn_tpu.sim import SimState, _add
 
 
 def _edge_specs(rs: RouterState, n_shards: int):

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from kubedtn_tpu.models.traffic import TrafficSpec, generate
 from kubedtn_tpu.ops import netem
-from kubedtn_tpu.ops.queues import init_inflight, insert_inflight, pop_due
+from kubedtn_tpu.ops.queues import insert_inflight, pop_due
 from kubedtn_tpu.ops.queues import shape_packets
 from kubedtn_tpu.sim import SimState, _add, init_sim
 

@@ -135,6 +135,7 @@ import numpy as np
 
 from kubedtn_tpu import fault, native
 from kubedtn_tpu import telemetry as tele
+from kubedtn_tpu.contracts import guarded_by, requires_lock
 from kubedtn_tpu.ops import netem
 from kubedtn_tpu.ops.queues import EdgeCounters, init_counters
 from kubedtn_tpu.wire.server import FrameSeg, flatten_frames
@@ -275,6 +276,8 @@ class _RemoteStage:
         return self._ring.dropped if self._ring is not None else 0
 
 
+@guarded_by("_lock", "_batches", "_queued", "_pending", "dropped",
+            "_traced", "_pos_enq", "_pos_done")
 class _PeerSender:
     """One bounded queue + sender thread per peer daemon.
 
@@ -374,7 +377,9 @@ class _PeerSender:
     def buffered(self) -> int:
         """Frames currently held (queued + awaiting retry) — the outage
         buffer's fill level."""
-        return self._queued + self._pending
+        with self._lock:  # the two counters move together under it; an
+            # unlocked sum can tear across a drain and go negative
+            return self._queued + self._pending
 
     def _recorder(self):
         return getattr(self.daemon, "recorder", None)
@@ -413,8 +418,9 @@ class _PeerSender:
 
     def _traced_in_flight(self, upto: int):
         """Traced entries among the next `upto` unresolved frames."""
-        limit = self._pos_done + upto
-        with self._lock:
+        with self._lock:  # _pos_done moves with _traced; reading it
+            # outside can pair a stale base with a newer deque
+            limit = self._pos_done + upto
             return [e for e in self._traced if e[0] < limit]
 
     def _advance_traced(self, n: int, stage: str, **detail) -> None:
@@ -1197,6 +1203,16 @@ class _ShapeJob:
         self.has_tel = False
 
 
+# Tick-state ownership: everything the dispatch/complete/release path
+# mutates is owned by the re-entrant _tick_lock. Public counters
+# (ticks/shaped/dropped/...) are deliberately NOT listed: they are
+# single-writer (the tick thread) and metrics scrapes tolerate torn
+# reads — the contract ARCHITECTURE.md documents.
+@guarded_by("_tick_lock", "_holdback", "_pending", "_bseq", "_inflight",
+            "_pipe_state", "_key", "_heap", "_seq", "_need_resync",
+            "_chain_shaped_s", "_last_shaped_s", "_origin_s",
+            "_disp_items", "_disp_decided", "_disp_samples",
+            "_disp_samp_adv", "_drain_budget", "_props_cache")
 class WireDataPlane:
     """Shapes wire frames through the engine's edge state in real time."""
 
@@ -1602,6 +1618,7 @@ class WireDataPlane:
         with self._tick_lock:
             return self._tick_inner(now_s)
 
+    @requires_lock("_tick_lock")
     def _complete_or_requeue(self, job: _ShapeJob) -> int:
         """_complete with the zero-frame-loss guarantee: a completion
         failure (a device error surfacing at the sync point — the very
@@ -1791,6 +1808,7 @@ class WireDataPlane:
                          bytes(frame), 0))
             return len(entries)
 
+    @requires_lock("_tick_lock")
     def _tick_inner(self, now_s: float | None) -> int:
         # an explicit clock marks the plane as running on synthetic time
         # (tests, fast_forward); start() rebases before mixing in the
@@ -1857,6 +1875,7 @@ class WireDataPlane:
         self.ticks += 1
         return shaped
 
+    @requires_lock("_tick_lock")
     def _adapt_budget(self) -> None:
         """Backpressure-aware drain budget (runner ticks only): while
         the post-drain ingress backlog GROWS across the sliding window,
@@ -1893,10 +1912,11 @@ class WireDataPlane:
             "depth": self.pipeline_depth,
             "effective_depth": self.effective_pipeline_depth,
             "degrade_level": self.degrade_level,
+            # dtnlint: lock-ok(metrics gauge snapshot: len/int reads are torn-read tolerant and must not block behind a wedged dispatch holding the tick lock)
             "inflight": len(self._inflight),
-            "drain_budget": self._drain_budget,
+            "drain_budget": self._drain_budget,  # dtnlint: lock-ok(gauge snapshot, see above)
             "ingress_backlog": self.last_backlog,
-            "holdback_wires": len(self._holdback),
+            "holdback_wires": len(self._holdback),  # dtnlint: lock-ok(gauge snapshot, see above)
         }
         return out
 
@@ -2012,6 +2032,7 @@ class WireDataPlane:
         """Transient peer-send retry attempts, summed over peers."""
         return sum(s.retries for s in list(self._peer_senders.values()))
 
+    @requires_lock("_tick_lock")
     def _requeue_failed(self, items, decided: bool) -> None:
         """Put a failed dispatch's frames back where the next tick will
         shape them — a tick failure must degrade, never lose frames.
@@ -2041,6 +2062,7 @@ class WireDataPlane:
         if self._holdback:
             self._wake.set()
 
+    @requires_lock("_tick_lock")
     def _dispatch(self, drained, now_s: float) -> _ShapeJob | None:
         """Front half of one tick's shaping: classify + bypass-decide on
         the host, then issue the whole tick's device program as ONE
@@ -2099,6 +2121,7 @@ class WireDataPlane:
             self._disp_samples = None
             self._disp_samp_adv = None
 
+    @requires_lock("_tick_lock")
     def _dispatch_inner(self, inputs, now_s: float) -> _ShapeJob | None:
         if self.chaos is not None:
             # deterministic fault injection (tests / chaos soak): raising
@@ -2398,6 +2421,7 @@ class WireDataPlane:
                               count=len(batches))
         ref, mirror = self._props_cache
         if ref is not state.props:
+            # dtnlint: sync-ok(cached host mirror — one transfer per props generation, not per tick; the cache replaced the old per-tick gather)
             mirror = np.asarray(state.props)
             self._props_cache = (state.props, mirror)
         props_rows = mirror[rows_np]
@@ -2557,6 +2581,8 @@ class WireDataPlane:
         self.stage_s["kernel"] += time.perf_counter() - t_kernel0
         return job
 
+    @requires_lock("_tick_lock")
+    # dtnlint: sync-ok(the pipeline's designated sync point: _complete consumes a dispatched tick's device outputs)
     def _complete(self, job: _ShapeJob) -> int:
         """Back half of a tick's shaping: block on one job's device
         outputs (the pipeline's only sync point), run the rare TBF
@@ -2847,6 +2873,7 @@ class WireDataPlane:
         self.shaped += shaped
         return shaped
 
+    @requires_lock("_tick_lock")
     def _accumulate_group(self, row_idx, sizes, valid, arrs) -> None:
         """Accumulate one group's shaping results into the per-edge
         cumulative counters: row-indexed vector adds, independent of
@@ -2889,6 +2916,8 @@ class WireDataPlane:
 
     # -- release + cross-node streaming egress -------------------------
 
+    @requires_lock("_tick_lock")
+    # dtnlint: sync-ok(host delivery stage: runs on already-materialized wheel state; the one counter-array copy is per release, not per frame)
     def _release(self, now_s: float) -> None:
         # ONE pass groups due frames by destination wire; delivery is then
         # per-GROUP work (one egress extend, one lookup), keeping the
@@ -3139,19 +3168,22 @@ class WireDataPlane:
         # onto the monotonic clock so pending releases keep their
         # REMAINING latency and token buckets don't see a decades-long
         # "elapsed" refill on the first real tick.
-        if self._clock_ext and self.last_now_s is not None:
-            delta = time.monotonic() - self.last_now_s
-            if self._origin_s is not None:
-                self._origin_s += delta
-            if self._last_shaped_s is not None:
-                self._last_shaped_s += delta
-            if self._heap:  # non-wheel fallback holds absolute deadlines
-                self._heap = [(r + delta, seq, pk, uid, f, tid)
-                              for (r, seq, pk, uid, f, tid)
-                              in self._heap]
-                heapq.heapify(self._heap)
-            self.last_now_s += delta
-            self._clock_ext = False
+        with self._tick_lock:
+            # the rebase below mutates epoch state a concurrent
+            # export_pending/restore_pending (gRPC thread) also touches
+            if self._clock_ext and self.last_now_s is not None:
+                delta = time.monotonic() - self.last_now_s
+                if self._origin_s is not None:
+                    self._origin_s += delta
+                if self._last_shaped_s is not None:
+                    self._last_shaped_s += delta
+                if self._heap:  # non-wheel fallback: absolute deadlines
+                    self._heap = [(r + delta, seq, pk, uid, f, tid)
+                                  for (r, seq, pk, uid, f, tid)
+                                  in self._heap]
+                    heapq.heapify(self._heap)
+                self.last_now_s += delta
+                self._clock_ext = False
         self._stop.clear()
         # steady-state GC posture while the runner is live: freeze the
         # long-lived object graph, relax gen-2 (restored on stop())
@@ -3207,18 +3239,20 @@ class WireDataPlane:
                 # in-flight dispatch remains, tick again immediately —
                 # the plane runs as fast as the host allows until the
                 # queues drain back to empty
+                # dtnlint: lock-ok(advisory backpressure peek on the runner thread: a stale read costs at most one period sleep; tick() re-reads under the lock)
                 if (self.last_backlog or self._holdback
-                        or self._inflight):
+                        or self._inflight):  # dtnlint: lock-ok(advisory peek, see above)
                     continue
                 budget = period - (now - t0)
                 # wake EARLY for the next scheduled release: the native
                 # wheel's next_due_us is a safe lower bound, so release
                 # jitter stays below the tick period instead of at it
                 # (the qdisc-watchdog precision of the reference's netem)
+                # dtnlint: lock-ok(advisory wake-early bound: _origin_s only rebases while the runner is stopped; a stale value widens the sleep by one period at most)
                 if self._wheel is not None and self._origin_s is not None:
                     nd = self._wheel.next_due_us()
                     if nd is not None:
-                        due_in = self._origin_s + nd / 1e6 - now
+                        due_in = self._origin_s + nd / 1e6 - now  # dtnlint: lock-ok(advisory bound, see above)
                         budget = min(budget, max(due_in, 0.0))
                 if budget > 0:
                     # wakes early on new ingress (daemon signal) or stop

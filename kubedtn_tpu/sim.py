@@ -48,7 +48,8 @@ class SimState:
     inflight: InFlight
     counters: EdgeCounters
     traffic: TrafficState
-    clock_us: jax.Array  # f64-ish virtual clock kept as f32 seconds pair
+    clock_us: jax.Array  # f32 VIRTUAL clock (bounded-horizon sims;
+    # wall-clock anchors stay f64 host-side — twin/snapshot)
 
 
 jax.tree_util.register_dataclass(
@@ -65,7 +66,7 @@ def init_sim(edges: EdgeState, q: int = 32) -> SimState:
         inflight=init_inflight(cap, q),
         counters=init_counters(cap),
         traffic=init_traffic_state(cap),
-        clock_us=jnp.zeros((), jnp.float32),
+        clock_us=jnp.zeros((), jnp.float32),  # dtnlint: dtype-ok(device virtual clock, f32 SoA contract; the f64-anchor rule protects WALL-clock anchors, which live host-side in twin/snapshot since PR 3)
     )
 
 

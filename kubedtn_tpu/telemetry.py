@@ -55,6 +55,7 @@ from collections import deque
 
 import numpy as np
 
+from kubedtn_tpu.contracts import guarded_by
 from kubedtn_tpu.metrics.metrics import BUCKETS
 
 # Latency histogram bin upper edges in µs — the reference bucket ladder
@@ -252,6 +253,8 @@ class _Window:
         return a
 
 
+@guarded_by("_lock", "_acc", "_patch", "_start_s", "_now_s", "_ring",
+            "windows_closed")
 class LinkTelemetry:
     """The per-edge window ring's host-side controller. The plane calls
     `open_acc()` at every dispatch (under the tick lock) to fetch the
@@ -278,7 +281,8 @@ class LinkTelemetry:
 
     @property
     def capacity(self) -> int:
-        return self._acc.shape[0]
+        with self._lock:  # _acc is swapped under the lock (rollover/grow)
+            return self._acc.shape[0]
 
     # -- tick-path API (tick lock held by the caller) ------------------
 
@@ -315,8 +319,10 @@ class LinkTelemetry:
         """Advance the window clock on an idle tick (nothing
         dispatched): without this a quiet plane would hold one window
         open forever and rates would divide by a stale span."""
-        if self._start_s is not None:
-            self.open_acc(now_s, self.capacity)
+        with self._lock:
+            started = self._start_s is not None
+        if started:  # open_acc re-checks under the lock; a racing
+            self.open_acc(now_s, self.capacity)  # first-dispatch wins
 
     def set_acc(self, acc) -> None:
         with self._lock:
@@ -492,6 +498,7 @@ def _mix64(x: int) -> int:
     return x ^ (x >> 31)
 
 
+@guarded_by("_lock", "_seq", "sampled", "recorded")
 class FlightRecorder:
     """Bounded host ring of lifecycle events for a deterministic sampled
     subset of frames (module docstring has the sampling contract).

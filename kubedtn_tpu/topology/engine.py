@@ -884,8 +884,12 @@ class SimEngine:
         t0 = jnp.zeros((E,), jnp.float32)
         # non-donating: a concurrent data-plane tick may hold these
         # buffers in its lock-free snapshot
+        # fold the link uid into the probe key: two pings with the same
+        # seed on different links must not draw identical loss/jitter
+        # bits (dtnlint key-discipline)
         self.state, res = netem.shape_step_nodonate(
-            self.state, sizes, have, t0, jax.random.key(seed))
+            self.state, sizes, have, t0,
+            jax.random.fold_in(jax.random.key(seed), uid))
         d_ab = float(res.depart_us[ra])
         d_ba = float(res.depart_us[rb])
         delivered = bool(res.delivered[ra]) and bool(res.delivered[rb])

@@ -25,7 +25,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-from kubedtn_tpu.api.types import Link, Topology
+from kubedtn_tpu.api.types import Link
 from kubedtn_tpu.topology.engine import SimEngine
 from kubedtn_tpu.topology.store import (
     NotFoundError,

@@ -1,0 +1,18 @@
+"""Known-bad fixture for the dtype-drift pass."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def freeze_anchor(clock_us):
+    return np.float32(clock_us)          # the clock_us freeze class
+
+
+def build(snapshot):
+    return snapshot.replace(
+        clock_us=jnp.zeros((), jnp.float32))  # f32-constructed anchor
+
+
+def leak_into_column(col):
+    return col.at[0].set(np.float64(1.0))  # f64 into an f32 scatter

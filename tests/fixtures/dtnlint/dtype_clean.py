@@ -1,0 +1,17 @@
+"""Clean fixture for the dtype-drift pass: zero findings expected."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def keep_anchor(clock_us):
+    return np.float64(clock_us)          # anchors STAY f64
+
+
+def build(snapshot, clock_us):
+    return snapshot.replace(clock_us=np.float64(clock_us))
+
+
+def column_write(col):
+    return col.at[0].set(jnp.float32(1.0))  # explicit f32: intended

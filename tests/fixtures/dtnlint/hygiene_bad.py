@@ -1,0 +1,12 @@
+"""Known-bad fixture for the hygiene pass."""
+
+from kubedtn_tpu import contracts  # first-party before stdlib: order
+import os
+import sys  # unused import
+
+
+def swallow():
+    try:
+        return os.getpid() + id(contracts)
+    except:                      # bare except
+        return 0

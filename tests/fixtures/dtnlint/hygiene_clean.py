@@ -1,0 +1,13 @@
+"""Clean fixture for the hygiene pass: zero findings expected."""
+
+import os
+import sys
+
+from kubedtn_tpu import contracts
+
+
+def fine():
+    try:
+        return os.getpid() + id(contracts) + len(sys.argv)
+    except (OSError, ValueError):
+        return 0

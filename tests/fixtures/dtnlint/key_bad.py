@@ -1,0 +1,28 @@
+"""Known-bad fixture for the key-discipline pass."""
+
+import jax
+
+
+def reuse(key):
+    a = jax.random.uniform(key)
+    b = jax.random.normal(key)     # same key, second sampler
+    return a + b
+
+
+def raw_root(seed):
+    return jax.random.uniform(jax.random.key(seed))  # unsplit root
+
+
+def root_into_call(seed, state):
+    return shape(state, jax.random.key(seed))  # root into sampling path
+
+
+def loop_invariant(key, n):
+    out = 0.0
+    for _ in range(n):
+        out = out + jax.random.uniform(key)  # same bits every pass
+    return out
+
+
+def shape(state, key):
+    return state

@@ -1,0 +1,28 @@
+"""Clean fixture for the key-discipline pass: zero findings expected."""
+
+import jax
+
+
+def split_then_sample(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.uniform(k1)
+    b = jax.random.normal(k2)
+    return a + b
+
+
+def leaf_kernel(state, key):
+    # the caller split for us; one sampler consumes the parameter
+    return state + jax.random.uniform(key)
+
+
+def folded_root(seed):
+    key = jax.random.key(seed)
+    k = jax.random.fold_in(key, 1)
+    return jax.random.uniform(k)
+
+
+def per_iteration(key, n):
+    out = 0.0
+    for i in range(n):
+        out = out + jax.random.uniform(jax.random.fold_in(key, i))
+    return out

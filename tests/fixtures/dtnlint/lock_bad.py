@@ -1,0 +1,19 @@
+"""Known-bad fixture for the lock-discipline pass."""
+
+import threading
+
+from kubedtn_tpu.contracts import guarded_by
+
+
+@guarded_by("_lock", "count", "items")
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.items = []
+
+    def bad_inc(self):
+        self.count += 1          # guarded write, no lock
+
+    def bad_read(self):
+        return len(self.items)   # guarded read, no lock

@@ -1,0 +1,24 @@
+"""Clean fixture for the lock-discipline pass: zero findings expected."""
+
+import threading
+
+from kubedtn_tpu.contracts import guarded_by, requires_lock
+
+
+@guarded_by("_lock", "count", "items")
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0           # __init__ precedes publication
+        self.items = []
+
+    def good_inc(self):
+        with self._lock:
+            self.count += 1
+
+    @requires_lock("_lock")
+    def helper(self):
+        self.items.append(1)     # caller holds the lock
+
+    def waivered(self):
+        return self.count  # dtnlint: lock-ok(fixture: torn read tolerated)

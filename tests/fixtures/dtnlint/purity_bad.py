@@ -1,0 +1,36 @@
+"""Known-bad fixture for the traced-purity pass: every construct here
+must produce a finding (tests/test_static_analysis.py pins the count).
+Never imported — parsed only."""
+
+import random
+import time
+
+import jax
+
+EVENTS = []
+
+
+@jax.jit
+def step(x):
+    t = time.time()          # wall clock inside a trace
+    print("tick", x)         # host I/O inside a trace
+    return x + t
+
+
+@jax.jit
+def jittered(x):
+    return x * random.random()   # host RNG inside a trace
+
+
+@jax.jit
+def accum(x):
+    EVENTS.append(x)         # closed-over container mutation
+    return x
+
+
+def run_scan(xs):
+    def body(carry, x):
+        EVENTS.append(x)     # scan body is traced too
+        return carry + x, None
+
+    return jax.lax.scan(body, 0.0, xs)

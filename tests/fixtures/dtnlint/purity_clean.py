@@ -1,0 +1,22 @@
+"""Clean fixture for the traced-purity pass: zero findings expected.
+Host effects OUTSIDE traces and local-container use INSIDE them are
+both legal."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    xs = []
+    xs.append(x * 2)         # local list: builds the trace, no effect
+    return jnp.stack(xs)
+
+
+def host_driver(x):
+    t0 = time.time()         # host side: fine
+    y = step(x)
+    print("elapsed", time.time() - t0)
+    return y

@@ -1,0 +1,12 @@
+"""Known-bad fixture for the host-sync pass (run with hot_roots
+pointing at `hot_tick`)."""
+
+import numpy as np
+
+
+def hot_tick(state):
+    mirror = np.asarray(state.props)     # device materialization
+    v = float(state.tokens[0])           # scalar coercion
+    if state:                            # bool coercion on a device val
+        v += 1.0
+    return mirror, v

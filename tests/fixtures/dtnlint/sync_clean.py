@@ -1,0 +1,13 @@
+"""Clean fixture for the host-sync pass: hot code that stays
+future-shaped (metadata reads, host-list marshalling, identity
+tests)."""
+
+import numpy as np
+
+
+def hot_tick(state, lens):
+    e = state.props.shape[0]             # metadata: no transfer
+    arr = np.asarray(lens, np.uint64)    # host list → host array
+    if state is None:                    # identity test: no coercion
+        return None
+    return e, arr

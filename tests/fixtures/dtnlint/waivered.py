@@ -1,0 +1,17 @@
+"""Waiver-syntax fixture: the findings exist but are waived (line,
+line-above, and def-level placements)."""
+
+import jax
+
+
+def line_waiver(key):
+    a = jax.random.uniform(key)
+    b = jax.random.normal(key)  # dtnlint: key-ok(fixture: documented reuse)
+    return a + b
+
+
+# dtnlint: key-ok(fixture: def-level waiver covers the whole body)
+def def_waiver(key):
+    a = jax.random.uniform(key)
+    b = jax.random.normal(key)
+    return a + b
