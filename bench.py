@@ -683,6 +683,28 @@ def main() -> None:
                 "trace_hops", "trace_stages", "trace_nodes",
                 "telemetry_windows_closed") if k in r}
 
+    def run_staged_update_soak():
+        # planned-update change-gate evidence: a clean delta claims,
+        # twin-verifies, and stages through the LIVE plane under load
+        # (gate latency, rounds, throughput during staging vs steady),
+        # and a regressing delta is rejected by the gate before
+        # touching the plane — with zero frame loss across the run.
+        # Process-isolated like the other live phases.
+        r = _isolated_scenario("staged_update_soak", {
+            "pairs": 2 if degraded else 4,
+            "steady_s": 2.0 if degraded else 3.0,
+            "staging_s": 2.0 if degraded else 3.0,
+            "offered_frames_per_s": 4_000 if degraded else 8_000})
+        extras["staged_update_soak"] = {
+            k: r[k] for k in (
+                "pairs", "offered_frames_per_s", "frames_fed",
+                "frames_delivered", "frames_lost",
+                "steady_frames_per_s", "staging_frames_per_s",
+                "staging_over_steady", "clean_plans_verified",
+                "clean_plans", "rounds_staged", "rollbacks", "gate_s",
+                "stage_s", "regressing_rejected",
+                "gate_left_plane_untouched", "tick_errors") if k in r}
+
     def run_telemetry_overhead():
         # observability cost evidence: the SAME plane-only workload
         # with the link-telemetry window ring + flight recorder off vs
@@ -788,6 +810,7 @@ def main() -> None:
     phase("live_soak_tbf", run_live_soak_tbf)
     phase("sharded_soak", run_sharded_soak)
     phase("chaos_soak", run_chaos_soak)
+    phase("staged_update_soak", run_staged_update_soak)
     phase("telemetry_overhead", run_telemetry_overhead)
     phase("whatif_sweep", run_whatif_sweep)
     phase("reconverge_10k", run_reconverge_10k)

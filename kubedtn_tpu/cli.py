@@ -45,6 +45,12 @@ def _json_safe(obj):
 
 
 def cmd_apply(args) -> int:
+    if getattr(args, "plan", None):
+        return _cmd_apply_plan(args)
+    if not args.file:
+        print("apply needs a topology YAML file, or --plan ID --daemon "
+              "HOST:PORT to apply a staged plan", file=sys.stderr)
+        return 1
     from kubedtn_tpu.api.types import load_yaml
     from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
 
@@ -64,6 +70,131 @@ def cmd_apply(args) -> int:
         "reconciles": len(results),
     }))
     return 0
+
+
+def _cmd_apply_plan(args) -> int:
+    """`kdt apply --plan ID --daemon HOST:PORT`: stage a previously
+    verified plan through the daemon's live plane (watch windows +
+    automatic rollback — Local.ApplyPlan)."""
+    import grpc
+
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+
+    client = DaemonClient(args.daemon)
+    try:
+        resp = client.ApplyPlan(
+            pb.ApplyPlanRequest(plan_id=int(args.plan),
+                                observe_ticks=args.observe_ticks),
+            timeout=args.timeout)
+    except grpc.RpcError as e:
+        print(f"apply: daemon {args.daemon} RPC failed: {_rpc_code(e)}",
+              file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+    out = {"ok": bool(resp.ok), "rounds_applied": resp.rounds_applied,
+           "rolled_back": bool(resp.rolled_back),
+           "reason": resp.reason or resp.error,
+           "stage_s": resp.stage_s}
+    print(json.dumps(_json_safe(out)))
+    return 0 if resp.ok else 1
+
+
+def cmd_plan(args) -> int:
+    """`kdt plan topo.yml --daemon HOST:PORT`: declare each topology's
+    desired links, get back the ordered schedule + the twin gate's
+    verdict, and (when verified) a plan id for `kdt apply --plan`
+    (Local.PlanUpdate)."""
+    import grpc
+
+    from kubedtn_tpu.api.types import load_yaml
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+
+    try:
+        topos = load_yaml(args.file)
+    except (OSError, ValueError) as e:
+        print(f"plan: {e}", file=sys.stderr)
+        return 1
+    none_if = lambda v: None if v < 0 else v  # noqa: E731
+    client = DaemonClient(args.daemon)
+    results = []
+    rc = 0
+    try:
+        for t in topos:
+            req = pb.PlanUpdateRequest(
+                name=t.name, kube_ns=t.namespace,
+                links=[pb.link_to_proto(l) for l in t.spec.links],
+                ticks=args.ticks, dt_us=args.dt_us,
+                max_delivery_drop=args.max_delivery_drop,
+                max_p99_factor=args.max_p99_factor,
+                max_round_edits=args.max_round_edits, seed=args.seed)
+            try:
+                resp = client.PlanUpdate(req, timeout=args.timeout)
+            except grpc.RpcError as e:
+                print(f"plan: daemon {args.daemon} RPC failed: "
+                      f"{_rpc_code(e)}", file=sys.stderr)
+                return 1
+            key = f"{t.namespace}/{t.name}"
+            if not resp.ok:
+                results.append({"topology": key, "ok": False,
+                                "error": resp.error})
+                rc = 1
+                continue
+            results.append({
+                "topology": key,
+                "ok": True,
+                "verified": bool(resp.verified),
+                "plan_id": int(resp.plan_id),
+                "reject_reason": resp.reject_reason,
+                "rounds": [{
+                    "index": r.index, "adds": r.adds,
+                    "changes": r.changes, "dels": r.dels,
+                    "delivery_ratio": none_if(r.delivery_ratio),
+                    "p99_us": none_if(r.p99_us),
+                } for r in resp.rounds],
+                "baseline_delivery_ratio": none_if(
+                    resp.baseline_delivery_ratio),
+                "baseline_p99_us": none_if(resp.baseline_p99_us),
+                "gate_s": resp.gate_s,
+                "skipped_adds": resp.skipped_adds,
+            })
+            if not resp.verified:
+                rc = 1
+    finally:
+        client.close()
+    if args.json:
+        print(json.dumps(_json_safe({"plans": results})))
+        return rc
+    for r in results:
+        if not r["ok"]:
+            print(f"{r['topology']}: ERROR {r['error']}")
+            continue
+        if not r["rounds"]:
+            print(f"{r['topology']}: no changes (empty diff)")
+            continue
+        verdict = ("VERIFIED" if r["verified"]
+                   else f"REJECTED ({r['reject_reason']})")
+        base = r["baseline_delivery_ratio"]
+        base_s = f"{100 * base:.2f}%" if base is not None else "-"
+        print(f"{r['topology']}: {verdict}  plan_id={r['plan_id']}  "
+              f"rounds={len(r['rounds'])}  baseline_delivery={base_s}  "
+              f"gate={r['gate_s']:.2f}s"
+              + (f"  skipped_adds={r['skipped_adds']}"
+                 if r["skipped_adds"] else ""))
+        for rd in r["rounds"]:
+            dr = rd["delivery_ratio"]
+            dr_s = f"{100 * dr:.2f}%" if dr is not None else "-"
+            p99 = rd["p99_us"]
+            p99_s = f"{p99:.0f}us" if p99 is not None else "-"
+            print(f"  round {rd['index'] + 1}: +{rd['adds']} "
+                  f"~{rd['changes']} -{rd['dels']}  delivery={dr_s}  "
+                  f"p99={p99_s}")
+        if r["verified"]:
+            print(f"  apply with: kdt apply --plan {r['plan_id']} "
+                  f"--daemon {args.daemon}")
+    return rc
 
 
 def _engine_from_yaml(path):
@@ -429,11 +560,13 @@ def cmd_daemon(args) -> int:
             # frames again
             checkpoint.consume_pending(ckpt_dir)
     from kubedtn_tpu.twin.query import stats_for
+    from kubedtn_tpu.updates.stager import stats_for as update_stats_for
 
     registry, hist = make_registry(engine,
                                    sim_counters_fn=dataplane.counters_fn,
                                    dataplane=dataplane,
-                                   whatif_stats=stats_for(daemon))
+                                   whatif_stats=stats_for(daemon),
+                                   update_stats=update_stats_for(daemon))
     engine.stats.observer = hist
     daemon.hist = hist
     server, port = make_server(daemon, port=args.port)
@@ -922,9 +1055,47 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpudtn")
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    ap = sub.add_parser("apply", help="load topology YAML and reconcile")
-    ap.add_argument("file")
+    ap = sub.add_parser(
+        "apply",
+        help="load topology YAML and reconcile, or apply a staged "
+             "plan (--plan ID --daemon)")
+    ap.add_argument("file", nargs="?", default=None)
+    ap.add_argument("--plan", type=int, default=None, metavar="ID",
+                    help="apply a plan previously verified by "
+                         "`kdt plan` (Local.ApplyPlan)")
+    ap.add_argument("--daemon", default="127.0.0.1:51111",
+                    metavar="HOST:PORT")
+    ap.add_argument("--observe-ticks", type=int, default=0,
+                    help="live ticks watched after each staged round "
+                         "(0 = daemon default)")
+    ap.add_argument("--timeout", type=float, default=300.0)
     ap.set_defaults(fn=cmd_apply)
+
+    plp = sub.add_parser(
+        "plan",
+        help="build + twin-verify an update schedule for the YAML's "
+             "desired links against a live daemon (Local.PlanUpdate)")
+    plp.add_argument("file", help="topology YAML declaring the DESIRED "
+                                  "link sets")
+    plp.add_argument("--daemon", default="127.0.0.1:51111",
+                     metavar="HOST:PORT")
+    plp.add_argument("--ticks", type=int, default=0,
+                     help="gate sweep horizon in virtual ticks "
+                          "(0 = daemon default)")
+    plp.add_argument("--dt-us", type=float, default=0.0)
+    plp.add_argument("--max-delivery-drop", type=float, default=0.0,
+                     help="guardrail: max absolute delivery-ratio drop "
+                          "vs baseline (0 = daemon default)")
+    plp.add_argument("--max-p99-factor", type=float, default=0.0,
+                     help="guardrail: max p99 growth factor vs "
+                          "baseline (0 = daemon default)")
+    plp.add_argument("--max-round-edits", type=int, default=0,
+                     help="split rounds to at most this many edits "
+                          "(0 = one round per phase)")
+    plp.add_argument("--seed", type=int, default=0)
+    plp.add_argument("--timeout", type=float, default=300.0)
+    plp.add_argument("--json", action="store_true")
+    plp.set_defaults(fn=cmd_plan)
 
     pp = sub.add_parser("ping", help="ping-equivalent probe between pods")
     pp.add_argument("a")

@@ -452,6 +452,41 @@ class WhatIfStatsCollector:
         return out
 
 
+class UpdateStatsCollector:
+    """kubedtn_update_* counters — observability for the planned-update
+    change gate (kubedtn_tpu.updates): plan volume and verdicts, gate
+    latency, rounds staged through the live plane, and rollbacks — the
+    numbers that say whether the twin gate is doing its job and how
+    often staging has to undo itself."""
+
+    SERIES = (
+        ("plans_built", "Update plans built from topology deltas"),
+        ("plans_verified", "Plans the twin gate verified"),
+        ("plans_rejected", "Plans the twin gate rejected"),
+        ("plan_errors", "Plan/gate infrastructure errors"),
+        ("rounds_staged", "Update rounds landed on the live plane"),
+        ("rollbacks", "Staged updates rolled back (regression or "
+                      "dispatch failure)"),
+        ("applies", "Staged updates fully applied"),
+        ("applies_failed", "Staged updates that did not complete"),
+        ("gate_seconds", "Wall seconds in the twin verification gate"),
+        ("stage_seconds", "Wall seconds staging rounds (incl. watch "
+                          "windows)"),
+    )
+
+    def __init__(self, stats) -> None:
+        self._stats = stats
+
+    def collect(self):
+        snap = self._stats.snapshot()
+        out = []
+        for name, doc in self.SERIES:
+            g = CounterMetricFamily(f"kubedtn_update_{name}", doc)
+            g.add_metric([], float(snap[name]))
+            out.append(g)
+        return out
+
+
 class MetricsServer:
     """Serves the registry on an HTTP port — the daemon's :51112/metrics
     endpoint (reference daemon/main.go:57-66)."""
@@ -509,7 +544,7 @@ class MetricsServer:
 
 def make_registry(engine=None, sim_counters_fn=None,
                   max_interfaces: int = 10_000, dataplane=None,
-                  whatif_stats=None):
+                  whatif_stats=None, update_stats=None):
     """Registry with the parity collectors installed."""
     registry = CollectorRegistry()
     hist = LatencyHistograms(registry)
@@ -523,4 +558,6 @@ def make_registry(engine=None, sim_counters_fn=None,
             registry.register(LinkTelemetryCollector(engine, dataplane))
     if whatif_stats is not None:
         registry.register(WhatIfStatsCollector(whatif_stats))
+    if update_stats is not None:
+        registry.register(UpdateStatsCollector(update_stats))
     return registry, hist

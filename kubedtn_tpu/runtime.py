@@ -1212,7 +1212,8 @@ class _ShapeJob:
             "_pipe_state", "_key", "_heap", "_seq", "_need_resync",
             "_chain_shaped_s", "_last_shaped_s", "_origin_s",
             "_disp_items", "_disp_decided", "_disp_samples",
-            "_disp_samp_adv", "_drain_budget", "_props_cache")
+            "_disp_samp_adv", "_drain_budget", "_props_cache",
+            "_update_stager")
 class WireDataPlane:
     """Shapes wire frames through the engine's edge state in real time."""
 
@@ -1428,6 +1429,10 @@ class WireDataPlane:
         self._shard_mesh = None
         self._edge_shard = None        # NamedSharding for the SoA
         self._sharded_fused = None
+        # -- planned-update stager (round 10) --------------------------
+        # lazily-created updates.stager.UpdateStager: staged topology
+        # rounds land through stage_update_round's barrier below
+        self._update_stager = None
         self.shard_xfrm = 0            # cumulative cross-shard frames
         self.shard_xfrm_last = 0       # cross-shard frames, last tick
         self.shard_mailbox_hwm = 0     # mailbox rows high-water mark
@@ -1664,6 +1669,43 @@ class WireDataPlane:
             self._pipe_state = None
             self._need_resync = False
             return shaped
+
+    def stage_update_round(self, apply_fn):
+        """Planned-update staging barrier (updates.stager): complete
+        every in-flight dispatch, run `apply_fn` (one round's engine
+        edits — it returns whatever the stager needs), and flush the
+        engine's pending scatters before the lock drops, so the next
+        tick snapshots the round fully applied or not at all. The
+        runner pauses one barrier per round, never stops. Re-entrant
+        under the tick lock (the stager's rollback holds it while
+        replaying the journal).
+
+        The engine flush runs in a FINALLY: if apply_fn raises after
+        enqueueing part of the round, the registries have already
+        moved, so the device must move with them before the lock can
+        drop — otherwise the next tick's lazy engine.state flush would
+        land the half-round mid-shaping. The stager's _apply_round
+        additionally replays its journal inside the same lock hold, so
+        no tick ever shapes against the mixture."""
+        with self._tick_lock:
+            self.flush()
+            try:
+                return apply_fn()
+            finally:
+                self.engine.flush()
+
+    def update_stager(self, stats=None):
+        """This plane's planned-update stager, created on first use
+        (kubedtn_tpu.updates.stager.UpdateStager). `stats` attaches an
+        UpdateStats sink the first time one is offered."""
+        from kubedtn_tpu.updates.stager import UpdateStager
+
+        with self._tick_lock:
+            if self._update_stager is None:
+                self._update_stager = UpdateStager(self, stats=stats)
+            elif stats is not None and self._update_stager.stats is None:
+                self._update_stager.stats = stats
+            return self._update_stager
 
     def fast_forward(self, sim_seconds: float,
                      dt_s: float | None = None) -> dict:

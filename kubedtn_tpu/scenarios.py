@@ -1063,6 +1063,65 @@ def live_plane_soak(pairs: int = 8, seconds: float = 20.0,
     }
 
 
+# -- shared harness for the live fault scenarios -----------------------
+# (chaos_soak, staged_update_soak, update_under_flap): one paced-feeder
+# / drain / warm-up implementation, so a fix to the pacing or the
+# loss-accounting discipline lands once.
+
+# non-IP ethertype: never eligible for the TCP bypass, so every frame
+# crosses the shaping plane and the loss accounting is exact
+_FAULT_FRAME = b"\x02" * 12 + b"\x07\x77" + b"\x00" * 50
+
+
+def _drain_wires(wires_out) -> int:
+    """Pop everything delivered so far; returns the count."""
+    c = 0
+    for w in wires_out:
+        dq = w.egress
+        while True:
+            try:
+                dq.popleft()
+            except IndexError:
+                break
+            c += 1
+    return c
+
+
+def _paced_feeder(wires_in, offered_frames_per_s: int, stop, fed,
+                  frame: bytes = _FAULT_FRAME, pace_s: float = 0.02):
+    """Fixed chunk per wire every pace_s keeps the offered load below
+    plane capacity, so loss accounting is exact (every fed frame must
+    eventually arrive). Run as a thread body; `fed` is a 1-list."""
+    per_wire = max(1, int(offered_frames_per_s * pace_s
+                          / max(len(wires_in), 1)))
+    chunk = [frame] * per_wire
+    while not stop.is_set():
+        for w in wires_in:
+            w.ingress.extend(chunk)
+        fed[0] += per_wire * len(wires_in)
+        stop.wait(pace_s)
+
+
+def _warm_live_load(wires_in, drain, fed, per_wire: int, label: str,
+                    frame: bytes = _FAULT_FRAME,
+                    timeout_s: float = 120.0) -> int:
+    """Chaos-free warm phase: one chunk end-to-end compiles the shaping
+    jit buckets and settles the stream, so the measured windows see the
+    plane, not the compiler. Returns frames delivered (== fed)."""
+    for w in wires_in:
+        w.ingress.extend([frame] * per_wire)
+    fed[0] += per_wire * len(wires_in)
+    delivered = 0
+    deadline = time.monotonic() + timeout_s
+    while delivered < fed[0] and time.monotonic() < deadline:
+        time.sleep(0.02)
+        delivered += drain()
+    if delivered < fed[0]:
+        raise RuntimeError(f"{label} warm-up never delivered "
+                           f"({delivered}/{fed[0]})")
+    return delivered
+
+
 def chaos_soak(pairs: int = 4, seconds: float = 12.0,
                flap_period_s: float = 1.0, duty_down: float = 0.5,
                offered_frames_per_s: int = 20_000,
@@ -1156,52 +1215,21 @@ def chaos_soak(pairs: int = 4, seconds: float = 12.0,
 
     fed = [0]
     stop_feed = _threading.Event()
-    frame = b"\x02" * 12 + b"\x07\x77" + b"\x00" * 50  # non-IP: no bypass
-
-    def feeder():
-        # paced injector: a fixed chunk per wire every pace_s keeps the
-        # offered load below plane capacity, so loss accounting is
-        # exact (every fed frame must eventually arrive at B)
-        pace_s = 0.02
-        per_wire = max(1, int(offered_frames_per_s * pace_s / pairs))
-        chunk = [frame] * per_wire
-        while not stop_feed.is_set():
-            for w in wires_in:
-                w.ingress.extend(chunk)
-            fed[0] += per_wire * pairs
-            stop_feed.wait(pace_s)
 
     def drain_delivered() -> int:
-        c = 0
-        for w in wires_out:
-            dq = w.egress
-            while True:
-                try:
-                    dq.popleft()
-                except IndexError:
-                    break
-                c += 1
-        return c
+        return _drain_wires(wires_out)
 
     delivered = 0
     windows: list[float] = []
     try:
-        # warm phase (chaos-free): one chunk end-to-end compiles the
-        # shaping jit buckets and settles the A→B stream, so the flap
-        # windows measure the fault-domain layer, not the compiler
-        warm_per_wire = max(1, int(offered_frames_per_s * 0.02 / pairs))
-        for w in wires_in:
-            w.ingress.extend([frame] * warm_per_wire)
-        fed[0] += warm_per_wire * pairs
-        warm_deadline = time.monotonic() + 120.0
-        while delivered < fed[0] and time.monotonic() < warm_deadline:
-            time.sleep(0.02)
-            delivered += drain_delivered()
-        if delivered < fed[0]:
-            raise RuntimeError(
-                f"chaos_soak warm-up never delivered "
-                f"({delivered}/{fed[0]})")
-        feed = _threading.Thread(target=feeder, daemon=True)
+        delivered = _warm_live_load(
+            wires_in, drain_delivered, fed,
+            max(1, int(offered_frames_per_s * 0.02 / pairs)),
+            "chaos_soak")
+        feed = _threading.Thread(
+            target=_paced_feeder,
+            args=(wires_in, offered_frames_per_s, stop_feed, fed),
+            daemon=True)
         feed.start()
         # flap schedule starts with the load (down first: the outage
         # buffer is exercised from the first window)
@@ -1279,6 +1307,329 @@ def chaos_soak(pairs: int = 4, seconds: float = 12.0,
         "trace_nodes": sorted({e["node"] for e in trace_path}),
         "telemetry_windows_closed": tel_a.windows_closed,
         "telemetry_link_rows": len(link_rows),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def staged_update_soak(pairs: int = 4, steady_s: float = 3.0,
+                       staging_s: float = 3.0,
+                       offered_frames_per_s: int = 8_000,
+                       latency: str = "2ms", new_latency: str = "3ms",
+                       dt_us: float = 2_000.0,
+                       observe_ticks: int = 4, gate_ticks: int = 120,
+                       drain_timeout_s: float = 60.0):
+    """The planned-update change gate under LIVE load, end to end:
+
+    1. a real daemon + runner serves paced traffic; a steady window
+       measures baseline throughput;
+    2. a CLEAN delta (latency bump on every pair) goes through the full
+       claim/apply path — plan → twin gate → staged rounds with watch
+       windows — while the load keeps flowing; throughput DURING
+       staging is measured against steady state and the zero-loss
+       accounting covers the whole run;
+    3. a REGRESSING delta (loss=70 on every pair) must be REJECTED by
+       the gate before touching the live plane — the engine state is
+       asserted unchanged.
+
+    Records gate latency, rounds staged, rollback count, and staging
+    vs steady throughput — the `staged_update_soak` bench phase."""
+    import threading as _threading
+
+    from kubedtn_tpu.updates import (Guardrails, plan_update,
+                                     verify_plan_live)
+    from kubedtn_tpu.updates.stager import UpdateStats
+
+    t0 = time.perf_counter()
+    daemon, server, _port, plane, wires_in, wires_out = \
+        _live_plane_setup(pairs, latency, dt_us, "su")
+    engine = daemon.engine
+    plane.enable_telemetry(window_s=0.5, sample_period=64)
+    stats = UpdateStats()
+    stager = plane.update_stager(stats=stats)
+
+    fed = [0]
+    stop_feed = _threading.Event()
+
+    def drain_delivered() -> int:
+        return _drain_wires(wires_out)
+
+    delivered = 0
+    # p99 headroom: the clean delta IS a latency bump (2ms -> 3ms), so
+    # the latency guardrail must not veto the intended change; the
+    # regressing delta is caught by the delivery-ratio guardrail
+    guards = Guardrails(ticks=gate_ticks, dt_us=1000.0,
+                        max_p99_factor=4.0)
+    try:
+        delivered = _warm_live_load(wires_in, drain_delivered, fed, 32,
+                                    "staged_update_soak")
+        feed = _threading.Thread(
+            target=_paced_feeder,
+            args=(wires_in, offered_frames_per_s, stop_feed, fed),
+            daemon=True)
+        feed.start()
+        # steady window
+        w0 = time.monotonic()
+        time.sleep(steady_s)
+        got = drain_delivered()
+        delivered += got
+        steady_rate = got / (time.monotonic() - w0)
+        # clean delta through the full claim/apply path, under load.
+        # The gate sweep runs on a live snapshot while traffic flows;
+        # staging lands each round at a flush barrier and watches the
+        # telemetry ring between rounds.
+        topos = [engine.store.get("default", f"su-a{i}")
+                 for i in range(pairs)]
+        new_props = LinkProperties(latency=new_latency)
+        gate_s, stage_s, rounds_staged = 0.0, 0.0, 0
+        stage_window_t0 = time.monotonic()
+        drained_during = [0]
+        stop_probe = _threading.Event()
+
+        def probe():  # keep draining so staging-window rate is measured
+            while not stop_probe.is_set():
+                drained_during[0] += drain_delivered()
+                stop_probe.wait(0.02)
+
+        pr = _threading.Thread(target=probe, daemon=True)
+        pr.start()
+        clean_verdicts = []
+        for topo in topos:
+            old = list(topo.status.links)
+            new = [l.with_properties(new_props) for l in old]
+            plan = plan_update(old, new, namespace=topo.namespace,
+                               name=topo.name)
+            verdict = verify_plan_live(plane, plan, guardrails=guards)
+            stats.record_plan(verdict)
+            gate_s += verdict.gate_s
+            clean_verdicts.append(verdict.ok)
+            if not verdict.ok:
+                continue
+            res = stager.stage(plan, topo, observe_ticks=observe_ticks,
+                               guardrails=guards)
+            stage_s += res.stage_s
+            rounds_staged += res.rounds_applied
+        time.sleep(max(0.0, staging_s
+                       - (time.monotonic() - stage_window_t0)))
+        stop_probe.set()
+        pr.join(timeout=2)
+        drained_during[0] += drain_delivered()
+        delivered += drained_during[0]
+        staging_rate = (drained_during[0]
+                        / (time.monotonic() - stage_window_t0))
+        # regressing delta: the gate must block it BEFORE the live plane
+        topo0 = engine.store.get("default", "su-a0")
+        bad = [l.with_properties(LinkProperties(loss="70"))
+               for l in topo0.status.links]
+        bad_plan = plan_update(list(topo0.status.links), bad,
+                               namespace=topo0.namespace,
+                               name=topo0.name)
+        pre_props = np.asarray(engine.state.props).copy()
+        bad_verdict = verify_plan_live(plane, bad_plan,
+                                       guardrails=guards)
+        stats.record_plan(bad_verdict)
+        gate_s += bad_verdict.gate_s
+        post_props = np.asarray(engine.state.props)
+        gate_untouched = bool(np.array_equal(pre_props, post_props))
+        # drain to empty: zero-loss accounting across the whole run
+        stop_feed.set()
+        feed.join(timeout=5)
+        deadline = time.monotonic() + drain_timeout_s
+        while delivered < fed[0] and time.monotonic() < deadline:
+            time.sleep(0.05)
+            delivered += drain_delivered()
+    finally:
+        stop_feed.set()
+        plane.stop()
+        server.stop(0)
+    snap_stats = stats.snapshot()
+    return {
+        "scenario": "staged_update_soak",
+        "pairs": pairs,
+        "offered_frames_per_s": offered_frames_per_s,
+        "frames_fed": fed[0],
+        "frames_delivered": delivered,
+        "frames_lost": fed[0] - delivered,
+        "steady_frames_per_s": round(steady_rate, 1),
+        "staging_frames_per_s": round(staging_rate, 1),
+        "staging_over_steady": round(staging_rate / steady_rate, 3)
+        if steady_rate else None,
+        "clean_plans_verified": sum(clean_verdicts),
+        "clean_plans": len(clean_verdicts),
+        "rounds_staged": rounds_staged,
+        "rollbacks": snap_stats["rollbacks"],
+        "gate_s": round(gate_s, 3),
+        "stage_s": round(stage_s, 3),
+        "regressing_rejected": not bad_verdict.ok,
+        "regressing_reason": bad_verdict.reason,
+        "gate_left_plane_untouched": gate_untouched,
+        "tick_errors": plane.tick_errors,
+        "update_stats": snap_stats,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def update_under_flap(pairs: int = 2, seconds: float = 5.0,
+                      flap_period_s: float = 1.0, duty_down: float = 0.5,
+                      offered_frames_per_s: int = 6_000,
+                      latency: str = "2ms", new_latency: str = "4ms",
+                      dt_us: float = 2_000.0, gate_ticks: int = 100,
+                      observe_ticks: int = 3, seed: int = 11,
+                      drain_timeout_s: float = 90.0):
+    """chaos_soak's cross-node flap harness with a staged update landing
+    MID-FLAP: while the A→B peer breaker is cycling, a planned latency
+    change on A's topologies goes through the gate and stages through
+    the running plane. The update must either complete or roll back
+    cleanly, and the zero-loss accounting must hold either way —
+    `frames_lost == 0` (the outage buffer + retry absorb the flap, the
+    staging barriers never strand a frame)."""
+    import threading as _threading
+
+    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+    from kubedtn_tpu.chaos import ChaosInjector
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.updates import (Guardrails, plan_update,
+                                     verify_plan_live)
+    from kubedtn_tpu.updates.stager import UpdateStats
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon, make_server
+
+    t0 = time.perf_counter()
+
+    def make_node():
+        store = TopologyStore()
+        engine = SimEngine(store, capacity=4 * pairs + 8)
+        daemon = Daemon(engine)
+        server, port = make_server(daemon, port=0, host="127.0.0.1",
+                                   log_rpcs=False)
+        server.start()
+        addr = f"127.0.0.1:{port}"
+        engine.node_ip = addr
+        return store, engine, daemon, server, addr
+
+    store_a, engine_a, daemon_a, server_a, addr_a = make_node()
+    store_b, engine_b, daemon_b, server_b, addr_b = make_node()
+    props = LinkProperties(latency=latency)
+    for store in (store_a, store_b):
+        for i in range(pairs):
+            ta = Topology(name=f"ua{i}", spec=TopologySpec(links=[
+                Link(local_intf="eth1", peer_intf="eth1",
+                     peer_pod=f"ub{i}", uid=i + 1, properties=props)]))
+            tb = Topology(name=f"ub{i}", spec=TopologySpec(links=[
+                Link(local_intf="eth1", peer_intf="eth1",
+                     peer_pod=f"ua{i}", uid=i + 1, properties=props)]))
+            ta.status.src_ip, ta.status.net_ns = addr_a, "/ns/a"
+            tb.status.src_ip, tb.status.net_ns = addr_b, "/ns/b"
+            ta.status.links = list(ta.spec.links)
+            tb.status.links = list(tb.spec.links)
+            store.create(ta)
+            store.create(tb)
+    for i in range(pairs):
+        t = store_a.get("default", f"ua{i}")
+        assert engine_a.add_links(t, t.spec.links), "cross-node realize"
+    wires_in, wires_out = [], []
+    for i in range(pairs):
+        wb = daemon_b._add_wire(pb.WireDef(
+            local_pod_name=f"ub{i}", kube_ns="default", link_uid=i + 1,
+            intf_name_in_pod="eth1", peer_ip=addr_a))
+        wa = daemon_a._add_wire(pb.WireDef(
+            local_pod_name=f"ua{i}", kube_ns="default", link_uid=i + 1,
+            intf_name_in_pod="eth1", peer_ip=addr_b,
+            peer_intf_id=wb.wire_id))
+        wires_in.append(wa)
+        wires_out.append(wb)
+
+    plane = WireDataPlane(daemon_a, dt_us=dt_us)
+    plane.enable_telemetry(window_s=0.5, sample_period=64, node=addr_a)
+    chaos = ChaosInjector(seed=seed)
+    plane.attach_chaos(chaos)
+    stats = UpdateStats()
+    stager = plane.update_stager(stats=stats)
+    plane.start()
+
+    fed = [0]
+    stop_feed = _threading.Event()
+
+    def drain_delivered() -> int:
+        return _drain_wires(wires_out)
+
+    delivered = 0
+    stage_results = []
+    # latency-bump delta: give the p99 guardrail headroom (see
+    # staged_update_soak) — the scenario's contract is complete-or-
+    # roll-back-cleanly with zero loss, either verdict is a pass
+    guards = Guardrails(ticks=gate_ticks, dt_us=1000.0,
+                        max_p99_factor=4.0)
+    try:
+        delivered = _warm_live_load(wires_in, drain_delivered, fed, 32,
+                                    "update_under_flap")
+        feed = _threading.Thread(
+            target=_paced_feeder,
+            args=(wires_in, offered_frames_per_s, stop_feed, fed),
+            daemon=True)
+        feed.start()
+        chaos.flap_peer(addr_b, flap_period_s, duty_down)
+        t_end = time.monotonic() + seconds
+        # stage the planned update mid-flap (after ~one flap period so
+        # the breaker is demonstrably cycling)
+        time.sleep(min(flap_period_s, seconds / 3))
+        new_props = LinkProperties(latency=new_latency)
+        for i in range(pairs):
+            topo = store_a.get("default", f"ua{i}")
+            old = list(topo.status.links)
+            new = [l.with_properties(new_props) for l in old]
+            plan = plan_update(old, new, namespace=topo.namespace,
+                               name=topo.name)
+            verdict = verify_plan_live(plane, plan, guardrails=guards)
+            stats.record_plan(verdict)
+            if not verdict.ok:
+                stage_results.append("gate-rejected")
+                continue
+            res = stager.stage(plan, topo, observe_ticks=observe_ticks,
+                               guardrails=guards)
+            stage_results.append("completed" if res.ok
+                                 else f"rolled-back: {res.reason}")
+        while time.monotonic() < t_end:
+            time.sleep(0.05)
+            delivered += drain_delivered()
+        stop_feed.set()
+        feed.join(timeout=5)
+        chaos.heal_peer(addr_b)
+        deadline = time.monotonic() + drain_timeout_s
+        while delivered < fed[0] and time.monotonic() < deadline:
+            time.sleep(0.05)
+            delivered += drain_delivered()
+        plane.flush_peers(timeout_s=10.0)
+        delivered += drain_delivered()
+    finally:
+        stop_feed.set()
+        pstats = plane.peer_fault_stats().get(addr_b, {})
+        plane.stop()
+        server_a.stop(0)
+        server_b.stop(0)
+    # every verdict is "clean" as long as the plane is consistent:
+    # completed (landed), rolled-back (undone bit-exactly), or
+    # gate-rejected (never touched the plane) — the scenario's contract
+    # is zero loss either way, not a particular verdict
+    clean = all(r in ("completed", "gate-rejected")
+                or r.startswith("rolled-back") for r in stage_results)
+    return {
+        "scenario": "update_under_flap",
+        "pairs": pairs,
+        "seconds": seconds,
+        "flap_hz": round(1.0 / flap_period_s, 3),
+        "frames_fed": fed[0],
+        "frames_delivered": delivered,
+        "frames_lost": fed[0] - delivered,
+        "stage_results": stage_results,
+        "stages_clean": clean,
+        "stages_completed": sum(1 for r in stage_results
+                                if r == "completed"),
+        "rollbacks": stats.snapshot()["rollbacks"],
+        "breaker_cycles": int(pstats.get("cycles", 0)),
+        "breaker": pstats,
+        "injected_faults": dict(chaos.injected),
+        "tick_errors": plane.tick_errors,
+        "update_stats": stats.snapshot(),
         "wall_s": round(time.perf_counter() - t0, 3),
     }
 
@@ -1621,4 +1972,6 @@ LADDER = {
     "whatif_sweep": whatif_sweep,
     "telemetry_overhead": telemetry_overhead,
     "sharded_soak": sharded_soak,
+    "staged_update_soak": staged_update_soak,
+    "update_under_flap": update_under_flap,
 }

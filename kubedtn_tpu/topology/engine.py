@@ -539,6 +539,20 @@ class SimEngine:
         engine lock is released — the reference's explicit unlock-before-
         RPC deadlock avoidance (handler.go:442-446)."""
         remote_calls = self._add_links_locked(topo, links)
+        ok = self.complete_remote(remote_calls, pod_key=topo.key)
+        if links:
+            self.log.debug("add_links %s", _fields(
+                action="add", pod=topo.key, links=len(links),
+                remote_calls=len(remote_calls), ok=ok))
+        return ok
+
+    def complete_remote(self, remote_calls, pod_key: str = "",
+                        action: str = "add") -> bool:
+        """Issue the cross-node completion RPCs `_add_links_locked`
+        returned — ALWAYS with the engine lock released (the reference's
+        unlock-before-RPC deadlock avoidance, handler.go:442-446). The
+        one completion loop shared by `add_links` and the planned-update
+        stager's round apply."""
         ok = True
         for src_ip, remote_pod in remote_calls:
             try:
@@ -547,13 +561,9 @@ class SimEngine:
             except Exception as e:
                 self.stats.remote_errors += 1
                 self.log.warning("remote completion failed %s", _fields(
-                    action="add", pod=topo.key, peer_daemon=src_ip,
+                    action=action, pod=pod_key, peer_daemon=src_ip,
                     error=type(e).__name__))
                 ok = False
-        if links:
-            self.log.debug("add_links %s", _fields(
-                action="add", pod=topo.key, links=len(links),
-                remote_calls=len(remote_calls), ok=ok))
         return ok
 
     @_locked

@@ -44,6 +44,12 @@ class Perturbation:
     props: object | None = None  # LinkProperties for degrade
     node: object | None = None   # blackhole target: node id or pod name
     factor: float = 1.0          # scale multiplier
+    # degrade only: restrict the edit to the directed row(s) whose
+    # SOURCE is this node id — `update_links` semantics, which rebuild
+    # only the local end's qdiscs. None (the default, and the only form
+    # the wire surface emits) degrades every active row of the uid,
+    # the historical both-directions behavior.
+    src_node: int | None = None
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -172,11 +178,16 @@ def compile_scenarios(scenarios, edges, pod_ids: dict | None = None,
                         f"{p.node!r} (id {nid}) touches no active rows")
                 drows_i.extend(int(r) for r in hit)
                 continue
-            hit = np.flatnonzero(active & (uid_arr == int(p.uid)))
+            mask = active & (uid_arr == int(p.uid))
+            if p.kind == "degrade" and p.src_node is not None:
+                mask &= src == int(p.src_node)
+            hit = np.flatnonzero(mask)
             if hit.size == 0:
                 raise ValueError(
                     f"scenario {sc.name!r}: no active rows for link uid "
-                    f"{p.uid}")
+                    f"{p.uid}"
+                    + (f" with src node {p.src_node}"
+                       if p.src_node is not None else ""))
             if p.kind == "fail":
                 drows_i.extend(int(r) for r in hit)
             else:  # degrade

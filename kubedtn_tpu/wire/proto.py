@@ -238,6 +238,57 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
         _field("recent_traces", 4, U64, REP),
         _field("sampled", 5, I64),
     ))
+    # Framework extension (absent from reference kube_dtn.proto): the
+    # planned-update surface (kubedtn_tpu.updates) — claim/apply
+    # semantics per the Kubernetes Network Driver Model. PlanUpdate
+    # diffs the declared desired links against the realized state,
+    # builds the ordered schedule and dry-runs it through the twin
+    # verification gate; ApplyPlan stages a verified plan through the
+    # live plane with automatic rollback. Reference clients never see
+    # these types.
+    f.message_type.append(_msg(
+        "PlanUpdateRequest",
+        _field("name", 1, S), _field("kube_ns", 2, S),
+        _field("links", 3, None, REP, type_name="Link"),  # desired set
+        _field("ticks", 4, I32),            # gate horizon; 0 = default
+        _field("dt_us", 5, D),
+        _field("max_delivery_drop", 6, D),  # guardrails; 0 = default
+        _field("max_p99_factor", 7, D),
+        _field("max_round_edits", 8, I32),  # 0 = one round per phase
+        _field("seed", 9, I64),
+    ))
+    f.message_type.append(_msg(
+        "PlanRound",
+        _field("index", 1, I32), _field("adds", 2, I32),
+        _field("changes", 3, I32), _field("dels", 4, I32),
+        _field("delivery_ratio", 5, D),     # gate cumulative; -1 unknown
+        _field("p99_us", 6, D),
+    ))
+    f.message_type.append(_msg(
+        "PlanUpdateResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("plan_id", 3, I64),          # 0 = not appliable
+        _field("rounds", 4, None, REP, type_name="PlanRound"),
+        _field("verified", 5, B),
+        _field("reject_reason", 6, S),
+        _field("baseline_delivery_ratio", 7, D),
+        _field("baseline_p99_us", 8, D),
+        _field("gate_s", 9, D),
+        _field("skipped_adds", 10, I32),
+    ))
+    f.message_type.append(_msg(
+        "ApplyPlanRequest",
+        _field("plan_id", 1, I64),
+        _field("observe_ticks", 2, I32),    # watch window; 0 = default
+    ))
+    f.message_type.append(_msg(
+        "ApplyPlanResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("rounds_applied", 3, I32),
+        _field("rolled_back", 4, B),
+        _field("reason", 5, S),
+        _field("stage_s", 6, D),
+    ))
     return f
 
 
@@ -255,7 +306,9 @@ for _name in ("LinkProperties", "Link", "Pod", "PodQuery",
               "WhatIfMetrics", "WhatIfResponse",
               "ObserveLinksRequest", "LinkStats", "ObserveLinksResponse",
               "ObserveTraceRequest", "TraceEvent",
-              "ObserveTraceResponse"):
+              "ObserveTraceResponse",
+              "PlanUpdateRequest", "PlanRound", "PlanUpdateResponse",
+              "ApplyPlanRequest", "ApplyPlanResponse"):
     _MESSAGES[_name] = message_factory.GetMessageClass(
         _pool.FindMessageTypeByName(f"{PACKAGE}.{_name}"))
 
@@ -286,6 +339,11 @@ ObserveLinksResponse = _MESSAGES["ObserveLinksResponse"]
 ObserveTraceRequest = _MESSAGES["ObserveTraceRequest"]
 TraceEvent = _MESSAGES["TraceEvent"]
 ObserveTraceResponse = _MESSAGES["ObserveTraceResponse"]
+PlanUpdateRequest = _MESSAGES["PlanUpdateRequest"]
+PlanRound = _MESSAGES["PlanRound"]
+PlanUpdateResponse = _MESSAGES["PlanUpdateResponse"]
+ApplyPlanRequest = _MESSAGES["ApplyPlanRequest"]
+ApplyPlanResponse = _MESSAGES["ApplyPlanResponse"]
 
 # Service method tables: name -> (request class, response class, streaming)
 LOCAL_METHODS = {
@@ -309,6 +367,11 @@ LOCAL_METHODS = {
     # cli trace read these — not in the reference IDL)
     "ObserveLinks": (ObserveLinksRequest, ObserveLinksResponse, False),
     "ObserveTrace": (ObserveTraceRequest, ObserveTraceResponse, False),
+    # Framework extensions: the planned-update change gate — verified
+    # multi-round topology updates staged through the live plane with
+    # rollback (kubedtn_tpu.updates; not in the reference IDL)
+    "PlanUpdate": (PlanUpdateRequest, PlanUpdateResponse, False),
+    "ApplyPlan": (ApplyPlanRequest, ApplyPlanResponse, False),
 }
 REMOTE_METHODS = {
     "Update": (RemotePod, BoolResponse, False),

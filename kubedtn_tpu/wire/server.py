@@ -572,6 +572,22 @@ class Daemon:
                                 sorted(e["detail"].items())),
             ) for e in evs])
 
+    def PlanUpdate(self, request, context):
+        """Framework extension: build + twin-verify an ordered update
+        schedule for a topology's declared desired links (the CLAIM
+        half of the planned-update surface, kubedtn_tpu.updates)."""
+        from kubedtn_tpu.updates.service import serve_plan_update
+
+        return serve_plan_update(self, request)
+
+    def ApplyPlan(self, request, context):
+        """Framework extension: stage a verified plan through the live
+        plane with watch windows and automatic rollback (the APPLY
+        half; kubedtn_tpu.updates.stager)."""
+        from kubedtn_tpu.updates.service import serve_apply_plan
+
+        return serve_apply_plan(self, request)
+
     # -- Remote --------------------------------------------------------
 
     def Update(self, request, context):

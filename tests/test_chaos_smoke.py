@@ -8,7 +8,7 @@ import logging
 
 import pytest
 
-from kubedtn_tpu.scenarios import chaos_soak
+from kubedtn_tpu.scenarios import chaos_soak, update_under_flap
 
 
 @pytest.mark.chaos
@@ -41,3 +41,29 @@ def test_chaos_soak_smoke_no_frames_lost():
         assert stage in r["trace_stages"], r["trace_stages"]
     assert len(r["trace_nodes"]) == 2  # both daemons contributed
     assert r["sampled_frames"] > 0
+
+
+@pytest.mark.chaos
+def test_update_under_flap_smoke():
+    """Round 10: a planned update staged while the peer breaker is
+    cycling must either complete or roll back cleanly — and the
+    zero-loss accounting must hold either way (<30 s tier-1 smoke of
+    the bench's update_under_flap variant)."""
+    logging.disable(logging.WARNING)
+    try:
+        r = update_under_flap(pairs=2, seconds=3.0, flap_period_s=1.0,
+                              offered_frames_per_s=4_000, gate_ticks=60,
+                              seed=13)
+    finally:
+        logging.disable(logging.NOTSET)
+    assert r["frames_fed"] > 0
+    # the flap actually fired while the update staged
+    assert r["injected_faults"]["peer_blackhole"] > 0
+    assert r["breaker_cycles"] >= 1, r["breaker"]
+    # every staged update either landed or rolled back cleanly — and
+    # at least one actually went through the gate + stager
+    assert r["stage_results"], r
+    assert r["stages_clean"], r["stage_results"]
+    # acceptance: zero loss, zero tick errors, either way
+    assert r["frames_lost"] == 0, r
+    assert r["tick_errors"] == 0, r

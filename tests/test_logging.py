@@ -131,10 +131,13 @@ def test_reconcile_failure_logged_warning(capture):
     t.status.links = []
     store.create(t)
     Reconciler(store, engine).reconcile("default", "p")
-    warnings = [r for r in capture
+    warnings = [r.getMessage() for r in capture
                 if r.name == "kubedtn.reconciler"
                 and r.levelname == "WARNING"]
-    assert warnings and "requeue=True" in warnings[0].getMessage()
+    assert any("requeue=True" in m for m in warnings), warnings
+    # the partial-apply warning names the failed link set (ISSUE 8)
+    assert any("failed_links" in m and "add" in m for m in warnings), \
+        warnings
 
 
 def test_wire_data_rpcs_log_at_debug_not_info(capture):

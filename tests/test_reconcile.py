@@ -650,3 +650,122 @@ class TestTrace:
         assert out["reachable"] is True
         assert len(out["hops"]) == 16
         assert out["total_latency_us"] == 16_000.0
+
+
+class TestCalcDiffEdgeCases:
+    """ISSUE 8 satellite: calc_diff round-trip/duplicate-uid/shaping-
+    only edge cases, property-tested over seeded random link sets."""
+
+    @staticmethod
+    def _rand_links(rng, n):
+        links = []
+        used = set()
+        for _ in range(n):
+            # duplicate uids are LEGAL (identity is the 8-field tuple);
+            # only exact-duplicate identities are avoided, since the
+            # reference's status list cannot hold two identical links
+            while True:
+                uid = rng.randrange(4)       # few uids => collisions
+                intf = f"eth{rng.randrange(3)}"
+                peer = f"p{rng.randrange(3)}"
+                if (uid, intf, peer) not in used:
+                    used.add((uid, intf, peer))
+                    break
+            links.append(Link(
+                local_intf=intf, peer_intf=intf, peer_pod=peer, uid=uid,
+                properties=LinkProperties(
+                    latency=f"{rng.randrange(1, 9)}ms",
+                    loss=rng.choice(["", "5", "10"]))))
+        return links
+
+    @staticmethod
+    def _apply(old, add, delete, changed):
+        from kubedtn_tpu.topology.reconciler import _identity
+
+        dead = {_identity(d) for d in delete}
+        ch = {_identity(c): c for c in changed}
+        out = [ch.get(_identity(l), l) for l in old
+               if _identity(l) not in dead]
+        return out + list(add)
+
+    @staticmethod
+    def _norm(links):
+        from kubedtn_tpu.topology.reconciler import _identity
+
+        return sorted(links, key=lambda l: (_identity(l),
+                                            repr(l.properties)))
+
+    def test_roundtrip_property(self):
+        import random
+
+        for seed in range(20):
+            rng = random.Random(seed)
+            old = self._rand_links(rng, rng.randrange(0, 8))
+            new = self._rand_links(rng, rng.randrange(0, 8))
+            fwd = calc_diff(old, new)
+            applied = self._apply(old, *fwd)
+            assert self._norm(applied) == self._norm(new), seed
+            # applying the diff converges: nothing left to do
+            add2, del2, ch2 = calc_diff(applied, new)
+            assert (add2, del2, ch2) == ([], [], []), seed
+            # and the reverse diff takes you back — old -> new -> old
+            # round-trips to an EMPTY diff
+            back = calc_diff(applied, old)
+            restored = self._apply(applied, *back)
+            assert self._norm(restored) == self._norm(old), seed
+            assert calc_diff(restored, old) == ([], [], []), seed
+
+    def test_self_diff_is_empty(self):
+        import random
+
+        for seed in range(5):
+            links = self._rand_links(random.Random(seed), 6)
+            assert calc_diff(links, list(links)) == ([], [], [])
+
+    def test_duplicate_uid_links_tracked_independently(self):
+        # two links sharing a uid (distinct interfaces): changing one's
+        # properties must classify exactly that one as changed
+        a1 = Link(local_intf="eth1", peer_intf="eth1", peer_pod="x",
+                  uid=7, properties=LinkProperties(latency="1ms"))
+        a2 = Link(local_intf="eth2", peer_intf="eth2", peer_pod="x",
+                  uid=7, properties=LinkProperties(latency="1ms"))
+        a2_new = a2.with_properties(LinkProperties(latency="9ms"))
+        add, dele, changed = calc_diff([a1, a2], [a1, a2_new])
+        assert (add, dele) == ([], [])
+        assert changed == [a2_new]
+
+    def test_shaping_only_change_is_changed_not_add_del(self):
+        # a link whose ONLY delta is shaping properties (here: rate) is
+        # an update, never a delete+add — identity excludes properties
+        a = Link(local_intf="eth1", peer_intf="eth1", peer_pod="x",
+                 uid=1, properties=LinkProperties(latency="2ms"))
+        a_new = a.with_properties(LinkProperties(rate="5Mbit"))
+        add, dele, changed = calc_diff([a], [a_new])
+        assert add == [] and dele == []
+        assert changed == [a_new]
+
+
+def test_direct_reconcile_failure_requeues_for_next_drain():
+    """ISSUE 8 satellite (partial-apply leak): a failed DIRECT
+    reconcile() — e.g. during reconcile_all's startup resync, with no
+    watch event pending — must requeue the key itself, so the next
+    drain retries the half-applied delta instead of leaving it stale
+    until an unrelated event."""
+    store = TopologyStore()
+    engine = TestEngineFailurePropagation.FlakyEngine(store, capacity=16)
+    link = Link(local_intf="eth1", peer_intf="eth1", peer_pod="r2",
+                uid=1, properties=LinkProperties(latency="10ms"))
+    t = Topology(name="r1", spec=TopologySpec(links=[link]))
+    t.status.links = []
+    store.create(t)
+    rec = Reconciler(store, engine)
+    # swallow the CREATE watch event so the later drain has NO events —
+    # only the requeue can drive the retry
+    list(rec._watch.poll())
+    res = rec.reconcile("default", "r1")
+    assert res.ok is False
+    assert ("default", "r1") in rec._requeue
+    results = rec.drain()
+    assert any(r.ok for r in results)
+    fresh = store.get("default", "r1")
+    assert fresh.status.links == fresh.spec.links

@@ -1,0 +1,752 @@
+"""Planned-update engine (kubedtn_tpu/updates/): planner ordering +
+static check, twin verification gate, stager equivalence/rollback, the
+reconciler's planned path, and the PlanUpdate/ApplyPlan wire surface.
+
+The two acceptance pins (ISSUE 8):
+
+- a CLEAN planned update staged through the live plane is byte-identical
+  to a direct `update_links` apply — edge-state SoA and telemetry ring
+  totals — at pipeline depths 1 and 2, unsharded and on the 8-device
+  forced-host CPU mesh;
+- a REGRESSING delta is rejected by the twin gate before touching the
+  live plane, and a mid-staging regression rolls back through the
+  journal: configuration state (uid/active/props, and src/dst on every
+  row active in either state) plus the host registries restore
+  bit-exactly, with dead-row residue exactly matching the engine's own
+  delete semantics.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from test_pipeline_determinism import _daemon_with_pairs, _tagged_frames
+
+from kubedtn_tpu.api.types import Link, LinkProperties
+from kubedtn_tpu.parallel.mesh import make_mesh
+from kubedtn_tpu.runtime import WireDataPlane
+from kubedtn_tpu.updates import (
+    Guardrails,
+    PlanError,
+    UpdateRound,
+    check_plan,
+    inverse_round,
+    plan_update,
+    verify_plan,
+)
+from kubedtn_tpu.updates.stager import UpdateStats
+from kubedtn_tpu.twin.snapshot import snapshot_from_engine
+
+
+def _link(uid, peer="b0", intf="eth1", props=None):
+    return Link(local_intf=intf, peer_intf=intf, peer_pod=peer, uid=uid,
+                properties=props or LinkProperties())
+
+
+# ---- planner ----------------------------------------------------------
+
+class TestPlanner:
+    def test_make_before_break_order(self):
+        old = [_link(1), _link(2, props=LinkProperties(latency="1ms"))]
+        new = [_link(2, props=LinkProperties(latency="9ms")), _link(3)]
+        plan = plan_update(old, new, name="a")
+        kinds = [("add" if r.adds else "change" if r.changes else "del")
+                 for r in plan.rounds]
+        assert kinds == ["add", "change", "del"]
+        assert plan.checked
+        assert plan.n_edits == 3
+
+    def test_round_chunking(self):
+        old = []
+        new = [_link(i, peer=f"b{i}") for i in range(5)]
+        plan = plan_update(old, new, name="a", max_round_edits=2)
+        assert [len(r.adds) for r in plan.rounds] == [2, 2, 1]
+        assert [r.index for r in plan.rounds] == [0, 1, 2]
+
+    def test_empty_diff_empty_plan(self):
+        links = [_link(1), _link(2)]
+        plan = plan_update(links, list(links), name="a")
+        assert plan.rounds == ()
+        assert plan.n_edits == 0
+
+    def test_changes_carry_old_props(self):
+        old = [_link(1, props=LinkProperties(latency="1ms"))]
+        new = [_link(1, props=LinkProperties(latency="9ms"))]
+        plan = plan_update(old, new, name="a")
+        (rnd,) = plan.rounds
+        assert rnd.changes[0].properties.latency == "9ms"
+        assert rnd.changes_old[0].properties.latency == "1ms"
+
+    def test_inverse_round(self):
+        old = [_link(1, props=LinkProperties(latency="1ms")), _link(2)]
+        new = [_link(1, props=LinkProperties(latency="9ms")), _link(3)]
+        plan = plan_update(old, new, name="a")
+        for rnd in plan.rounds:
+            inv = inverse_round(rnd)
+            assert inv.adds == rnd.dels
+            assert inv.dels == rnd.adds
+            assert inv.changes == rnd.changes_old
+            assert inv.changes_old == rnd.changes
+
+    def test_check_rejects_delete_before_add(self):
+        # identity change: a<->b0 connectivity moves from uid 1 to uid 2.
+        # Deleting first blackholes the pair transiently — the planner
+        # never emits this order; the check must refuse it.
+        old = [_link(1, intf="eth1")]
+        new = [_link(2, intf="eth2")]
+        plan = plan_update(old, new, name="a")
+        assert plan.checked  # planner's own order passes
+        bad = (UpdateRound(index=0, dels=tuple(old)),
+               UpdateRound(index=1, adds=tuple(new)))
+        with pytest.raises(PlanError, match="blackhole"):
+            check_plan(plan, rounds=bad)
+
+    def test_check_rejects_mixed_state_transient_loop(self):
+        """A transition whose OLD and NEW next-hops can mix into a
+        cycle must be refused: adding x-v reroutes y's traffic to v
+        through x (the tie-break picks x) while x, still on the old
+        round, forwards to v through y — nodes straddling the round
+        barrier would bounce x -> y -> x. The planner cannot split a
+        single add to fix this, so the delta is refused outright (the
+        reconciler's planned path then falls back to direct apply)."""
+        # fabric: x-y (uid "10"), y-w (uid "5"), w-v (uid "6").
+        # old: x reaches v via y->w->v (x's next hop: y).
+        # new: link x-v (uid 1) — y's next hops to v tie between x and
+        # w at distance 1; the deterministic tie-break (str(uid):
+        # "10" < "5") picks x. Union: x->y (old) + y->x (new) = loop.
+        fabric = [("default/x", "default/y", 10),
+                  ("default/y", "default/w", 5),
+                  ("default/w", "default/v", 6)]
+        plan = plan_update([], [_link(1, peer="v")], name="x",
+                           check=False)
+        with pytest.raises(PlanError, match="transient loop"):
+            check_plan(plan, fabric_edges=fabric)
+
+    def test_check_fabric_detour_allows_delete_first(self):
+        # same delta, but the surrounding fabric already connects the
+        # endpoints — no transient blackhole even delete-first
+        old = [_link(1, intf="eth1")]
+        new = [_link(2, intf="eth2")]
+        plan = plan_update(old, new, name="a")
+        bad = (UpdateRound(index=0, dels=tuple(old)),
+               UpdateRound(index=1, adds=tuple(new)))
+        reports = check_plan(plan, rounds=bad,
+                             fabric_edges=[("default/a", "default/b0")])
+        assert len(reports) == 2
+
+    def test_pair_disconnected_in_endpoint_is_not_a_demand(self):
+        # the END state drops the link entirely: operator intent, so the
+        # intermediate states owe that pair nothing
+        old = [_link(1)]
+        plan = plan_update(old, [], name="a")
+        assert plan.checked
+
+
+# ---- verification gate ------------------------------------------------
+
+def _realized_cluster(pairs=2, props=None):
+    props = props or LinkProperties(latency="2ms")
+    daemon, engine, win, wout = _daemon_with_pairs(pairs, props)
+    return daemon, engine, win, wout
+
+
+GATE_GUARDS = Guardrails(ticks=60, dt_us=1000.0)
+
+
+class TestGate:
+    def test_clean_plan_verified(self):
+        _d, engine, _wi, _wo = _realized_cluster()
+        topo = engine.store.get("default", "a0")
+        old = list(topo.status.links)
+        new = [l.with_properties(LinkProperties(latency="3ms"))
+               for l in old]
+        plan = plan_update(old, new, name="a0")
+        v = verify_plan(plan, snapshot_from_engine(engine),
+                        guardrails=Guardrails(ticks=60, dt_us=1000.0,
+                                              max_p99_factor=4.0))
+        assert v.ok, v.reason
+        assert len(v.rounds) == plan.n_rounds
+        assert v.baseline["delivery_ratio"] is not None
+        assert v.gate_s > 0
+
+    def test_regressing_plan_rejected(self):
+        _d, engine, _wi, _wo = _realized_cluster()
+        topo = engine.store.get("default", "a0")
+        old = list(topo.status.links)
+        new = [l.with_properties(LinkProperties(loss="80"))
+               for l in old]
+        plan = plan_update(old, new, name="a0")
+        v = verify_plan(plan, snapshot_from_engine(engine),
+                        guardrails=GATE_GUARDS)
+        assert not v.ok
+        assert "delivery" in v.reason
+        assert any(not r["ok"] for r in v.rounds)
+
+    def test_link_failure_rejected_via_fail_vocabulary(self):
+        # deleting a live link tanks that edge's delivery in the sweep —
+        # the DELETE round replays as a `fail` perturbation
+        _d, engine, _wi, _wo = _realized_cluster()
+        topo = engine.store.get("default", "a0")
+        old = list(topo.status.links)
+        plan = plan_update(old, [], name="a0")
+        v = verify_plan(plan, snapshot_from_engine(engine),
+                        guardrails=GATE_GUARDS)
+        assert not v.ok
+
+    def test_gate_degrade_targets_local_row_only(self):
+        """With pod_ids resolving the plan topology, a CHANGE degrades
+        only the LOCAL directed row — `update_links` semantics — so an
+        asymmetric peer configuration (loss on the reverse row) stays
+        in the replica and the gate verifies the exact end state
+        staging will produce."""
+        _d, engine, _wi, _wo = _realized_cluster(pairs=1)
+        # make the PEER direction lossy (it keeps shaping that way
+        # regardless of what the local end's update changes)
+        peer = engine.store.get("default", "b0")
+        assert engine.update_links(
+            peer, [l.with_properties(LinkProperties(loss="50"))
+                   for l in peer.spec.links])
+        topo = engine.store.get("default", "a0")
+        old = list(topo.status.links)
+        new = [l.with_properties(LinkProperties(latency="3ms"))
+               for l in old]
+        plan = plan_update(old, new, name="a0")
+        with engine._lock:
+            pod_ids = dict(engine._pod_ids)
+        v = verify_plan(plan, snapshot_from_engine(engine),
+                        guardrails=Guardrails(ticks=60, dt_us=1000.0,
+                                              max_p99_factor=8.0),
+                        pod_ids=pod_ids)
+        assert v.ok, v.reason
+        # the peer row's 50% loss is still shaping in the round replica
+        # (a uid-wide degrade would have wiped it and shown ~baseline-
+        # with-no-loss delivery); baseline carries the same loss, so
+        # the round's delivery must sit near the LOSSY baseline, well
+        # below a loss-free one
+        b = v.baseline["delivery_ratio"]
+        r = v.rounds[-1]["delivery_ratio"]
+        assert b < 0.9  # the peer loss shows in the baseline
+        assert abs(r - b) < 0.05, (r, b)
+
+    def test_adds_only_plan_trivially_verified(self):
+        _d, engine, _wi, _wo = _realized_cluster()
+        plan = plan_update([], [_link(9, peer="b0")], name="a0")
+        v = verify_plan(plan, snapshot_from_engine(engine),
+                        guardrails=GATE_GUARDS)
+        assert v.ok
+        assert v.skipped_adds == 1
+
+    def test_cumulative_rounds(self):
+        # round k's scenario carries rounds 1..k: a benign change in
+        # round 1 plus a killer delete in round 2 must show round 1
+        # clean and round 2 failing
+        _d, engine, _wi, _wo = _realized_cluster()
+        t0 = engine.store.get("default", "a0")
+        t1 = engine.store.get("default", "a1")
+        old = list(t0.status.links) + list(t1.status.links)
+        new = [old[0].with_properties(LinkProperties(latency="3ms"))]
+        plan = plan_update(old, new, name="a0")
+        v = verify_plan(plan, snapshot_from_engine(engine),
+                        guardrails=Guardrails(ticks=60, dt_us=1000.0,
+                                              max_p99_factor=4.0))
+        assert not v.ok
+        assert v.rounds[0]["ok"]          # change round alone: fine
+        assert not v.rounds[-1]["ok"]     # + delete round: regression
+
+
+# ---- stager: staged ≡ direct ------------------------------------------
+
+PROPS = LinkProperties(latency="3ms", jitter="1ms", loss="5")
+NEW_PROPS = LinkProperties(latency="5ms", jitter="1ms", loss="2")
+
+
+def _staged_or_direct(depth, mesh_n, staged, *, observe_ticks=2,
+                      n_per_wire=120, ticks_before=25, ticks_after=25):
+    """Drive one fresh plane through an identical deterministic
+    schedule; apply the same delta staged (plan → rounds → barriers)
+    or direct (one update_links). Returns (delivery, SoA columns,
+    telemetry totals, plane)."""
+    daemon, engine, win, wout = _daemon_with_pairs(2, PROPS)
+    plane = WireDataPlane(daemon, dt_us=2000.0, pipeline_depth=depth)
+    plane.pipeline_explicit_clock = True
+    plane.enable_telemetry(window_s=0.01, sample_period=4)
+    if mesh_n is not None:
+        plane.enable_sharding(make_mesh(mesh_n))
+    t = [100.0]
+
+    def ticks(n):
+        for _ in range(n):
+            t[0] += 0.002
+            plane.tick(now_s=t[0])
+
+    for k, wa in enumerate(win):
+        wa.ingress.extend(_tagged_frames(k, n_per_wire))
+    ticks(ticks_before)
+    topo = engine.store.get("default", "a0")
+    old = list(topo.status.links)
+    new = [l.with_properties(NEW_PROPS) for l in old]
+    if staged:
+        plan = plan_update(old, new, namespace="default", name="a0",
+                           max_round_edits=1)
+        res = plane.update_stager().stage(
+            plan, topo, observe_ticks=observe_ticks, tick_driver=ticks,
+            guardrails=Guardrails(max_p99_factor=8.0))
+        assert res.ok, res
+        assert res.rounds_applied == plan.n_rounds
+    else:
+        assert engine.update_links(topo, new)
+        # match the staged run's tick schedule exactly: its watch
+        # windows are idle ticks (no ingress), so the same idle ticks
+        # here keep both runs byte-comparable
+        ticks(observe_ticks * len(old))
+    for k, wa in enumerate(win):
+        wa.ingress.extend(_tagged_frames(k, n_per_wire))
+    ticks(ticks_after)
+    plane.flush()
+    plane.tick(now_s=t[0] + 10.0)
+    assert plane.tick_errors == 0
+    st = engine.state
+    cols = {n: np.asarray(getattr(st, n))
+            for n in ("uid", "src", "dst", "active", "props")}
+    tel, _secs = plane.telemetry.window_sum()
+    return [list(w.egress) for w in wout], cols, tel, plane
+
+
+@pytest.mark.parametrize("mesh_n,depth", [
+    (None, 1), (None, 2), (8, 1), (8, 2),
+], ids=["unsharded-d1", "unsharded-d2", "mesh8-d1", "mesh8-d2"])
+def test_staged_end_state_byte_identical_to_direct(mesh_n, depth):
+    """ISSUE 8 acceptance: staged apply ≡ direct update_links apply —
+    per-wire delivery bytes, the full edge-state SoA configuration
+    columns, and the telemetry window-ring totals — at depths 1 and 2,
+    unsharded and on the 8-device CPU mesh."""
+    if mesh_n is not None and len(jax.devices()) < mesh_n:
+        pytest.skip(f"needs {mesh_n} devices")
+    d_out, d_cols, d_tel, dp = _staged_or_direct(depth, mesh_n, False)
+    s_out, s_cols, s_tel, sp = _staged_or_direct(depth, mesh_n, True)
+    assert s_out == d_out
+    assert sp.shaped == dp.shaped
+    assert sp.dropped == dp.dropped
+    for name in d_cols:
+        np.testing.assert_array_equal(s_cols[name], d_cols[name],
+                                      err_msg=name)
+    np.testing.assert_array_equal(s_tel, d_tel)
+    assert sum(len(w) for w in d_out) > 0  # guards a vacuous pass
+
+
+# ---- stager: rollback --------------------------------------------------
+
+def _registry_state(engine):
+    return (dict(engine._rows), dict(engine._peer),
+            dict(engine._row_owner), set(engine._shaped_rows))
+
+
+def _fail_after(n):
+    calls = [0]
+
+    def health(_plane, _base):
+        calls[0] += 1
+        if calls[0] >= n:
+            return False, "injected regression", {}
+        return True, "", {}
+
+    return health
+
+
+class TestRollback:
+    def _plane(self):
+        daemon, engine, win, wout = _daemon_with_pairs(2, PROPS)
+        plane = WireDataPlane(daemon, dt_us=2000.0, pipeline_depth=1)
+        plane.pipeline_explicit_clock = True
+        plane.enable_telemetry(window_s=0.01, sample_period=4)
+        t = [100.0]
+
+        def ticks(n):
+            for _ in range(n):
+                t[0] += 0.002
+                plane.tick(now_s=t[0])
+
+        return daemon, engine, win, wout, plane, ticks
+
+    def test_changes_only_rollback_bit_exact(self):
+        """A regression mid-staging rolls the applied rounds back: for
+        a property-change plan EVERY configuration column (uid, src,
+        dst, active, props) and every registry restores bit-exactly."""
+        daemon, engine, win, wout, plane, ticks = self._plane()
+        for k, wa in enumerate(win):
+            wa.ingress.extend(_tagged_frames(k, 80))
+        ticks(25)
+        topo = engine.store.get("default", "a0")
+        old = list(topo.status.links)
+        new = [l.with_properties(NEW_PROPS) for l in old]
+        plan = plan_update(old, new, name="a0", max_round_edits=1)
+        st0 = engine.state
+        pre = {n: np.asarray(getattr(st0, n)).copy()
+               for n in ("uid", "src", "dst", "active", "props")}
+        pre_reg = _registry_state(engine)
+        res = plane.update_stager().stage(
+            plan, topo, observe_ticks=1, tick_driver=ticks,
+            health_check=_fail_after(1), guardrails=Guardrails())
+        assert not res.ok and res.rolled_back
+        assert res.rounds_applied == 0
+        st1 = engine.state
+        for name, a in pre.items():
+            np.testing.assert_array_equal(
+                np.asarray(getattr(st1, name)), a, err_msg=name)
+        assert _registry_state(engine) == pre_reg
+        # status was never copied: the delta remains pending
+        assert engine.store.get("default", "a0").status.links == old
+
+    def test_add_del_rollback_restores_config(self):
+        """Adds/deletes roll back to the exact pre-plan rows: uid,
+        active, props restore bit-exactly on every row; src/dst on
+        every row that is active in either state (rows freed by the
+        rolled-back add keep the engine's normal delete residue — the
+        same bytes a direct add-then-delete leaves)."""
+        daemon, engine, win, wout, plane, ticks = self._plane()
+        ticks(5)
+        topo = engine.store.get("default", "a0")
+        old = list(topo.status.links)
+        new = ([old[0].with_properties(NEW_PROPS)]
+               + [_link(9, peer="b0", intf="eth7",
+                        props=LinkProperties(latency="1ms"))])
+        plan = plan_update(old, new, name="a0", max_round_edits=1)
+        st0 = engine.state
+        pre = {n: np.asarray(getattr(st0, n)).copy()
+               for n in ("uid", "src", "dst", "active", "props")}
+        pre_reg = _registry_state(engine)
+        res = plane.update_stager().stage(
+            plan, topo, observe_ticks=1, tick_driver=ticks,
+            health_check=_fail_after(plan.n_rounds),
+            guardrails=Guardrails())
+        assert not res.ok and res.rolled_back
+        st1 = engine.state
+        post = {n: np.asarray(getattr(st1, n))
+                for n in ("uid", "src", "dst", "active", "props")}
+        for name in ("uid", "active", "props"):
+            np.testing.assert_array_equal(post[name], pre[name],
+                                          err_msg=name)
+        live = pre["active"] | post["active"]
+        np.testing.assert_array_equal(post["src"][live],
+                                      pre["src"][live])
+        np.testing.assert_array_equal(post["dst"][live],
+                                      pre["dst"][live])
+        assert _registry_state(engine) == pre_reg
+
+    def test_rollback_then_traffic_matches_untouched_plane(self):
+        """After a rollback the plane shapes EXACTLY like one that was
+        never staged: identical subsequent delivery bytes (INDEP
+        kernel class — no persistent row state involved)."""
+        def run(staged):
+            daemon, engine, win, wout, plane, ticks = self._plane()
+            for k, wa in enumerate(win):
+                wa.ingress.extend(_tagged_frames(k, 60))
+            ticks(20)
+            if staged:
+                topo = engine.store.get("default", "a0")
+                old = list(topo.status.links)
+                new = [l.with_properties(NEW_PROPS) for l in old]
+                plan = plan_update(old, new, name="a0")
+                res = plane.update_stager().stage(
+                    plan, topo, observe_ticks=2, tick_driver=ticks,
+                    health_check=_fail_after(1),
+                    guardrails=Guardrails())
+                assert res.rolled_back
+            else:
+                ticks(2)  # the staged run's watch window, idle here
+            for k, wa in enumerate(win):
+                wa.ingress.extend(_tagged_frames(k, 60))
+            ticks(25)
+            plane.flush()
+            plane.tick(now_s=1000.0)
+            return [list(w.egress) for w in wout]
+
+        assert run(True) == run(False)
+
+    def test_engine_op_failure_rolls_back(self):
+        """A mid-round engine failure (the dispatch-failure hook) rolls
+        back instead of leaving a half-applied round."""
+        daemon, engine, win, wout, plane, ticks = self._plane()
+        ticks(3)
+        topo = engine.store.get("default", "a0")
+        old = list(topo.status.links)
+        new = [l.with_properties(NEW_PROPS) for l in old]
+        plan = plan_update(old, new, name="a0")
+        st0 = engine.state
+        pre_props = np.asarray(st0.props).copy()
+        real = engine.update_links
+        engine.update_links = lambda *_a, **_k: False
+        try:
+            res = plane.update_stager().stage(
+                plan, topo, observe_ticks=0, guardrails=Guardrails())
+        finally:
+            engine.update_links = real
+        assert not res.ok and res.rolled_back
+        assert "dispatch failure" in res.reason
+        np.testing.assert_array_equal(np.asarray(engine.state.props),
+                                      pre_props)
+
+    def test_ladder_signal_triggers_rollback(self):
+        """The PR 2 fault-domain hook: a tick_errors rise during the
+        watch window is a regression — the built-in health check rolls
+        the round back."""
+        daemon, engine, win, wout, plane, ticks = self._plane()
+        ticks(3)
+        topo = engine.store.get("default", "a0")
+        old = list(topo.status.links)
+        new = [l.with_properties(NEW_PROPS) for l in old]
+        plan = plan_update(old, new, name="a0")
+
+        def failing_driver(n):
+            plane.tick_errors += 1  # what the runner does on a failure
+            ticks(n)
+
+        res = plane.update_stager().stage(
+            plan, topo, observe_ticks=1, tick_driver=failing_driver,
+            guardrails=Guardrails())
+        assert not res.ok and res.rolled_back
+        assert "tick_errors" in res.reason
+
+    def test_one_staging_at_a_time(self):
+        daemon, engine, win, wout, plane, ticks = self._plane()
+        topo = engine.store.get("default", "a0")
+        old = list(topo.status.links)
+        plan = plan_update(
+            old, [l.with_properties(NEW_PROPS) for l in old], name="a0")
+        stager = plane.update_stager()
+        with stager._tick_lock:
+            stager._staging_key = "default/other"
+        try:
+            with pytest.raises(RuntimeError, match="in progress"):
+                stager.stage(plan, topo, observe_ticks=0)
+        finally:
+            with stager._tick_lock:
+                stager._staging_key = None
+
+
+# ---- reconciler planned path ------------------------------------------
+
+class TestPlannedReconcile:
+    def _cluster(self):
+        from kubedtn_tpu.topology import Reconciler
+
+        daemon, engine, win, wout = _daemon_with_pairs(2, PROPS)
+        plane = WireDataPlane(daemon, dt_us=2000.0, pipeline_depth=1)
+        plane.pipeline_explicit_clock = True
+        stats = UpdateStats()
+        rec = Reconciler(
+            engine.store, engine, plane=plane, planned=True,
+            guardrails=Guardrails(ticks=60, dt_us=1000.0,
+                                  max_p99_factor=8.0),
+            observe_ticks=0, update_stats=stats)
+        return engine, plane, rec, stats
+
+    def test_clean_delta_routes_through_planner(self):
+        engine, plane, rec, stats = self._cluster()
+        topo = engine.store.get("default", "a0")
+        topo.spec.links = [l.with_properties(NEW_PROPS)
+                           for l in topo.spec.links]
+        engine.store.update(topo)
+        results = [r for r in rec.drain() if r.action != "noop"]
+        assert [r.action for r in results] == ["planned"]
+        assert results[0].ok
+        assert "gate" in results[0].phase_ms
+        fresh = engine.store.get("default", "a0")
+        assert fresh.status.links == fresh.spec.links
+        row = engine.link_row("default/a0", 1)
+        assert row["latency_us"] == pytest.approx(5000.0)
+        assert stats.snapshot()["plans_verified"] == 1
+
+    def test_regressing_delta_rejected_before_live_plane(self):
+        """ISSUE 8 acceptance: the gate blocks a regressing delta
+        BEFORE it touches the live plane — device state unchanged,
+        status stale, no requeue spin."""
+        engine, plane, rec, stats = self._cluster()
+        pre_props = np.asarray(engine.state.props).copy()
+        topo = engine.store.get("default", "a0")
+        old_status = list(topo.status.links)
+        topo.spec.links = [l.with_properties(LinkProperties(loss="80"))
+                           for l in topo.spec.links]
+        engine.store.update(topo)
+        results = [r for r in rec.drain() if r.action != "noop"]
+        assert [r.action for r in results] == ["plan-rejected"]
+        assert not results[0].ok
+        np.testing.assert_array_equal(np.asarray(engine.state.props),
+                                      pre_props)
+        fresh = engine.store.get("default", "a0")
+        assert fresh.status.links == old_status  # delta NOT recorded
+        assert rec._requeue == set()  # deterministic verdict: no spin
+        assert stats.snapshot()["plans_rejected"] == 1
+
+    def test_direct_path_still_default(self):
+        from kubedtn_tpu.topology import Reconciler
+
+        daemon, engine, _wi, _wo = _daemon_with_pairs(1, PROPS)
+        rec = Reconciler(engine.store, engine)
+        assert rec.planned is False
+        topo = engine.store.get("default", "a0")
+        topo.spec.links = [l.with_properties(NEW_PROPS)
+                           for l in topo.spec.links]
+        engine.store.update(topo)
+        results = [r for r in rec.drain() if r.action != "noop"]
+        assert [r.action for r in results] == ["changed"]
+
+
+# ---- wire surface ------------------------------------------------------
+
+class TestWireSurface:
+    def _daemon(self):
+        daemon, engine, win, wout = _daemon_with_pairs(2, PROPS)
+        plane = WireDataPlane(daemon, dt_us=2000.0, pipeline_depth=1)
+        plane.pipeline_explicit_clock = True
+        return daemon, engine, plane
+
+    def _request(self, pb, engine, name, props, **kw):
+        topo = engine.store.get("default", name)
+        desired = [l.with_properties(props) for l in topo.spec.links]
+        return pb.PlanUpdateRequest(
+            name=name, kube_ns="default",
+            links=[pb.link_to_proto(l) for l in desired],
+            ticks=60, max_p99_factor=8.0, **kw)
+
+    def test_plan_then_apply(self):
+        from kubedtn_tpu.wire import proto as pb
+
+        daemon, engine, plane = self._daemon()
+        resp = daemon.PlanUpdate(
+            self._request(pb, engine, "a0", NEW_PROPS), None)
+        assert resp.ok, resp.error
+        assert resp.verified
+        assert resp.plan_id > 0
+        assert len(resp.rounds) == 1
+        assert resp.rounds[0].changes == 1
+        assert resp.baseline_delivery_ratio > 0
+        apply_resp = daemon.ApplyPlan(
+            pb.ApplyPlanRequest(plan_id=resp.plan_id), None)
+        assert apply_resp.ok, apply_resp
+        assert apply_resp.rounds_applied == 1
+        assert not apply_resp.rolled_back
+        fresh = engine.store.get("default", "a0")
+        assert fresh.spec.links[0].properties.latency == "5ms"
+        assert fresh.status.links == fresh.spec.links
+        # consumed: a second apply of the same id fails loudly
+        again = daemon.ApplyPlan(
+            pb.ApplyPlanRequest(plan_id=resp.plan_id), None)
+        assert not again.ok
+        assert "unknown or expired" in again.error
+
+    def test_regressing_plan_gets_no_id(self):
+        from kubedtn_tpu.wire import proto as pb
+
+        daemon, engine, plane = self._daemon()
+        resp = daemon.PlanUpdate(
+            self._request(pb, engine, "a0",
+                          LinkProperties(loss="80")), None)
+        assert resp.ok
+        assert not resp.verified
+        assert resp.plan_id == 0
+        assert "delivery" in resp.reject_reason
+
+    def test_apply_conflict_on_moved_topology(self):
+        from kubedtn_tpu.wire import proto as pb
+
+        daemon, engine, plane = self._daemon()
+        resp = daemon.PlanUpdate(
+            self._request(pb, engine, "a0", NEW_PROPS), None)
+        assert resp.verified
+        # the topology moves between plan and apply
+        topo = engine.store.get("default", "a0")
+        topo.status.links = [
+            l.with_properties(LinkProperties(latency="7ms"))
+            for l in topo.status.links]
+        engine.store.update_status(topo)
+        apply_resp = daemon.ApplyPlan(
+            pb.ApplyPlanRequest(plan_id=resp.plan_id), None)
+        assert not apply_resp.ok
+        assert "conflict" in apply_resp.error
+
+    def test_apply_does_not_clobber_newer_spec(self):
+        """A desired state posted AFTER the plan was built must survive
+        the apply: status records what was realized, the newer spec is
+        left for the next reconcile to converge toward."""
+        from kubedtn_tpu.wire import proto as pb
+
+        daemon, engine, plane = self._daemon()
+        resp = daemon.PlanUpdate(
+            self._request(pb, engine, "a0", NEW_PROPS), None)
+        assert resp.verified
+        # operator posts a NEWER desired state via the normal spec path
+        v2 = LinkProperties(latency="8ms")
+        topo = engine.store.get("default", "a0")
+        topo.spec.links = [l.with_properties(v2)
+                           for l in topo.spec.links]
+        engine.store.update(topo)
+        apply_resp = daemon.ApplyPlan(
+            pb.ApplyPlanRequest(plan_id=resp.plan_id), None)
+        assert apply_resp.ok, apply_resp
+        fresh = engine.store.get("default", "a0")
+        # v2's intent preserved; the realized state is the plan's
+        assert fresh.spec.links[0].properties.latency == "8ms"
+        assert fresh.status.links[0].properties.latency == "5ms"
+        assert fresh.spec.links != fresh.status.links  # reconcilable
+
+    def test_unrealized_topology_is_an_error(self):
+        from kubedtn_tpu.wire import proto as pb
+        from kubedtn_tpu.api.types import Topology, TopologySpec
+
+        daemon, engine, plane = self._daemon()
+        engine.store.create(Topology(
+            name="fresh", spec=TopologySpec(links=[_link(1)])))
+        resp = daemon.PlanUpdate(pb.PlanUpdateRequest(
+            name="fresh", kube_ns="default",
+            links=[pb.link_to_proto(_link(1))]), None)
+        assert not resp.ok
+        assert "not realized" in resp.error
+
+    def test_empty_diff_is_verified_noop(self):
+        from kubedtn_tpu.wire import proto as pb
+
+        daemon, engine, plane = self._daemon()
+        topo = engine.store.get("default", "a0")
+        resp = daemon.PlanUpdate(pb.PlanUpdateRequest(
+            name="a0", kube_ns="default",
+            links=[pb.link_to_proto(l) for l in topo.status.links]),
+            None)
+        assert resp.ok and resp.verified
+        assert resp.plan_id == 0
+        assert len(resp.rounds) == 0
+
+
+# ---- metrics -----------------------------------------------------------
+
+def test_update_stats_collector_series():
+    from kubedtn_tpu.metrics.metrics import UpdateStatsCollector
+
+    stats = UpdateStats()
+
+    class _V:
+        ok = True
+        gate_s = 0.25
+
+    stats.record_plan(_V())
+    fams = UpdateStatsCollector(stats).collect()
+    names = {f.name for f in fams}
+    assert "kubedtn_update_plans_built" in names
+    assert "kubedtn_update_rollbacks" in names
+    by_name = {f.name: f for f in fams}
+    assert by_name["kubedtn_update_plans_built"].samples[0].value == 1.0
+    assert by_name["kubedtn_update_gate_seconds"].samples[0].value \
+        == pytest.approx(0.25)
+
+
+def test_guarded_by_registry_covers_stager():
+    """ISSUE 8 satellite: the stager's shared state is declared under
+    the plane's tick lock for dtnlint's lock pass."""
+    from kubedtn_tpu import contracts
+    import kubedtn_tpu.updates.stager  # noqa: F401  (applies decorator)
+
+    reg = contracts.registry()
+    stager = reg.get("kubedtn_tpu.updates.stager.UpdateStager", {})
+    assert stager.get("_journal") == "_tick_lock"
+    assert stager.get("_staging_key") == "_tick_lock"
