@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: all test test-fast bench native crd daemon scenario-% docker clean \
-	lint typecheck verify
+	lint typecheck verify verify-fast
 
 all: native test
 
@@ -26,8 +26,17 @@ typecheck:                 ## strict types over the contract core (when installe
 		echo "pyright/mypy not installed; configs live in pyproject.toml"; \
 	fi
 
-verify: lint typecheck native  ## lint + types, then the tier-1 suite
+verify: typecheck native   ## both analysis layers + types, then tier-1
+	$(PY) -m kubedtn_tpu.analysis --verify --json ANALYSIS.json
+	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
+		$(PY) -m ruff check kubedtn_tpu tests bench.py; \
+	else \
+		echo "ruff not installed; dtnlint's hygiene pass covered the floor"; \
+	fi
 	$(PY) -m pytest tests/ -q -m "not slow"
+
+verify-fast:               ## pre-commit gate: dtnlint + dtnverify, no pytest
+	$(PY) -m kubedtn_tpu.analysis --verify --cached -q --json ANALYSIS.json
 
 test: native               ## full suite (CPU, virtual 8-device mesh)
 	$(PY) -m pytest tests/ -q

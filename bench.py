@@ -748,6 +748,45 @@ def main() -> None:
                 "baseline_delivery_ratio", "worst_delivery_ratio",
                 "baseline_p99_us") if k in r}
 
+    def run_verify_gate():
+        # dtnverify trajectory: the jaxpr-layer gate's per-entry-point
+        # compiled cost (XLA flops/bytes at the canonical harness
+        # shapes) and the fused tick's measured dispatches/tick land in
+        # the bench record, so cost drift across PRs is readable from
+        # the BENCH_r*.json series, not just pass/fail in tier-1.
+        # Subprocess-isolated like the live phases (it builds and ticks
+        # its own probe plane).
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           ".bench_verify.json")
+        try:
+            p = subprocess.run(
+                [sys.executable, "-m", "kubedtn_tpu.analysis",
+                 "--verify", "-q", "--json", out],
+                capture_output=True, text=True, timeout=900.0,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            # returncode first: a crashed run writes no artifact, and
+            # the traceback in stderr beats a FileNotFoundError
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"verify gate failed rc={p.returncode}: "
+                    f"{(p.stderr or p.stdout)[-400:]}")
+            with open(out) as fh:
+                doc = json.load(fh)
+        finally:
+            if os.path.exists(out):
+                os.unlink(out)
+        j = doc.get("jaxpr", {})
+        extras["verify_gate"] = {
+            "exit_code": p.returncode,
+            "ast_findings": doc.get("summary", {}),
+            "jaxpr_findings": j.get("summary", {}),
+            "dispatch": j.get("dispatch", {}),
+            "entry_costs": {
+                name: {k: ep[k] for k in ("flops", "bytes", "eqns")
+                       if k in ep}
+                for name, ep in j.get("entry_points", {}).items()},
+        }
+
     def run_reconverge_10k():
         from kubedtn_tpu.scenarios import reconverge_10k
 
@@ -814,6 +853,7 @@ def main() -> None:
     phase("telemetry_overhead", run_telemetry_overhead)
     phase("whatif_sweep", run_whatif_sweep)
     phase("reconverge_10k", run_reconverge_10k)
+    phase("verify_gate", run_verify_gate)
 
     try:
         extras["host"]["loadavg_end"] = [round(x, 2)

@@ -34,6 +34,7 @@ from kubedtn_tpu.analysis.core import (
     Finding,
     Project,
     apply_waivers,
+    stale_waivers,
     summarize,
     write_json,
 )
@@ -52,7 +53,10 @@ def run_suite(root: Path | None = None,
               rules: tuple[str, ...] | None = None,
               packages: tuple[str, ...] = ("kubedtn_tpu",),
               ) -> tuple[Project, list[Finding]]:
-    """Parse the tree, run the selected passes, apply waivers."""
+    """Parse the tree, run the selected passes, apply waivers. A full
+    run (rules=None) additionally reports STALE waivers — `<rule>-ok`
+    comments no finding matches anymore; a subset run cannot judge
+    staleness (the un-run rules' waivers would all look dead)."""
     root = root if root is not None else default_root()
     project = Project(root, packages=packages)
     graph = CallGraph(project)
@@ -60,4 +64,9 @@ def run_suite(root: Path | None = None,
     for rule in (rules if rules is not None else tuple(PASSES)):
         findings.extend(PASSES[rule](project, graph))
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
-    return project, apply_waivers(project, findings)
+    used: set = set()
+    findings = apply_waivers(project, findings, used=used)
+    if rules is None:
+        findings.extend(stale_waivers(project, used))
+        findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return project, findings

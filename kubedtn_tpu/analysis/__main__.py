@@ -1,18 +1,40 @@
-"""``python -m kubedtn_tpu.analysis`` — run dtnlint over the tree.
+"""``python -m kubedtn_tpu.analysis`` — run the contract suite.
 
-Exit status 0 iff every finding is waived (``# dtnlint:
-<rule>-ok(reason)``). ``--json`` writes the machine-readable artifact
-(the tier-1 test writes ``ANALYSIS.json`` at the repo root so benches
-can track the findings-count trajectory).
+Two layers, one artifact:
+
+- **dtnlint** (default): the AST passes over the tree. Exit 0 iff
+  every finding is waived (``# dtnlint: <rule>-ok(reason)``).
+- **dtnverify** (``--verify``): the jaxpr layer — trace the real tick/
+  sweep programs and check the op-allowlist / key-provenance /
+  dtype-flow / sharding contracts plus the COST_BUDGET.json dispatch &
+  cost gate. ``--cached`` replays the stored result when no package
+  source changed (the `make verify-fast` / pre-commit path);
+  ``--update-budgets`` re-baselines the budget file.
+
+``--json PATH`` writes the machine-readable artifact (schema v2; the
+tier-1 tests write ``ANALYSIS.json`` at the repo root). ``--diff
+OLD.json`` compares artifacts (new / fixed / waiver-flips) for
+reviewer use. ``--fix`` mechanically repairs hygiene findings (unused
+imports, import-group order) in place.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from pathlib import Path
 
-from kubedtn_tpu.analysis import (
+# the sharded entry point needs a multi-device mesh; harmless
+# everywhere else, and it must land before jax initializes a backend
+if "--verify" in sys.argv \
+        and "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
+from kubedtn_tpu.analysis import (  # noqa: E402  (XLA_FLAGS first)
     PASSES,
     default_root,
     run_suite,
@@ -21,12 +43,54 @@ from kubedtn_tpu.analysis import (
 )
 
 
+def _merge_subset_section(path: Path, section: dict,
+                          entries: tuple[str, ...]) -> dict:
+    """An `--entries` subset run must not clobber the artifact's FULL
+    jaxpr section (8 entry points, dispatch pins, budget status) with
+    a partial one: merge the re-traced entries over the existing
+    section, keeping every other entry's state and the full-run-only
+    dispatch/budget results."""
+    import json
+
+    try:
+        old = json.loads(Path(path).read_text()).get("jaxpr")
+    except (OSError, ValueError):
+        old = None
+    if not old:
+        return section
+    merged = dict(old)
+    merged["entry_points"] = {**old.get("entry_points", {}),
+                              **section.get("entry_points", {})}
+    tags = tuple(f"[{e}] " for e in entries)
+    # drop only findings the subset run REGENERATES: the per-entry IR
+    # passes re-ran, but jcost (dispatch counts + budget comparison) is
+    # full-run-only — dropping an active jcost finding here would flip
+    # the artifact to clean without anything re-measuring the regression
+    kept = [f for f in old.get("findings", [])
+            if f.get("rule") == "jcost"
+            or not f.get("message", "").startswith(tags)]
+    merged["findings"] = kept + section.get("findings", [])
+    merged["summary"] = {
+        **old.get("summary", {}),
+        "total": len(merged["findings"]),
+        "unwaivered": sum(1 for f in merged["findings"]
+                          if not f.get("waived")),
+        "entries_traced": len([v for v in
+                               merged["entry_points"].values()
+                               if "skipped" not in v]),
+        "entries_skipped": len([v for v in
+                                merged["entry_points"].values()
+                                if "skipped" in v]),
+    }
+    return merged
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m kubedtn_tpu.analysis",
-        description="dtnlint: contract-checking static analysis for "
-                    "the determinism / key / host-sync / lock / dtype "
-                    "invariants")
+        description="dtnlint + dtnverify: contract checking for the "
+                    "determinism / key / host-sync / lock / dtype "
+                    "invariants, at the AST and jaxpr levels")
     ap.add_argument("--root", type=Path, default=None,
                     help="repo root (default: the installed package's "
                          "parent)")
@@ -39,7 +103,33 @@ def main(argv: list[str] | None = None) -> int:
                     help="print waived findings too")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="summary line only")
+    ap.add_argument("--verify", action="store_true",
+                    help="additionally run dtnverify: trace the "
+                         "compiled tick/sweep programs and check the "
+                         "jaxpr-level contracts + cost budgets")
+    ap.add_argument("--entries", default=None, metavar="NAMES",
+                    help="comma-separated dtnverify entry-point subset "
+                         "(skips the dispatch/budget gate, which needs "
+                         "the full set)")
+    ap.add_argument("--cached", action="store_true",
+                    help="reuse the stored dtnverify result when no "
+                         "kubedtn_tpu source changed (pre-commit path)")
+    ap.add_argument("--update-budgets", action="store_true",
+                    help="re-baseline COST_BUDGET.json from the "
+                         "measured dispatch counts and compiled costs")
+    ap.add_argument("--fix", action="store_true",
+                    help="mechanically repair hygiene findings "
+                         "(unused imports, import-group order)")
+    ap.add_argument("--diff", type=Path, default=None, metavar="OLD",
+                    help="compare OLD ANALYSIS artifact against "
+                         "--json PATH (or a fresh run) and exit")
     args = ap.parse_args(argv)
+
+    if args.diff is not None and args.json is None:
+        # validated up front: a forgotten --json must not cost a full
+        # --verify trace (and possibly a --fix rewrite) first
+        ap.error("--diff needs --json PATH (the artifact to compare "
+                 "against)")
 
     rules = None
     if args.rules:
@@ -50,9 +140,46 @@ def main(argv: list[str] | None = None) -> int:
                      f"(have: {', '.join(PASSES)})")
 
     root = args.root if args.root is not None else default_root()
-    _project, findings = run_suite(root=root, rules=rules)
+    project, findings = run_suite(root=root, rules=rules)
+
+    if args.fix:
+        from kubedtn_tpu.analysis.fix import fix_tree
+
+        changed = fix_tree(root, project, findings)
+        for rel in changed:
+            print(f"fixed: {rel}")
+        # re-lint the repaired tree so the report reflects reality
+        project, findings = run_suite(root=root, rules=rules)
+
+    ast_findings = findings
+    jaxpr_section = None
+    if args.verify:
+        from kubedtn_tpu.analysis.verify import run_verify
+
+        entries = (tuple(e.strip() for e in args.entries.split(",")
+                         if e.strip()) if args.entries else None)
+        vfindings, report = run_verify(
+            root=root, entries=entries, use_cache=args.cached,
+            update_budgets=args.update_budgets)
+        jaxpr_section = dict(report)
+        jaxpr_section["findings"] = [f.to_json() for f in vfindings]
+        jaxpr_section["summary"] = {
+            **report.get("summary", {}),
+            "total": len(vfindings),
+            "unwaivered": sum(1 for f in vfindings if not f.waived),
+        }
+        if entries is not None and args.json is not None:
+            jaxpr_section = _merge_subset_section(
+                args.json, jaxpr_section, entries)
+        findings = ast_findings + vfindings
+
     if args.json is not None:
-        write_json(args.json, findings, root)
+        write_json(args.json, ast_findings, root, jaxpr=jaxpr_section)
+
+    if args.diff is not None:
+        from kubedtn_tpu.analysis.diff import run_diff
+
+        return run_diff(args.diff, args.json)
 
     active = [f for f in findings if not f.waived]
     if not args.quiet:
@@ -61,7 +188,8 @@ def main(argv: list[str] | None = None) -> int:
             print(f.format())
     s = summarize(findings)
     by_rule = ", ".join(f"{k}={v}" for k, v in s["by_rule"].items())
-    print(f"dtnlint: {s['total']} finding(s), {s['waived']} waived, "
+    layer = "dtnlint+dtnverify" if args.verify else "dtnlint"
+    print(f"{layer}: {s['total']} finding(s), {s['waived']} waived, "
           f"{s['unwaivered']} active ({by_rule or 'clean tree'})")
     return 1 if active else 0
 

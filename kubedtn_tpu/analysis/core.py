@@ -24,8 +24,25 @@ RULE_SYNC = "sync"
 RULE_LOCK = "lock"
 RULE_DTYPE = "dtype"
 RULE_HYGIENE = "hygiene"
+# meta-rule: a waiver comment whose line no longer triggers its rule
+# (dead waivers rot the audit trail — the reason reads as if it
+# justifies something, but nothing is being justified)
+RULE_WAIVER = "waiver"
 ALL_RULES = (RULE_PURITY, RULE_KEY, RULE_SYNC, RULE_LOCK, RULE_DTYPE,
-             RULE_HYGIENE)
+             RULE_HYGIENE, RULE_WAIVER)
+# the dtnverify (jaxpr-layer) rule tags. These are deliberately NOT
+# waivable: a jaxpr finding means a compiled program breaks a
+# byte-identity/fusion contract, and the sanctioned overrides are the
+# vetted allowlist or --update-budgets. A `<tag>-ok(...)` comment for
+# one of these is dead by construction — stale_waivers names it as
+# such instead of pretending the rule merely stopped firing.
+JAXPR_RULES = ("jops", "jkey", "jdtype", "jshard", "jcost")
+
+# the ANALYSIS.json artifact schema. v1: flat dtnlint findings doc
+# (PRs 6-7). v2: adds `schema_version` and the dtnverify `jaxpr`
+# section; the AST layer keeps its v1 top-level keys so v1 consumers
+# (and `--diff` against old artifacts) keep working.
+SCHEMA_VERSION = 2
 
 # the reason may itself contain parens (`tick() re-reads...`): match
 # lazily but only stop at a ')' followed by end-of-line, another
@@ -89,11 +106,19 @@ class SourceFile:
         """The waiver reason covering (rule, line), if any: the line
         itself, the line above it (comment-on-its-own-line style), or
         any enclosing def/class header line."""
+        m = self.waiver_match(rule, line)
+        return m[1] if m is not None else None
+
+    def waiver_match(self, rule: str, line: int
+                     ) -> tuple[int, str] | None:
+        """Like `waiver_for`, but returns (waiver_line, reason) so
+        callers can track WHICH waiver comment fired — the stale-waiver
+        meta-rule reports the ones that never do."""
         for cand in (line, line - 1):
             reason = self.waivers.get(cand, {}).get(rule)
             if reason is not None and (cand == line
                                        or self._is_comment_line(cand)):
-                return reason
+                return cand, reason
         for start, end, header in self._scopes:
             if start <= line <= end:
                 for cand in (header, header - 1):
@@ -101,7 +126,7 @@ class SourceFile:
                     if reason is not None and (
                             cand == header
                             or self._is_comment_line(cand)):
-                        return reason
+                        return cand, reason
         return None
 
     def _is_comment_line(self, line: int) -> bool:
@@ -138,19 +163,56 @@ class Project:
         return None
 
 
-def apply_waivers(project: Project,
-                  findings: list[Finding]) -> list[Finding]:
+def apply_waivers(project: Project, findings: list[Finding],
+                  used: set | None = None) -> list[Finding]:
     """Mark each finding waived when its file carries a matching
-    ``<rule>-ok(reason)`` waiver in scope."""
+    ``<rule>-ok(reason)`` waiver in scope. `used` (when given)
+    collects the ``(path, waiver_line, rule)`` triples that actually
+    fired, for stale-waiver detection."""
     for f in findings:
         src = project.files.get(f.path)
         if src is None:
             continue
-        reason = src.waiver_for(f.rule, f.line)
-        if reason is not None:
+        m = src.waiver_match(f.rule, f.line)
+        if m is not None:
             f.waived = True
-            f.waiver_reason = reason
+            f.waiver_reason = m[1]
+            if used is not None:
+                used.add((f.path, m[0], f.rule))
     return findings
+
+
+def stale_waivers(project: Project, used: set) -> list[Finding]:
+    """The waiver meta-rule: every ``<rule>-ok(reason)`` comment that
+    matched NO finding is itself a finding — the rule stopped
+    triggering (code moved, bug fixed, rule refined) and the dead
+    waiver now documents a justification for nothing. Only meaningful
+    after a FULL pass run: a subset run would see every other rule's
+    waivers as stale."""
+    out: list[Finding] = []
+    for src in project:
+        for line, rules in sorted(src.waivers.items()):
+            for rule, reason in sorted(rules.items()):
+                if rule == RULE_WAIVER:
+                    continue  # waiving stale-waiver reports is circular
+                if (src.rel, line, rule) in used:
+                    continue
+                if rule in JAXPR_RULES:
+                    out.append(Finding(
+                        RULE_WAIVER, src.rel, line,
+                        f"waiver `{rule}-ok({reason})` targets a "
+                        f"jaxpr-layer rule — dtnverify findings are "
+                        f"not waivable; fix the program, extend the "
+                        f"vetted allowlist, or re-baseline with "
+                        f"--update-budgets"))
+                else:
+                    out.append(Finding(
+                        RULE_WAIVER, src.rel, line,
+                        f"stale waiver `{rule}-ok({reason})` — no "
+                        f"`{rule}` finding triggers here anymore; "
+                        f"drop the comment (dead waivers rot the "
+                        f"audit trail)"))
+    return out
 
 
 def summarize(findings: list[Finding]) -> dict[str, object]:
@@ -167,17 +229,29 @@ def summarize(findings: list[Finding]) -> dict[str, object]:
     }
 
 
-def write_json(path: Path, findings: list[Finding],
-               root: Path) -> None:
-    """The machine-readable artifact (ANALYSIS.json): stable ordering,
-    no timestamps — diffs track the findings-count trajectory."""
+def write_json(path: Path, findings: list[Finding], root: Path,
+               jaxpr: dict | None = None) -> None:
+    """The machine-readable artifact (ANALYSIS.json, schema v2):
+    stable ordering, no timestamps — diffs track the findings-count
+    trajectory. The AST layer keeps the v1 top-level keys; the
+    dtnverify layer lands in the `jaxpr` section. A writer that ran
+    only one layer PRESERVES the other layer's existing section, so
+    the artifact stays complete whichever gate wrote last."""
     findings = sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    if jaxpr is None and path.exists():
+        try:
+            jaxpr = json.loads(path.read_text()).get("jaxpr")
+        except (OSError, ValueError):
+            jaxpr = None
     doc = {
         "tool": "dtnlint",
+        "schema_version": SCHEMA_VERSION,
         "root": root.name,
         "summary": summarize(findings),
         "findings": [f.to_json() for f in findings],
     }
+    if jaxpr is not None:
+        doc["jaxpr"] = dict(jaxpr)
     path.write_text(json.dumps(doc, indent=2) + "\n")
 
 

@@ -1,0 +1,55 @@
+"""dtnverify — jaxpr-level contract verification of the compiled tick
+programs.
+
+dtnlint (the AST layer, `kubedtn_tpu.analysis.passes`) checks the
+determinism / key / host-sync / lock / dtype contracts where they are
+WRITTEN; this package checks them where they are STAKED — in the
+lowered programs the plane actually dispatches. The real entry points
+(the fused tick at depths 1/2, the degradation ladder's `_class_tick`,
+the sharded `shard_map` program, the twin's replica scan, the update
+gate's sweep) are traced into jaxprs and compiled executables, then
+four pass families run over the IR:
+
+========  ==============================================================
+rule      contract (NOT waivable — see below)
+========  ==============================================================
+jops      op-allowlist determinism: no primitive outside the vetted
+          set, no nondeterministic collective/host-callback primitives
+          on the tick path
+jkey      every ``random_bits`` is reachable only through a
+          ``split``/``fold_in`` chain rooted at the tick key argument —
+          no key minted, baked, or consumed raw inside traced code
+jdtype    IR-level f64 taint: no truncating cast on a wall-clock-
+          anchored f64 value, no f64-anchored value scattered into an
+          f32 SoA column, no stray f64 inside the f32 tick programs
+jshard    sharding audit: key/batch args replicated into the shard_map
+          program, ppermute the only collective (scatters stay local
+          to the owning shard), foreign mailbox bits move through
+          ``select_n`` only — never arithmetic
+jcost     dispatch & cost budget: compiled dispatches per tick and XLA
+          cost-analysis FLOPs/bytes per entry point against the
+          checked-in ``COST_BUDGET.json``
+========  ==============================================================
+
+Unlike the AST layer, jaxpr findings carry NO waiver mechanism: a
+finding here means a compiled program violates a byte-identity or
+fusion contract, and the sanctioned overrides are structural — extend
+the vetted allowlist (a reviewed code change) or re-baseline the
+budgets (``--update-budgets``). A ``# dtnlint: jops-ok(...)``-style
+comment does nothing and is reported as a dead waiver.
+
+The eBPF-verifier analogy (SURVEY §2.9) is deliberate: the reference
+enforces its data-plane contracts with kernel verifier constraints at
+load time; the TPU-native equivalent is verification over the jaxprs
+and executables themselves, gating tier-1 before any bench run.
+"""
+
+from __future__ import annotations
+
+from kubedtn_tpu.analysis.verify.runner import (
+    VERIFY_RULES,
+    VerifyReport,
+    run_verify,
+)
+
+__all__ = ["run_verify", "VerifyReport", "VERIFY_RULES"]

@@ -1,0 +1,140 @@
+"""jcost — the checked-in dispatch & cost budgets (COST_BUDGET.json).
+
+The budget file pins, per entry point, the XLA cost-analysis FLOPs and
+bytes-accessed of the compiled program at the harness's canonical
+shapes, plus the measured dispatches-per-tick of the fused tick. A
+refactor that silently splits the fused dispatch (dispatch count is
+matched EXACTLY) or bloats an entry point's compiled cost past the
+tolerance fails tier-1 before any bench run.
+
+Honesty rules:
+- budgets are backend-specific (cost analysis differs across
+  backends); a mismatched backend skips the flops/bytes comparison
+  with an explicit note but still enforces dispatch counts, which are
+  a host-level property;
+- a jax version change can legitimately shift lowering costs — the
+  recorded version is reported on mismatch so the reviewer knows to
+  re-baseline with ``--update-budgets`` instead of hunting a phantom
+  regression;
+- an entry point with no pinned budget is itself a finding: new
+  programs enter the gate deliberately, not by default.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from kubedtn_tpu.analysis.core import Finding
+
+RULE_JCOST = "jcost"
+
+BUDGET_FILE = "COST_BUDGET.json"
+# growth tolerance before a cost regression flags: generous enough for
+# minor lowering drift, far below the 2× "silently split/doubled"
+# failure mode this gate exists to catch
+COST_TOLERANCE = 1.5
+
+
+def budget_path(root: Path) -> Path:
+    return root / BUDGET_FILE
+
+
+def load_budget(root: Path) -> dict | None:
+    p = budget_path(root)
+    if not p.exists():
+        return None
+    return json.loads(p.read_text())
+
+
+def write_budget(root: Path, entries: list, dispatch: dict) -> dict:
+    """Re-baseline: record every traced entry's measured cost plus the
+    dispatch counts. Returns the written document."""
+    import jax
+
+    doc = {
+        "schema_version": 1,
+        "backend": jax.default_backend(),
+        "jax": jax.__version__,
+        "tolerance": COST_TOLERANCE,
+        "entries": {
+            ep.name: {
+                "flops": ep.cost["flops"],
+                "bytes": ep.cost["bytes"],
+                "eqns": ep.n_eqns,
+            }
+            for ep in entries
+            if ep.jaxpr is not None and ep.cost is not None
+        },
+        "dispatch": dispatch,
+    }
+    budget_path(root).write_text(json.dumps(doc, indent=2,
+                                            sort_keys=True) + "\n")
+    return doc
+
+
+def check_budget(root: Path, entries: list, dispatch: dict,
+                 findings: list[Finding]) -> dict:
+    """Compare measured entries/dispatch counts against the checked-in
+    budget; append jcost findings. Returns a status dict for the
+    report."""
+    import jax
+
+    status: dict = {"file": BUDGET_FILE, "checked": False}
+    doc = load_budget(root)
+    if doc is None:
+        findings.append(Finding(
+            RULE_JCOST, BUDGET_FILE, 1,
+            "COST_BUDGET.json missing — run `python -m "
+            "kubedtn_tpu.analysis --verify --update-budgets` to pin "
+            "the current dispatch counts and compiled costs"))
+        return status
+    backend = jax.default_backend()
+    tol = float(doc.get("tolerance", COST_TOLERANCE))
+    same_backend = doc.get("backend") == backend
+    status.update(backend=backend, budget_backend=doc.get("backend"),
+                  checked=True, cost_compared=same_backend)
+    if doc.get("jax") != jax.__version__:
+        status["note"] = (
+            f"budget recorded on jax {doc.get('jax')}, running "
+            f"{jax.__version__}: a cost flag may be lowering drift — "
+            f"re-baseline with --update-budgets if so")
+
+    budgets = doc.get("entries", {})
+    traced = {ep.name: ep for ep in entries if ep.jaxpr is not None}
+    for name, ep in traced.items():
+        b = budgets.get(name)
+        if b is None:
+            findings.append(Finding(
+                RULE_JCOST, ep.path, ep.line,
+                f"[{name}] no budget pinned for this entry point — "
+                f"add it via --update-budgets (new programs enter the "
+                f"gate deliberately)"))
+            continue
+        if not same_backend or ep.cost is None:
+            continue
+        for metric in ("flops", "bytes"):
+            have = float(ep.cost[metric])
+            want = float(b[metric])
+            if want > 0 and have > want * tol:
+                findings.append(Finding(
+                    RULE_JCOST, ep.path, ep.line,
+                    f"[{name}] {metric} regression: {have:.0f} > "
+                    f"budget {want:.0f} × {tol} — the compiled "
+                    f"program grew past its pinned envelope "
+                    f"(re-baseline with --update-budgets only if the "
+                    f"growth is intentional and reviewed)"))
+
+    # dispatch counts: exact, backend-independent
+    for key, want in (doc.get("dispatch") or {}).items():
+        have = dispatch.get(key)
+        if have is None:
+            continue
+        if float(have) != float(want):
+            findings.append(Finding(
+                RULE_JCOST, "kubedtn_tpu/runtime.py", 1,
+                f"[{key}] dispatches per tick = {have} (budget "
+                f"{want}) — the one-fused-dispatch-per-tick contract "
+                f"broke: the tick program was split or a new jitted "
+                f"call joined the steady tick path"))
+    return status

@@ -1,0 +1,86 @@
+"""Dispatch counting — the runtime half of the jcost family.
+
+"One fused dispatch per tick" (PR 1) is the plane's core performance
+contract, and it is invisible to jaxpr inspection: a refactor could
+keep every traced program clean while quietly calling two of them per
+tick. This probe pins it at the call layer: every module-level
+jax-compiled callable in `runtime.TICK_DISPATCH_MODULES` is wrapped
+with a counter, the canonical three-class probe plane runs a warmup
+(compiles excluded by design — a compile is not a steady-state
+dispatch), and then a counted window of steady ticks with fresh
+ingress on all three kernel classes.
+
+Definition pinned in COST_BUDGET.json: *dispatches per tick* = calls
+of named jitted programs from the registered tick-path modules during
+one `plane.tick()`, at steady state, all classes active. Transfers
+(`device_put`, `np.asarray` at the completion sync point) are not
+dispatches.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+
+def count_dispatches(fn, module_names) -> int:
+    """Run `fn()` with every module-level jitted callable in
+    `module_names` wrapped by a counter; returns the number of calls.
+    Wrapping is attribute-level, so callables resolved through module
+    globals at call time (the plane's dispatch path) are all seen."""
+    import jax
+
+    counter = {"n": 0}
+    patched: list[tuple[object, str, object]] = []
+    try:
+        for mod_name in module_names:
+            mod = importlib.import_module(mod_name)
+            for attr in dir(mod):
+                obj = getattr(mod, attr)
+                if not isinstance(obj, jax.stages.Wrapped):
+                    continue
+
+                def make(wrapped):
+                    def counted(*a, **k):
+                        counter["n"] += 1
+                        return wrapped(*a, **k)
+
+                    counted.__wrapped__ = wrapped
+                    return counted
+
+                patched.append((mod, attr, obj))
+                setattr(mod, attr, make(obj))
+        fn()
+    finally:
+        for mod, attr, obj in patched:
+            setattr(mod, attr, obj)
+    return counter["n"]
+
+
+def fused_tick_dispatches(depth: int = 1, ticks: int = 3) -> float:
+    """Measured dispatches per steady tick on the canonical probe
+    plane (all three kernel classes active every tick)."""
+    from kubedtn_tpu.runtime import TICK_DISPATCH_MODULES
+    from kubedtn_tpu.analysis.verify.entrypoints import build_probe_plane
+
+    plane, win = build_probe_plane(depth=depth)
+    t = [100.0]
+
+    def feed():
+        for wa in win:
+            wa.ingress.extend(bytes([7]) * 64 for _ in range(8))
+
+    def one_tick():
+        feed()
+        t[0] += 0.002
+        plane.tick(now_s=t[0])
+
+    for _ in range(4):   # warmup: compiles + pipeline fill
+        one_tick()
+
+    def window():
+        for _ in range(ticks):
+            one_tick()
+
+    n = count_dispatches(window, TICK_DISPATCH_MODULES)
+    plane.flush()
+    return n / float(ticks)

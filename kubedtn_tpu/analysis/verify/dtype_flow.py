@@ -1,0 +1,106 @@
+"""jdtype — IR-level f64 taint through the traced programs.
+
+The PR 3 clock-freeze class, checked where it actually happens: a wall
+clock held in float32 stops advancing once the tick delta drops below
+half its ulp (~2.4 h of µs uptime), so the contract is that f64
+wall-clock anchors stay f64 until a RELATIVE quantity is formed, and
+no truncating cast lands an anchored value in the f32 SoA.
+
+At the IR level that is a forward taint: every f64 input or constant
+of the program is a taint root; a `convert_element_type` that narrows
+a tainted float and a scatter of tainted updates into an f32 operand
+are findings. The shipped tick programs run with x64 disabled, so a
+clean tree proves the *absence* of f64 in traced code outright (the
+third check); the mutation fixtures trace under
+`jax.experimental.enable_x64` to demonstrate the taint machinery on
+the historical bug shape.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubedtn_tpu.analysis.core import Finding
+from kubedtn_tpu.analysis.verify.jaxpr_tools import Dataflow, iter_eqns
+
+RULE_JDTYPE = "jdtype"
+
+_NARROW_FLOATS = ("float32", "bfloat16", "float16")
+
+
+def _is_f64(aval) -> bool:
+    dtype = getattr(aval, "dtype", None)
+    return dtype is not None and dtype == np.dtype("float64")
+
+
+class _TaintF64(Dataflow):
+    bottom = False
+
+    def join(self, a, b):
+        return bool(a) or bool(b)
+
+    def invar(self, var, index):
+        return _is_f64(var.aval)
+
+    def constvar(self, var):
+        return _is_f64(getattr(var, "aval", None))
+
+    def literal(self, lit):
+        return _is_f64(getattr(lit, "aval", None))
+
+    def transfer(self, eqn, in_vals):
+        name = eqn.primitive.name
+        tainted = any(in_vals)
+        if name == "convert_element_type" and in_vals and in_vals[0]:
+            new = str(eqn.params.get("new_dtype"))
+            if new in _NARROW_FLOATS:
+                self.emit(f"truncating cast f64→{new} on a wall-clock-"
+                          f"anchored value inside traced code (the "
+                          f"clock-freeze class — keep anchors f64 "
+                          f"until a relative time is formed)")
+            # the narrowed value still descends from the anchor
+            return [True] * len(eqn.outvars)
+        if name in ("scatter", "scatter-add", "scatter-mul",
+                    "scatter-min", "scatter-max"):
+            # invars: (operand, indices, updates)
+            if len(in_vals) >= 3 and in_vals[2]:
+                op_dtype = str(getattr(eqn.invars[0].aval, "dtype", ""))
+                if op_dtype in _NARROW_FLOATS:
+                    self.emit(f"f64-anchored updates scattered into "
+                              f"an {op_dtype} SoA column")
+            return [tainted] * len(eqn.outvars)
+        if name == "dynamic_update_slice":
+            if len(in_vals) >= 2 and in_vals[1]:
+                op_dtype = str(getattr(eqn.invars[0].aval, "dtype", ""))
+                if op_dtype in _NARROW_FLOATS:
+                    self.emit(f"f64-anchored update written into an "
+                              f"{op_dtype} SoA column")
+            return [tainted] * len(eqn.outvars)
+        return None
+
+
+def check_dtype_flow(entry, findings: list[Finding]) -> None:
+    msgs: list[str] = []
+    flow = _TaintF64(emit=lambda m: msgs.append(m))
+    flow.run(entry.jaxpr.jaxpr)
+    for m in dict.fromkeys(msgs):
+        findings.append(Finding(RULE_JDTYPE, entry.path, entry.line,
+                                f"[{entry.name}] {m}"))
+    if entry.expect_f32_only:
+        hits = 0
+        for eqn in iter_eqns(entry.jaxpr.jaxpr):
+            for v in eqn.outvars:
+                if _is_f64(getattr(v, "aval", None)):
+                    hits += 1
+                    if hits <= 2:
+                        findings.append(Finding(
+                            RULE_JDTYPE, entry.path, entry.line,
+                            f"[{entry.name}] float64 value produced by "
+                            f"`{eqn.primitive.name}` inside the f32 "
+                            f"tick program (x64 leak doubles HBM "
+                            f"traffic and breaks SoA bit-layout)"))
+        if hits > 2:
+            findings.append(Finding(
+                RULE_JDTYPE, entry.path, entry.line,
+                f"[{entry.name}] ...and {hits - 2} further float64 "
+                f"values in this program"))

@@ -1,0 +1,369 @@
+"""dtnverify harness: trace the REAL entry points into jaxprs.
+
+The canonical probe topology is three link pairs, one per shaping
+kernel class (slot-independent, TBF, correlated-sequential), built
+through the production path (store → reconciler → engine → daemon →
+WireDataPlane) with telemetry ON. The fused tick's arguments are then
+CAPTURED from real `plane.tick()` dispatches — not hand-built — so the
+traced program is the byte-for-byte production one, statics included.
+The sharded program, the degradation ladder's `_class_tick`, the twin
+sweep, and the update gate's sweep trace from the same captured shapes
+through their production assembly helpers (`twin.engine.prepare_sweep`,
+`updates.gate.gate_scenarios`).
+
+Shapes are pinned (capacity 16, one padded row per class, 16 slots, 3
+sweep steps) so the XLA cost-analysis numbers in COST_BUDGET.json are
+reproducible run-to-run on a given backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import inspect
+from pathlib import Path
+
+from kubedtn_tpu.analysis import default_root
+
+PROBE_CAPACITY = 16
+SWEEP_STEPS = 3
+SWEEP_REPLICAS = 2
+
+ENTRY_NAMES = (
+    "fused_tick_d1", "fused_tick_d2",
+    "class_tick_tbf", "class_tick_seq", "class_tick_ind",
+    "sharded_fused",
+    "twin_sweep", "update_gate_sweep",
+)
+
+
+@dataclasses.dataclass
+class EntryPoint:
+    """One traced program plus the contract knobs the passes read."""
+
+    name: str
+    path: str                 # repo-relative source anchor
+    line: int
+    jaxpr: object = None      # ClosedJaxpr (None when skipped)
+    cost: dict | None = None  # {"flops":..., "bytes":...} when compiled
+    skip_reason: str | None = None
+    allowed_collectives: tuple = ()
+    expect_f32_only: bool = True
+    expect_shard_map: bool = False
+    edge_axis: str = "edge"
+    n_eqns: int = 0
+    n_prims: int = 0
+
+
+def _anchor(fn) -> tuple[str, int]:
+    """Repo-relative (path, line) of a callable (through jit wrappers)."""
+    f = inspect.unwrap(getattr(fn, "__wrapped__", fn))
+    try:
+        src = Path(inspect.getsourcefile(f)).resolve()
+        line = inspect.getsourcelines(f)[1]
+        return src.relative_to(default_root()).as_posix(), line
+    except Exception:
+        return "kubedtn_tpu/runtime.py", 1
+
+
+# -- the probe plane ----------------------------------------------------
+
+def _probe_props():
+    from kubedtn_tpu.api.types import LinkProperties
+
+    return [
+        LinkProperties(latency="3ms", jitter="1ms", loss="5"),    # ind
+        LinkProperties(rate="2Gbit"),                             # tbf
+        LinkProperties(latency="2ms", loss="10", loss_corr="25"),  # seq
+    ]
+
+
+def build_probe_plane(depth: int = 2, telemetry: bool = True):
+    """The canonical three-class plane, built through the production
+    control path. Returns (plane, ingress_wires)."""
+    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=PROBE_CAPACITY)
+    props = _probe_props()
+    for i, p in enumerate(props):
+        a, b = f"a{i}", f"b{i}"
+        store.create(Topology(name=a, spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=b,
+                 uid=i + 1, properties=p)])))
+        store.create(Topology(name=b, spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=a,
+                 uid=i + 1, properties=p)])))
+        engine.setup_pod(a)
+        engine.setup_pod(b)
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    win = []
+    for i in range(len(props)):
+        win.append(daemon._add_wire(pb.WireDef(
+            local_pod_name=f"a{i}", kube_ns="default", link_uid=i + 1,
+            intf_name_in_pod="eth1")))
+        daemon._add_wire(pb.WireDef(
+            local_pod_name=f"b{i}", kube_ns="default", link_uid=i + 1,
+            intf_name_in_pod="eth1"))
+    plane = WireDataPlane(daemon, dt_us=2_000.0, pipeline_depth=depth)
+    plane.pipeline_explicit_clock = True
+    if telemetry:
+        plane.enable_telemetry(window_s=0.05, sample_period=4)
+    return plane, win
+
+
+def capture_fused_calls():
+    """Run real ticks and capture `_fused_tick`'s production arguments
+    for the all-classes dispatch at depth 1 (chain head, has_dyn=False)
+    and depth 2 (chained dyn). Returns {"d1": (args, statics),
+    "d2": ...}."""
+    from kubedtn_tpu import runtime as rt
+
+    captured: dict[str, tuple] = {}
+    orig = rt._fused_tick
+
+    def recorder(*args, **statics):
+        if all(statics.get(f)
+               for f in ("has_seq", "has_tbf", "has_ind", "has_tel")):
+            captured.setdefault(
+                "d2" if statics.get("has_dyn") else "d1",
+                (args, dict(statics)))
+        return orig(*args, **statics)
+
+    rt._fused_tick = recorder
+    try:
+        plane, win = build_probe_plane(depth=2)
+        t = 100.0
+        for j in range(6):
+            for wa in win:
+                wa.ingress.extend(bytes([j]) * 64 for _ in range(8))
+            t += 0.002
+            plane.tick(now_s=t)
+        plane.flush()
+    finally:
+        rt._fused_tick = orig
+    missing = {"d1", "d2"} - set(captured)
+    if missing:
+        raise RuntimeError(
+            f"probe plane never dispatched an all-classes fused tick "
+            f"for {sorted(missing)} — harness drifted from the plane")
+    return captured
+
+
+# -- tracing ------------------------------------------------------------
+
+def _trace(fn, *args):
+    import jax
+
+    return jax.make_jaxpr(fn)(*args)
+
+
+def _cost_of(jitted_callable, args) -> dict | None:
+    """XLA cost analysis of the compiled program (flops / bytes
+    accessed); None when the backend does not report them."""
+    try:
+        compiled = jitted_callable.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        ca0 = ca[0] if isinstance(ca, (list, tuple)) else ca
+        if not ca0:
+            return None
+        return {"flops": float(ca0.get("flops", 0.0)),
+                "bytes": float(ca0.get("bytes accessed", 0.0))}
+    except Exception:
+        return None
+
+
+def _finish(ep: EntryPoint, closed, cost) -> EntryPoint:
+    from kubedtn_tpu.analysis.verify.jaxpr_tools import (
+        count_eqns,
+        primitive_set,
+    )
+
+    ep.jaxpr = closed
+    ep.cost = cost
+    ep.n_eqns = count_eqns(closed.jaxpr)
+    ep.n_prims = len(primitive_set(closed.jaxpr))
+    return ep
+
+
+def trace_entry_points(entries: tuple[str, ...] | None = None,
+                       compile_costs: bool = True) -> list[EntryPoint]:
+    """Trace every requested entry point; entries that cannot run in
+    this environment come back with `skip_reason` instead of a jaxpr
+    (honest skip, surfaced in the report)."""
+    import jax
+
+    from kubedtn_tpu import runtime as rt
+
+    wanted = tuple(entries) if entries else ENTRY_NAMES
+    unknown = set(wanted) - set(ENTRY_NAMES)
+    if unknown:
+        raise ValueError(f"unknown entry point(s): {sorted(unknown)} "
+                         f"(have: {', '.join(ENTRY_NAMES)})")
+    out: list[EntryPoint] = []
+    need_fused = any(e.startswith(("fused_", "class_", "sharded"))
+                     for e in wanted)
+    caps = capture_fused_calls() if need_fused else {}
+
+    fpath, fline = _anchor(rt._fused_tick)
+    for depth_name, cap_key in (("fused_tick_d1", "d1"),
+                                ("fused_tick_d2", "d2")):
+        if depth_name not in wanted:
+            continue
+        args, statics = caps[cap_key]
+        fn = functools.partial(rt._fused_tick, **statics)
+        ep = EntryPoint(depth_name, fpath, fline)
+        closed = _trace(lambda *a: fn(*a), *args)
+        cost = (_cost_of(jax.jit(lambda *a: fn(*a)), args)
+                if compile_costs else None)
+        out.append(_finish(ep, closed, cost))
+
+    cpath, cline = _anchor(rt._class_tick)
+    class_wanted = [e for e in wanted if e.startswith("class_tick_")]
+    if class_wanted:
+        # the ladder's un-fused rung: same captured state/args, the
+        # production per-class chaining (tick key split, per-class
+        # fold_in happens inside via _shape_class)
+        args, _statics = caps["d2"]
+        state, dyn, key, elapsed, seq_a, tbf_a, ind_a, tel = args
+        _key2, sub = jax.random.split(key)
+        class_args = {"class_tick_seq": seq_a, "class_tick_tbf": tbf_a,
+                      "class_tick_ind": ind_a}
+        for name in class_wanted:
+            kind = name.rsplit("_", 1)[1]
+            fn = functools.partial(rt._class_tick, kind=kind,
+                                   has_dyn=True, has_tel=True)
+            a = (state, dyn, sub, elapsed, class_args[name], tel)
+            ep = EntryPoint(name, cpath, cline)
+            closed = _trace(lambda *x: fn(*x), *a)
+            cost = (_cost_of(jax.jit(lambda *x: fn(*x)), a)
+                    if compile_costs else None)
+            out.append(_finish(ep, closed, cost))
+
+    if "sharded_fused" in wanted:
+        out.append(_trace_sharded(caps, compile_costs))
+
+    if "twin_sweep" in wanted or "update_gate_sweep" in wanted:
+        out.extend(_trace_sweeps(wanted, compile_costs))
+
+    return out
+
+
+def _trace_sharded(caps, compile_costs: bool) -> EntryPoint:
+    import jax
+
+    from kubedtn_tpu import runtime as rt
+    from kubedtn_tpu.parallel.mesh import (
+        EDGE_AXIS,
+        edge_sharding,
+        make_mesh,
+    )
+
+    spath, sline = _anchor(rt._make_sharded_fused)
+    ep = EntryPoint("sharded_fused", spath, sline,
+                    allowed_collectives=("ppermute", "axis_index"),
+                    expect_shard_map=True, edge_axis=EDGE_AXIS)
+    if len(jax.devices()) < 2:
+        ep.skip_reason = (f"needs ≥2 devices for a real mailbox ring, "
+                          f"environment exposes {len(jax.devices())}")
+        return ep
+    mesh = make_mesh(2)
+    sharded = rt._make_sharded_fused(mesh)
+    args, statics = caps["d2"]
+    state, dyn, key, elapsed, seq_a, tbf_a, ind_a, tel = args
+    sh = edge_sharding(mesh)
+    put = lambda x: jax.device_put(x, sh)  # noqa: E731
+    state = jax.tree.map(put, state)
+    dyn = jax.tree.map(put, dyn)
+    tel = put(tel)
+    a = (state, dyn, key, elapsed, seq_a, tbf_a, ind_a, tel)
+    fn = functools.partial(sharded, **statics)
+    closed = _trace(lambda *x: fn(*x), *a)
+    cost = (_cost_of(jax.jit(lambda *x: fn(*x)), a)
+            if compile_costs else None)
+    return _finish(ep, closed, cost)
+
+
+def _small_snapshot():
+    """A tiny engine-built snapshot shared by the sweep entries."""
+    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+    from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+    from kubedtn_tpu.twin.snapshot import snapshot_from_engine
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=8)
+    props = _probe_props()
+    for i, p in enumerate(props[:2]):
+        a, b = f"a{i}", f"b{i}"
+        store.create(Topology(name=a, spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=b,
+                 uid=i + 1, properties=p)])))
+        store.create(Topology(name=b, spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=a,
+                 uid=i + 1, properties=p)])))
+        engine.setup_pod(a)
+        engine.setup_pod(b)
+    Reconciler(store, engine).drain()
+    links = [t.spec.links[0] for t in
+             (store.get("default", "a0"), store.get("default", "a1"))]
+    with engine._lock:
+        pod_ids = dict(engine._pod_ids)
+    return snapshot_from_engine(engine, q=8), links, pod_ids
+
+
+def _trace_sweeps(wanted, compile_costs: bool) -> list[EntryPoint]:
+    from kubedtn_tpu.twin.spec import Perturbation, Scenario
+
+    out: list[EntryPoint] = []
+    snap, links, pod_ids = _small_snapshot()
+
+    if "twin_sweep" in wanted:
+        scenarios = [Scenario(name="baseline"),
+                     Scenario(name="degrade", perturbations=(
+                         Perturbation("fail", uid=links[0].uid),))]
+        out.append(_trace_one_sweep("twin_sweep", snap, scenarios,
+                                    pod_ids, compile_costs))
+
+    if "update_gate_sweep" in wanted:
+        import dataclasses as dc
+
+        from kubedtn_tpu.updates.gate import gate_scenarios
+        from kubedtn_tpu.updates.planner import plan_update
+
+        old = list(links)
+        new = [dc.replace(
+            old[0], properties=dc.replace(old[0].properties,
+                                          latency="9ms")), old[1]]
+        plan = plan_update(old, new, name="a0", check=False)
+        scenarios, _adds, _edits = gate_scenarios(plan, snap,
+                                                  pod_ids=pod_ids)
+        if not scenarios:
+            ep = EntryPoint("update_gate_sweep", *_anchor(gate_scenarios))
+            ep.skip_reason = ("probe plan produced no replayable "
+                              "rounds — harness drifted from the gate")
+            out.append(ep)
+        else:
+            out.append(_trace_one_sweep("update_gate_sweep", snap,
+                                        scenarios, pod_ids,
+                                        compile_costs))
+    return out
+
+
+def _trace_one_sweep(name, snap, scenarios, pod_ids,
+                     compile_costs: bool) -> EntryPoint:
+    import jax
+
+    from kubedtn_tpu.twin.engine import prepare_sweep
+
+    jitted, args, _sig, _n = prepare_sweep(
+        snap, scenarios, steps=SWEEP_STEPS, dt_us=1_000.0, k_slots=4,
+        seed=0, pod_ids=pod_ids)
+    ep = EntryPoint(name, *_anchor(jitted))
+    closed = jax.make_jaxpr(jitted.__wrapped__)(*args)
+    cost = _cost_of(jitted, args) if compile_costs else None
+    return _finish(ep, closed, cost)

@@ -1,0 +1,227 @@
+"""Shared jaxpr machinery for the dtnverify passes: recursive equation
+iteration and a small forward-dataflow engine that understands jax's
+structural primitives (pjit / scan / while / cond / shard_map /
+custom_* calls), so a pass written against flat equations sees through
+every nesting level the tracer produces.
+
+The dataflow engine is deliberately minimal: per-variable abstract
+values from a tiny lattice (key provenance, f64 taint, foreign-bit
+taint), a join, and a per-equation transfer hook. Loop bodies
+(scan/while) run to a bounded fixpoint on their carries — the lattices
+here are a few booleans deep, so convergence takes at most as many
+passes as there are flags.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+from jax import core as jax_core
+
+# primitives whose params hold sub-jaxprs the engine maps structurally
+# (operand values seed inner invars 1:1; inner outvars land on eqn
+# outvars 1:1 — the jax calling conventions below)
+_CALL_LIKE = ("pjit", "closed_call", "core_call", "xla_call", "remat",
+              "remat2", "checkpoint", "custom_jvp_call",
+              "custom_vjp_call", "custom_vjp_call_jaxpr", "shard_map")
+_FIXPOINT_CAP = 8
+
+
+def _as_jaxpr(obj) -> jax_core.Jaxpr | None:
+    if isinstance(obj, jax_core.ClosedJaxpr):
+        return obj.jaxpr
+    if isinstance(obj, jax_core.Jaxpr):
+        return obj
+    return None
+
+
+def subjaxprs(eqn) -> Iterator[jax_core.Jaxpr]:
+    """Every inner Jaxpr referenced by `eqn`'s params (any nesting
+    style: single, tuple of branches, ClosedJaxpr-wrapped)."""
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (list, tuple)) else (v,)
+        for sub in vals:
+            j = _as_jaxpr(sub)
+            if j is not None:
+                yield j
+
+
+def iter_eqns(jaxpr: jax_core.Jaxpr) -> Iterator[jax_core.JaxprEqn]:
+    """Every equation in `jaxpr` and every nested sub-jaxpr, outermost
+    first."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in subjaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def primitive_set(jaxpr: jax_core.Jaxpr) -> set[str]:
+    return {eqn.primitive.name for eqn in iter_eqns(jaxpr)}
+
+
+def count_eqns(jaxpr: jax_core.Jaxpr) -> int:
+    return sum(1 for _ in iter_eqns(jaxpr))
+
+
+def is_key_dtype(aval) -> bool:
+    """True for jax's typed PRNG key arrays (key<fry> etc.)."""
+    import jax
+
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return False
+    try:
+        return jax.dtypes.issubdtype(dtype, jax.dtypes.prng_key)
+    except Exception:
+        return False
+
+
+class Dataflow:
+    """Forward dataflow over a nested jaxpr.
+
+    Subclass hooks:
+    - ``bottom``: the no-information value (default None).
+    - ``join(a, b)``: lattice join; must treat ``bottom`` as identity.
+    - ``invar(var, index)`` / ``constvar(var)`` / ``literal(lit)``:
+      initial values at the top level.
+    - ``transfer(eqn, in_vals)``: return the eqn's out values (a list
+      matching ``eqn.outvars``) or None for the default — join of the
+      inputs broadcast to every output. Called for NON-structural
+      primitives only; structural ones recurse automatically.
+
+    Findings are the subclass's business: append to ``self.emit`` (a
+    caller-supplied callable) inside ``transfer``.
+    """
+
+    bottom = None
+
+    def __init__(self, emit: Callable[[str], None] | None = None) -> None:
+        self.emit = emit if emit is not None else (lambda msg: None)
+
+    # -- hooks ---------------------------------------------------------
+    def join(self, a, b):
+        return a if b is self.bottom else b if a is self.bottom else a
+
+    def invar(self, var, index: int):
+        return self.bottom
+
+    def constvar(self, var):
+        return self.bottom
+
+    def literal(self, lit):
+        return self.bottom
+
+    def transfer(self, eqn, in_vals):
+        return None
+
+    # -- engine --------------------------------------------------------
+    def run(self, jaxpr: jax_core.Jaxpr, in_vals=None):
+        if in_vals is None:
+            in_vals = [self.invar(v, i)
+                       for i, v in enumerate(jaxpr.invars)]
+        return self._run(jaxpr, list(in_vals),
+                         [self.constvar(v) for v in jaxpr.constvars])
+
+    def _run(self, jaxpr, in_vals, const_vals):
+        env: dict = {}
+        for v, val in zip(jaxpr.constvars, const_vals):
+            env[v] = val
+        for v, val in zip(jaxpr.invars, in_vals):
+            env[v] = val
+
+        def read(a):
+            if isinstance(a, jax_core.Literal):
+                return self.literal(a)
+            return env.get(a, self.bottom)
+
+        for eqn in jaxpr.eqns:
+            ivals = [read(x) for x in eqn.invars]
+            ovals = self._structural(eqn, ivals)
+            if ovals is None:
+                ovals = self.transfer(eqn, ivals)
+            if ovals is None:
+                j = self.bottom
+                for x in ivals:
+                    j = self.join(j, x)
+                ovals = [j] * len(eqn.outvars)
+            for v, val in zip(eqn.outvars, ovals):
+                if not isinstance(v, jax_core.DropVar):
+                    env[v] = val
+        return [read(v) for v in jaxpr.outvars]
+
+    def _sub(self, obj, in_vals):
+        """Run an inner jaxpr: ClosedJaxpr consts get bottom-or-const
+        treatment via `constvar`, bare Jaxpr constvars likewise."""
+        inner = _as_jaxpr(obj)
+        return self._run(inner, list(in_vals),
+                         [self.constvar(v) for v in inner.constvars])
+
+    def _structural(self, eqn, ivals):
+        name = eqn.primitive.name
+        if name in _CALL_LIKE:
+            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                obj = eqn.params.get(key)
+                if obj is not None and _as_jaxpr(obj) is not None:
+                    inner = _as_jaxpr(obj)
+                    # custom_* calls carry extra operands (the jvp/bwd
+                    # closures) beyond the body's invars; align tail
+                    take = ivals[len(ivals) - len(inner.invars):] \
+                        if len(inner.invars) <= len(ivals) else ivals
+                    out = self._sub(obj, take)
+                    return self._pad_out(out, eqn)
+            return None
+        if name == "scan":
+            return self._scan(eqn, ivals)
+        if name == "while":
+            return self._while(eqn, ivals)
+        if name == "cond":
+            return self._cond(eqn, ivals)
+        return None
+
+    def _pad_out(self, out, eqn):
+        n = len(eqn.outvars)
+        if len(out) == n:
+            return out
+        return (out + [self.bottom] * n)[:n]
+
+    def _scan(self, eqn, ivals):
+        nc = eqn.params["num_consts"]
+        ncar = eqn.params["num_carry"]
+        consts, carry, xs = (ivals[:nc], ivals[nc:nc + ncar],
+                             ivals[nc + ncar:])
+        body = eqn.params["jaxpr"]
+        for _ in range(_FIXPOINT_CAP):
+            out = self._sub(body, consts + carry + xs)
+            new_carry = [self.join(a, b)
+                         for a, b in zip(carry, out[:ncar])]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        out = self._sub(body, consts + carry + xs)
+        return self._pad_out(out[:ncar] + out[ncar:], eqn)
+
+    def _while(self, eqn, ivals):
+        cn = eqn.params["cond_nconsts"]
+        bn = eqn.params["body_nconsts"]
+        cond_consts = ivals[:cn]
+        body_consts = ivals[cn:cn + bn]
+        carry = ivals[cn + bn:]
+        body = eqn.params["body_jaxpr"]
+        cond = eqn.params["cond_jaxpr"]
+        for _ in range(_FIXPOINT_CAP):
+            self._sub(cond, cond_consts + carry)  # visit for findings
+            out = self._sub(body, body_consts + carry)
+            new_carry = [self.join(a, b) for a, b in zip(carry, out)]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        return self._pad_out(carry, eqn)
+
+    def _cond(self, eqn, ivals):
+        args = ivals[1:]  # operand 0 is the branch index
+        outs = None
+        for br in eqn.params["branches"]:
+            out = self._sub(br, args)
+            outs = out if outs is None else [
+                self.join(a, b) for a, b in zip(outs, out)]
+        return self._pad_out(outs or [], eqn)

@@ -1,0 +1,204 @@
+"""jops / jkey — op-allowlist determinism over the traced programs.
+
+Two checks per entry point:
+
+1. **Allowlist** (`jops`): every primitive in the jaxpr must come from
+   the vetted set below. The set is the union of what the real tick /
+   sweep programs legitimately lower to, curated by family; a new
+   primitive appearing in a traced program is a *review event*, not
+   noise — nondeterministic reductions, host callbacks, and unvetted
+   collectives are exactly what this catches. Collectives are
+   entry-scoped: only the sharded program may ppermute.
+
+2. **Key provenance** (`jkey`): dataflow over the typed-PRNG values
+   proving every `random_bits` is reachable only through a
+   `split`/`fold_in` chain rooted at a key ARGUMENT of the program.
+   `random_seed` inside traced code (a key minted at trace time — the
+   historical "raw `jax.random.key(seed)` into a sampler" bug, PR 6's
+   engine.ping finding) and a key argument consumed raw (no
+   split/fold_in before sampling — the PR 3 vmap-drift class) are both
+   findings at the IR level, where decorator indirection and helper
+   layers cannot hide them from the AST pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from kubedtn_tpu.analysis.core import Finding
+from kubedtn_tpu.analysis.verify.jaxpr_tools import (
+    Dataflow,
+    is_key_dtype,
+    iter_eqns,
+)
+
+RULE_JOPS = "jops"
+RULE_JKEY = "jkey"
+
+# -- the vetted primitive set ------------------------------------------
+
+STRUCTURAL = {
+    "pjit", "closed_call", "core_call", "xla_call", "scan", "while",
+    "cond", "custom_jvp_call", "custom_vjp_call",
+    "custom_vjp_call_jaxpr", "remat", "remat2", "checkpoint",
+    "shard_map",
+}
+ELEMENTWISE = {
+    "add", "sub", "mul", "div", "rem", "pow", "integer_pow", "neg",
+    "abs", "sign", "floor", "ceil", "round", "exp", "log", "log1p",
+    "expm1", "sqrt", "rsqrt", "lgamma", "logistic", "erf", "erf_inv",
+    "tanh", "sin", "cos", "max", "min", "clamp", "is_finite",
+    "eq", "ne", "ge", "gt", "le", "lt", "and", "or", "not", "xor",
+    # the total-order comparators XLA's variadic sort lowers through
+    # (deterministic by construction — they define the total order)
+    "le_to", "lt_to",
+    "select_n", "shift_left", "shift_right_logical",
+    "shift_right_arithmetic", "nextafter", "square",
+}
+DATA_MOVEMENT = {
+    "broadcast_in_dim", "concatenate", "convert_element_type",
+    "bitcast_convert_type", "dynamic_slice", "dynamic_update_slice",
+    "gather", "scatter", "scatter-add", "scatter-mul", "scatter-min",
+    "scatter-max", "pad", "reshape", "rev", "slice", "squeeze",
+    "transpose", "iota", "copy", "expand_dims",
+}
+REDUCTIONS = {
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_or",
+    "reduce_and", "reduce_prod", "argmax", "argmin", "cumsum",
+    "cummax", "cumlogsumexp",
+    # XLA variadic sort is deterministic (total order over the
+    # comparator + index tiebreak in jnp wrappers); searchsorted
+    # lowers through it on this backend
+    "sort",
+}
+RNG = {
+    # random_seed is DELIBERATELY absent: a key minted inside a traced
+    # program is the jkey finding below, never an allowed op
+    "random_split", "random_fold_in", "random_bits", "random_wrap",
+    "random_unwrap", "threefry2x32",
+}
+ALLOWED_COMMON = (STRUCTURAL | ELEMENTWISE | DATA_MOVEMENT
+                  | REDUCTIONS | RNG)
+
+# collectives are allowed per entry point (ALLOWED_COLLECTIVES on the
+# EntryPoint); anything here that is not granted flags as jops
+COLLECTIVE = {
+    "ppermute", "pshuffle", "psum", "pmax", "pmin", "pmean",
+    "all_gather", "all_to_all", "reduce_scatter", "axis_index",
+    "psum_scatter",
+}
+
+# primitives that are findings with a specific message even if someone
+# adds them to a local allowlist: they break determinism or reach the
+# host mid-program
+DENY = {
+    "random_seed": "key minted inside a traced program (raw "
+                   "`jax.random.key(...)` reaches the compiled tick — "
+                   "the sampler replays the same stream every call)",
+    "pure_callback": "host callback inside a traced program",
+    "io_callback": "host callback inside a traced program",
+    "debug_callback": "host callback inside a traced program",
+    "infeed": "host transfer inside a traced program",
+    "outfeed": "host transfer inside a traced program",
+    "approx_top_k": "approximate (nondeterministic) reduction",
+}
+
+
+def check_ops(entry, findings: list[Finding]) -> None:
+    """The allowlist walk (jops)."""
+    allowed = ALLOWED_COMMON | set(entry.allowed_collectives)
+    seen: set[str] = set()
+    for eqn in iter_eqns(entry.jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if name in seen:
+            continue
+        seen.add(name)
+        if name in DENY:
+            findings.append(Finding(
+                RULE_JOPS, entry.path, entry.line,
+                f"[{entry.name}] denied primitive `{name}`: "
+                f"{DENY[name]}"))
+        elif name in COLLECTIVE and name not in allowed:
+            findings.append(Finding(
+                RULE_JOPS, entry.path, entry.line,
+                f"[{entry.name}] collective `{name}` outside the "
+                f"sharded exchange — cross-shard traffic must ride "
+                f"the mailbox ring"))
+        elif name not in allowed and name not in COLLECTIVE:
+            findings.append(Finding(
+                RULE_JOPS, entry.path, entry.line,
+                f"[{entry.name}] unvetted primitive `{name}` — extend "
+                f"the dtnverify allowlist only after a determinism "
+                f"review"))
+
+
+# -- key provenance -----------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class _KeyVal:
+    rooted: bool    # transitively reaches a key ARGUMENT of the program
+    derived: bool   # a split/fold_in sits between root and here
+    minted: bool    # random_seed/random_wrap product or baked constant
+
+
+class _KeyFlow(Dataflow):
+    bottom = None
+
+    def join(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return _KeyVal(a.rooted and b.rooted, a.derived and b.derived,
+                       a.minted or b.minted)
+
+    def invar(self, var, index):
+        if is_key_dtype(var.aval):
+            return _KeyVal(rooted=True, derived=False, minted=False)
+        return None
+
+    def constvar(self, var):
+        if is_key_dtype(getattr(var, "aval", None)):
+            return _KeyVal(rooted=False, derived=False, minted=True)
+        return None
+
+    def transfer(self, eqn, in_vals):
+        name = eqn.primitive.name
+        if name == "random_seed":
+            self.emit("`random_seed` inside the traced program — "
+                      + DENY["random_seed"])
+            return [_KeyVal(False, False, True)] * len(eqn.outvars)
+        if name == "random_wrap":
+            return [_KeyVal(False, False, True)] * len(eqn.outvars)
+        if name in ("random_split", "random_fold_in"):
+            k = next((v for v in in_vals if isinstance(v, _KeyVal)),
+                     None)
+            if k is None:
+                k = _KeyVal(False, False, True)
+            return [_KeyVal(k.rooted, True, k.minted)] \
+                * len(eqn.outvars)
+        if name == "random_bits":
+            k = next((v for v in in_vals if isinstance(v, _KeyVal)),
+                     None)
+            if k is None or k.minted or not k.rooted:
+                self.emit("`random_bits` drawn from a key that is not "
+                          "rooted at a key argument of the program "
+                          "(minted or baked at trace time)")
+            elif not k.derived:
+                self.emit("key argument consumed RAW by `random_bits` "
+                          "— no `split`/`fold_in` between the tick key "
+                          "and the sampler (two call sites would draw "
+                          "identical streams)")
+            return [None] * len(eqn.outvars)
+        return None
+
+
+def check_keys(entry, findings: list[Finding]) -> None:
+    """The key-provenance dataflow (jkey). Messages dedupe per entry:
+    loop bodies run to fixpoint and would repeat them otherwise."""
+    msgs: list[str] = []
+    flow = _KeyFlow(emit=lambda m: msgs.append(m))
+    flow.run(entry.jaxpr.jaxpr)
+    for m in dict.fromkeys(msgs):
+        findings.append(Finding(RULE_JKEY, entry.path, entry.line,
+                                f"[{entry.name}] {m}"))

@@ -1,0 +1,170 @@
+"""dtnverify runner: trace → passes → budget → report.
+
+`run_verify` is the one entry: traces the requested entry points
+(kubedtn_tpu.analysis.verify.entrypoints), runs the four pass families
+over each jaxpr, measures the tick dispatch counts, checks the
+checked-in COST_BUDGET.json, and returns ``(findings, report)`` where
+`report` is the ANALYSIS.json ``jaxpr`` section (schema v2).
+
+The on-disk result cache (`--cached` / `make verify-fast`) keys on a
+content hash of every ``kubedtn_tpu/**/*.py`` file plus the budget
+file: tracing and compiling the entry points costs tens of seconds,
+and a pre-commit hook only needs that cost when something that can
+change a traced program changed. A hit replays the recorded findings
+verbatim (they are data); a miss falls through to the full run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+from kubedtn_tpu.analysis import default_root
+from kubedtn_tpu.analysis.core import JAXPR_RULES, Finding
+
+# ONE definition of the jaxpr rule tags: core.JAXPR_RULES also drives
+# the "not waivable" stale-waiver classification — two copies could
+# silently diverge when a sixth rule lands
+VERIFY_RULES = JAXPR_RULES
+CACHE_FILE = ".dtnverify-cache.json"
+
+
+class VerifyReport(dict):
+    """The ANALYSIS.json `jaxpr` section (plain dict subclass so json
+    serialization is direct)."""
+
+
+def _tree_hash(root: Path) -> str:
+    import jax
+
+    h = hashlib.sha256()
+    # the environment is part of the result's identity: a jax upgrade
+    # or backend/device-count change alters lowered primitives, cost
+    # analysis, and the sharded entry — a cached verdict from the old
+    # environment must miss, not replay
+    h.update(f"jax={jax.__version__};backend={jax.default_backend()};"
+             f"devices={len(jax.devices())};".encode())
+    for p in sorted((root / "kubedtn_tpu").rglob("*.py")):
+        h.update(p.relative_to(root).as_posix().encode())
+        h.update(p.read_bytes())
+    budget = root / "COST_BUDGET.json"
+    if budget.exists():
+        h.update(budget.read_bytes())
+    return h.hexdigest()
+
+
+def _load_cache(root: Path, key: str):
+    p = root / CACHE_FILE
+    if not p.exists():
+        return None
+    try:
+        doc = json.loads(p.read_text())
+    except (OSError, ValueError):
+        return None
+    if doc.get("tree_hash") != key or doc.get("schema") != 2:
+        return None
+    findings = [Finding(**f) for f in doc.get("findings", [])]
+    return findings, VerifyReport(doc.get("report", {}))
+
+
+def _save_cache(root: Path, key: str, findings, report) -> None:
+    doc = {"schema": 2, "tree_hash": key,
+           "findings": [f.to_json() for f in findings],
+           "report": dict(report)}
+    try:
+        (root / CACHE_FILE).write_text(json.dumps(doc) + "\n")
+    except OSError:
+        pass  # the cache is an optimization, never a failure
+
+
+def run_verify(root: Path | None = None,
+               entries: tuple[str, ...] | None = None,
+               use_cache: bool = False,
+               update_budgets: bool = False,
+               ) -> tuple[list[Finding], VerifyReport]:
+    """Run the jaxpr verification layer. `entries` selects a subset of
+    entry points (None = all); `use_cache` replays a stored clean/dirty
+    result when no package source changed; `update_budgets` re-baselines
+    COST_BUDGET.json from the measured costs instead of checking."""
+    root = Path(root) if root is not None else default_root()
+    full_run = entries is None
+    # every full run computes the key and SAVES at the end (hashing is
+    # milliseconds next to the trace/compile cost), so `make verify` /
+    # tier-1 warm the pre-commit `--cached` path; only `use_cache`
+    # runs are allowed to replay a hit
+    cache_key = (_tree_hash(root)
+                 if full_run and not update_budgets else None)
+    if use_cache and cache_key is not None:
+        hit = _load_cache(root, cache_key)
+        if hit is not None:
+            findings, report = hit
+            report["cache"] = "hit"
+            return findings, report
+
+    from kubedtn_tpu.analysis.verify import budget as budget_mod
+    from kubedtn_tpu.analysis.verify.dispatch import fused_tick_dispatches
+    from kubedtn_tpu.analysis.verify.dtype_flow import check_dtype_flow
+    from kubedtn_tpu.analysis.verify.entrypoints import trace_entry_points
+    from kubedtn_tpu.analysis.verify.ops_allowlist import (
+        check_keys,
+        check_ops,
+    )
+    from kubedtn_tpu.analysis.verify.sharding_audit import check_sharding
+
+    eps = trace_entry_points(entries=entries, compile_costs=True)
+    findings: list[Finding] = []
+    for ep in eps:
+        if ep.jaxpr is None:
+            continue
+        check_ops(ep, findings)
+        check_keys(ep, findings)
+        check_dtype_flow(ep, findings)
+        if ep.expect_shard_map:
+            check_sharding(ep, findings)
+
+    # dispatch counts: only measured on a full run (the probe builds
+    # and ticks a live plane; a --entries subset run stays cheap)
+    dispatch: dict = {}
+    if full_run:
+        dispatch["fused_tick_d1"] = fused_tick_dispatches(depth=1)
+        dispatch["fused_tick_d2"] = fused_tick_dispatches(depth=2)
+
+    budget_status: dict = {}
+    if update_budgets:
+        if not full_run:
+            raise ValueError("--update-budgets needs the full entry "
+                             "set (budgets are pinned per entry)")
+        doc = budget_mod.write_budget(root, eps, dispatch)
+        budget_status = {"file": budget_mod.BUDGET_FILE,
+                         "updated": True,
+                         "entries": sorted(doc["entries"])}
+    elif full_run:
+        budget_status = budget_mod.check_budget(root, eps, dispatch,
+                                                findings)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    report = VerifyReport({
+        "rules": list(VERIFY_RULES),
+        "entry_points": {
+            ep.name: (
+                {"skipped": ep.skip_reason} if ep.jaxpr is None else {
+                    "path": ep.path,
+                    "eqns": ep.n_eqns,
+                    "primitives": ep.n_prims,
+                    **({"flops": ep.cost["flops"],
+                        "bytes": ep.cost["bytes"]} if ep.cost else {}),
+                })
+            for ep in eps
+        },
+        "dispatch": dispatch,
+        "budget": budget_status,
+        "summary": {
+            "total": len(findings),
+            "entries_traced": sum(1 for e in eps if e.jaxpr is not None),
+            "entries_skipped": sum(1 for e in eps if e.jaxpr is None),
+        },
+    })
+    if cache_key is not None:
+        _save_cache(root, cache_key, findings, report)
+    return findings, report

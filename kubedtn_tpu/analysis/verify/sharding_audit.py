@@ -1,0 +1,141 @@
+"""jshard — audit of the sharded tick's `shard_map` program.
+
+The sharded plane's byte-identity contract (PR 5, ARCHITECTURE.md
+"Sharded live plane") rests on four IR-checkable facts:
+
+1. the per-tick KEY and the padded batch args enter the shard_map
+   REPLICATED, so every shard draws the unsharded kernels' exact
+   uniforms over the exact padded [R, K] shapes;
+2. edge-state columns enter sharded along axis 0 of the edge axis and
+   nothing else — no surprise partitioning;
+3. the ONLY collective inside the body is the mailbox ring's
+   `ppermute` (each step a bijective neighbor shift): every scatter is
+   therefore local to the owning shard by shard_map's per-shard SPMD
+   semantics;
+4. foreign bits arriving over the ring reach the shaping kernels
+   through `select_n` ONLY — the ownership flag picks the owner's bits
+   verbatim; any arithmetic on a pre-select mailbox payload would
+   round and break N-shard ≡ 1-shard bit-identity
+   (parallel/exchange.py documents the select-combine contract; the
+   ownership flag rides int payload column `exchange.OWNER_COL`).
+"""
+
+from __future__ import annotations
+
+from kubedtn_tpu.analysis.core import Finding
+from kubedtn_tpu.analysis.verify.jaxpr_tools import (
+    Dataflow,
+    is_key_dtype,
+    iter_eqns,
+)
+
+RULE_JSHARD = "jshard"
+
+# taint PROPAGATES through pure data movement (the payload is still
+# foreign bits, just rearranged — and a dtype convert is still the
+# payload's bits, rounded: letting it launder taint would hide an
+# arithmetic combine behind a leading astype)...
+_PASS_THROUGH = {
+    "slice", "dynamic_slice", "squeeze", "reshape", "broadcast_in_dim",
+    "concatenate", "transpose", "pad", "rev", "copy",
+    "expand_dims", "bitcast_convert_type", "convert_element_type",
+    "gather",
+}
+# ...is CONSUMED (and stops) at the ownership select and at flag
+# comparisons (the predicate is the owner bit, not payload)
+_CONSUMERS = {"select_n", "eq", "ne", "ge", "gt", "le", "lt", "and",
+              "or", "not"}
+
+
+class _ForeignTaint(Dataflow):
+    """Taint = 'came off the ring, not yet ownership-selected'."""
+
+    bottom = False
+
+    def join(self, a, b):
+        return bool(a) or bool(b)
+
+    def transfer(self, eqn, in_vals):
+        name = eqn.primitive.name
+        if name == "ppermute":
+            return [True] * len(eqn.outvars)
+        tainted = any(in_vals)
+        if not tainted:
+            return [False] * len(eqn.outvars)
+        if name in _PASS_THROUGH:
+            return [True] * len(eqn.outvars)
+        if name in _CONSUMERS:
+            return [False] * len(eqn.outvars)
+        self.emit(f"`{name}` consumes foreign mailbox bits BEFORE the "
+                  f"ownership select — cross-shard state must move "
+                  f"verbatim (`select_n` on the owner flag), never "
+                  f"through arithmetic")
+        return [False] * len(eqn.outvars)
+
+
+def _find_shard_maps(jaxpr):
+    return [eqn for eqn in iter_eqns(jaxpr)
+            if eqn.primitive.name == "shard_map"]
+
+
+def check_sharding(entry, findings: list[Finding]) -> None:
+    def add(msg: str) -> None:
+        findings.append(Finding(RULE_JSHARD, entry.path, entry.line,
+                                f"[{entry.name}] {msg}"))
+
+    maps = _find_shard_maps(entry.jaxpr.jaxpr)
+    if not maps:
+        add("expected a shard_map program, found none — the sharded "
+            "tick no longer runs under shard_map")
+        return
+    axis = entry.edge_axis
+    for eqn in maps:
+        in_names = eqn.params.get("in_names", ())
+        for i, (var, names) in enumerate(zip(eqn.invars, in_names)):
+            spec = dict(names)
+            if is_key_dtype(getattr(var, "aval", None)):
+                if spec:
+                    add(f"PRNG key input #{i} enters the shard_map "
+                        f"SHARDED ({spec}) — keys must replicate so "
+                        f"every shard draws identical uniforms")
+                continue
+            if spec not in ({}, {0: (axis,)}):
+                add(f"input #{i} uses partitioning {spec} — only "
+                    f"replicated or axis-0 `{axis}` block-sharding is "
+                    f"part of the plane's layout contract")
+        for i, names in enumerate(eqn.params.get("out_names", ())):
+            spec = dict(names)
+            if spec not in ({}, {0: (axis,)}):
+                add(f"output #{i} uses partitioning {spec} — outside "
+                    f"the replicated/edge-sharded layout contract")
+
+        body = eqn.params["jaxpr"]
+        body = body.jaxpr if hasattr(body, "jaxpr") else body
+        seen: set[str] = set()
+        for inner in iter_eqns(body):
+            name = inner.primitive.name
+            if name == "ppermute":
+                perm = inner.params.get("perm", ())
+                srcs = [s for s, _ in perm]
+                dsts = [d for _, d in perm]
+                if (len(set(srcs)) != len(srcs)
+                        or len(set(dsts)) != len(dsts)):
+                    add("ppermute permutation is not a bijection — a "
+                        "duplicated source/destination makes the "
+                        "exchange order-dependent")
+                continue
+            if name in ("psum", "pmax", "pmin", "pmean", "all_gather",
+                        "all_to_all", "reduce_scatter", "psum_scatter",
+                        "pshuffle") and name not in seen:
+                seen.add(name)
+                add(f"collective `{name}` inside the shard_map body — "
+                    f"the mailbox ring (`ppermute`/remote DMA) is the "
+                    f"only vetted cross-shard movement; reductions "
+                    f"across shards break scatter locality")
+
+        msgs: list[str] = []
+        flow = _ForeignTaint(emit=lambda m: msgs.append(m))
+        flow._sub(eqn.params["jaxpr"],
+                  [False] * len(body.invars))
+        for m in dict.fromkeys(msgs):
+            add(m)

@@ -41,7 +41,15 @@ from jax import lax
 
 from kubedtn_tpu.parallel.mesh import EDGE_AXIS
 
-__all__ = ["use_remote_dma", "make_ring_exchange", "dma_right_shift"]
+__all__ = ["use_remote_dma", "make_ring_exchange", "dma_right_shift",
+           "OWNER_COL"]
+
+# Column of the int mailbox payload that carries the ownership flag
+# (1 on the owning shard, 0 elsewhere). The combine below selects on
+# it, and dtnverify's sharding audit (analysis/verify/sharding_audit)
+# verifies at the jaxpr level that foreign payload bits reach the
+# kernels ONLY through that select — never arithmetic.
+OWNER_COL = 0
 
 
 def use_remote_dma(mesh=None) -> bool:
@@ -133,7 +141,7 @@ def make_ring_exchange(n_shards: int, axis: str = EDGE_AXIS,
         for _ in range(n_shards - 1):
             rf = shift(rf)
             ri = shift(ri)
-            own = ri[:, :1] > 0
+            own = ri[:, OWNER_COL:OWNER_COL + 1] > 0
             accf = jnp.where(own, rf, accf)
             acci = jnp.where(own, ri, acci)
         return accf, acci

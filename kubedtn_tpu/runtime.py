@@ -878,6 +878,23 @@ def _fused_tick(state, dyn, key, elapsed_us, seq_args, tbf_args,
 
 _CLASS_FOLD = {"seq": 0, "ind": 1, "tbf": 2}  # _shape_class's fold_in
 
+# The modules whose module-level jitted callables constitute the tick
+# path's device dispatches. dtnverify's dispatch-count probe
+# (kubedtn_tpu.analysis.verify.dispatch) wraps every jax-compiled
+# callable in these modules and counts invocations across a steady
+# plane tick: the one-fused-dispatch-per-tick contract (PR 1) is pinned
+# in COST_BUDGET.json against this count, so a refactor that silently
+# splits the fused program fails tier-1 before any bench run. A new
+# module that dispatches on the tick path must be listed here — the
+# probe cannot see what it does not wrap.
+TICK_DISPATCH_MODULES = (
+    "kubedtn_tpu.runtime",
+    "kubedtn_tpu.telemetry",
+    "kubedtn_tpu.ops.netem",
+    "kubedtn_tpu.ops.edge_state",
+    "kubedtn_tpu.ops.queues",
+)
+
 
 def _needs_placement(arr, sharding) -> bool:
     """Does `arr` need a device_put to land on `sharding`?"""

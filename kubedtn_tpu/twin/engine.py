@@ -318,22 +318,17 @@ def _shard_replicas(tree, mesh):
     return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
 
 
-def run_sweep(snapshot: TwinSnapshot, scenarios, *, steps: int,
-              dt_us: float, spec: TrafficSpec | None = None,
-              k_slots: int = 4, seed: int = 0, mesh=None,
-              edits: ReplicaEdits | None = None, pod_ids=None,
-              keep_final: bool = False) -> SweepResult:
-    """Run one what-if sweep: scenario replicas forked from `snapshot`,
-    advanced `steps` × `dt_us` of virtual time under one compiled scan.
-
-    Replica layout: lane i runs scenarios[i]; when `mesh` is given the
-    lane count pads up to a multiple of the mesh size with unperturbed
-    replicas (dropped from the results). `spec` defaults to the query
-    surface's offered load (query.build_cbr_spec — the ONE default, so
-    a library sweep and a `kdt whatif` sweep answer the same question).
-    `edits` short-circuits compilation for callers that prebuilt the
-    batches.
-    """
+def prepare_sweep(snapshot: TwinSnapshot, scenarios, *, steps: int,
+                  dt_us: float, spec: TrafficSpec | None = None,
+                  k_slots: int = 4, seed: int = 0, mesh=None,
+                  edits: ReplicaEdits | None = None, pod_ids=None):
+    """Build the compiled-sweep inputs without running anything:
+    ``(jitted, args, sig, n_replicas)`` with ``args = (bsim, keys,
+    scale)``. This is the ONE place the sweep's program and argument
+    layout are assembled — `run_sweep` executes it, and dtnverify
+    (kubedtn_tpu.analysis.verify) traces the identical `jitted`/`args`
+    pair into a jaxpr for contract verification, so the verified
+    program cannot drift from the served one."""
     names = [sc.name for sc in scenarios]
     if len(set(names)) != len(names):
         # reports and the wire surface key ranks by name — a duplicate
@@ -378,6 +373,30 @@ def run_sweep(snapshot: TwinSnapshot, scenarios, *, steps: int,
     sig = ("sim", k_slots, float(dt_us), spec_fp, steps, n, cap,
            _abstract_sig((bsim, keys, scale)),
            _mesh_sig(mesh))
+    return jitted, (bsim, keys, scale), sig, n
+
+
+def run_sweep(snapshot: TwinSnapshot, scenarios, *, steps: int,
+              dt_us: float, spec: TrafficSpec | None = None,
+              k_slots: int = 4, seed: int = 0, mesh=None,
+              edits: ReplicaEdits | None = None, pod_ids=None,
+              keep_final: bool = False) -> SweepResult:
+    """Run one what-if sweep: scenario replicas forked from `snapshot`,
+    advanced `steps` × `dt_us` of virtual time under one compiled scan.
+
+    Replica layout: lane i runs scenarios[i]; when `mesh` is given the
+    lane count pads up to a multiple of the mesh size with unperturbed
+    replicas (dropped from the results). `spec` defaults to the query
+    surface's offered load (query.build_cbr_spec — the ONE default, so
+    a library sweep and a `kdt whatif` sweep answer the same question).
+    `edits` short-circuits compilation for callers that prebuilt the
+    batches.
+    """
+    names = [sc.name for sc in scenarios]
+    jitted, (bsim, keys, scale), sig, n = prepare_sweep(
+        snapshot, scenarios, steps=steps, dt_us=dt_us, spec=spec,
+        k_slots=k_slots, seed=seed, mesh=mesh, edits=edits,
+        pod_ids=pod_ids)
     compiled, compile_s = _compile_cached(jitted, sig, bsim, keys, scale)
     t0 = time.perf_counter()
     bfinal, hist, occ, totals = compiled(bsim, keys, scale)
@@ -387,7 +406,7 @@ def run_sweep(snapshot: TwinSnapshot, scenarios, *, steps: int,
     run_s = time.perf_counter() - t0
 
     sim_seconds = steps * dt_us / 1e6
-    start = _start_totals(base.counters)
+    start = _start_totals(snapshot.sim.counters)
     metrics = [_replica_metrics(i, totals_np, start, hist_np, occ_np,
                                 sim_seconds)
                for i in range(len(scenarios))]

@@ -170,6 +170,23 @@ def verify_plan_live(plane, plan, *,
                        pod_ids=pod_ids, spec=spec, mesh=mesh)
 
 
+def gate_scenarios(plan, snapshot, pod_ids=None):
+    """The gate's EXACT sweep input: baseline + cumulative per-round
+    scenarios, plus the skipped-edit counts. One assembly point shared
+    by `verify_plan` (which runs it) and dtnverify
+    (kubedtn_tpu.analysis.verify, which traces the same program for
+    IR-level contract checks), so the verified gate sweep cannot drift
+    from the served one. Returns ``(scenarios, skipped_adds,
+    skipped_edits)`` with ``scenarios[0]`` the unperturbed baseline."""
+    local_node = (pod_ids or {}).get(plan.key)
+    rounds, skipped_adds, skipped_edits = _round_scenarios(
+        plan, snapshot, local_node=local_node)
+    if not rounds or all(not sc.perturbations for sc in rounds):
+        return [], skipped_adds, skipped_edits
+    return ([Scenario(name="baseline"), *rounds], skipped_adds,
+            skipped_edits)
+
+
 def verify_plan(plan, snapshot, *, guardrails: Guardrails | None = None,
                 pod_ids=None, spec=None, mesh=None) -> GateVerdict:
     """Replay the schedule against `snapshot` and return the verdict.
@@ -181,16 +198,15 @@ def verify_plan(plan, snapshot, *, guardrails: Guardrails | None = None,
     rest in `skipped_adds`."""
     g = guardrails or Guardrails()
     t0 = time.perf_counter()
-    local_node = (pod_ids or {}).get(plan.key)
-    scenarios, skipped_adds, skipped_edits = _round_scenarios(
-        plan, snapshot, local_node=local_node)
-    if not scenarios or all(not sc.perturbations for sc in scenarios):
+    scenarios, skipped_adds, skipped_edits = gate_scenarios(
+        plan, snapshot, pod_ids=pod_ids)
+    if not scenarios:
         return GateVerdict(
             ok=True, reason="", baseline={}, rounds=[],
             skipped_adds=skipped_adds, skipped_edits=skipped_edits,
             gate_s=round(time.perf_counter() - t0, 3))
     result = run_sweep(
-        snapshot, [Scenario(name="baseline"), *scenarios],
+        snapshot, scenarios,
         steps=g.ticks, dt_us=g.dt_us, seed=g.seed, k_slots=g.k_slots,
         pod_ids=pod_ids, spec=spec, mesh=mesh)
     base = result.metrics[0]

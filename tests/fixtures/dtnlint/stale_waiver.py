@@ -1,0 +1,9 @@
+"""Stale-waiver fixture: every waiver below sits where its rule no
+longer fires, so a FULL run must report each as a `waiver` finding."""
+
+import os  # dtnlint: hygiene-ok(dead: os IS used below, nothing to waive)
+
+
+# dtnlint: key-ok(dead: this function draws no keys anymore)
+def no_keys_here():
+    return os.getpid()

@@ -1,0 +1,139 @@
+"""Seeded mutation fixtures for the dtnverify passes.
+
+Each function re-introduces one HISTORICAL bug shape at the IR level —
+the exact classes the jaxpr passes exist to catch (ARCHITECTURE.md
+"Enforced invariants", lineage column). The test suite traces each
+mutant and asserts its pass KILLS it while the real tree stays clean;
+a pass that stops killing its mutant has rotted.
+
+Loaded by path (importlib) from tests/test_jaxpr_verify.py — never on
+the package import path, so dtnlint's AST passes do not scan it.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def mutant_raw_key(x):
+    """PR 6's engine.ping bug: a raw `jax.random.key(seed)` minted
+    INSIDE the traced program — every call replays the same stream.
+    Killed by jkey (random_seed in traced code) and jops (denied
+    primitive)."""
+    k = jax.random.key(42)
+    return x + jax.random.uniform(k, x.shape)
+
+
+def mutant_unsplit_key(key, x):
+    """The PR 3 vmap-drift class: a key ARGUMENT consumed raw by the
+    sampler — no split/fold_in between the tick key and the draw, so
+    two call sites sharing the key draw identical bits. Killed by
+    jkey."""
+    return x + jax.random.uniform(key, x.shape)
+
+
+def clean_key_use(key, x):
+    """The contract-conforming shape: fold_in then sample."""
+    k = jax.random.fold_in(key, 7)
+    return x + jax.random.uniform(k, x.shape)
+
+
+def mutant_f32_anchor(clock_us, soa):
+    """The PR 3 clock-freeze class, at the IR level: an f64 wall-clock
+    anchor truncated to f32 inside traced code and scattered into the
+    f32 SoA — past ~2.4 h of µs uptime the f32 clock stops advancing.
+    Trace under `jax.experimental.enable_x64` with an f64 `clock_us`.
+    Killed by jdtype (truncating cast + tainted scatter)."""
+    t32 = clock_us.astype(jnp.float32)
+    return soa.at[jnp.int32(0)].set(t32[0])
+
+
+def clean_anchor_use(clock_us, soa):
+    """The contract-conforming shape: form the RELATIVE time in f64,
+    then narrow the small delta."""
+    rel = clock_us - clock_us[0]
+    return soa.at[jnp.int32(0)].set(rel[0].astype(jnp.float32))
+
+
+def make_mutant_mailbox_arith(mesh, axis):
+    """The select-combine violation: the ring exchange merges foreign
+    mailbox bits with ARITHMETIC (`acc + rf * flag`) instead of the
+    ownership select — one FMA rounding and the N-shard plane is no
+    longer bit-identical to the 1-shard plane. Killed by jshard."""
+    from kubedtn_tpu.parallel.mesh import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = int(mesh.devices.size)
+    perm = [(s, (s + 1) % n) for s in range(n)]
+
+    def body(fmail, imail):
+        acc = fmail
+        rf, ri = fmail, imail
+        for _ in range(n - 1):
+            rf = lax.ppermute(rf, axis, perm)
+            ri = lax.ppermute(ri, axis, perm)
+            flag = (ri[:, :1] > 0).astype(fmail.dtype)
+            acc = acc + rf * flag   # the mutation: arithmetic combine
+        return acc
+
+    return shard_map(body, mesh=mesh, in_specs=(P(), P()),
+                     out_specs=P())
+
+
+def make_mutant_mailbox_cast_arith(mesh, axis):
+    """The laundered variant: the arithmetic combine hidden behind a
+    leading dtype cast (`ri.astype(f32)` then FMA). A taint pass that
+    lets `convert_element_type` consume taint misses this; jshard must
+    still kill it."""
+    from kubedtn_tpu.parallel.mesh import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = int(mesh.devices.size)
+    perm = [(s, (s + 1) % n) for s in range(n)]
+
+    def body(fmail, imail):
+        acc = fmail
+        rf, ri = fmail, imail
+        for _ in range(n - 1):
+            rf = lax.ppermute(rf, axis, perm)
+            ri = lax.ppermute(ri, axis, perm)
+            flag_f = ri[:, :1].astype(fmail.dtype)  # cast, THEN math
+            acc = acc + rf * flag_f
+        return acc
+
+    return shard_map(body, mesh=mesh, in_specs=(P(), P()),
+                     out_specs=P())
+
+
+def make_clean_mailbox(mesh, axis):
+    """The real exchange's select-combine, for the clean control."""
+    from kubedtn_tpu.parallel.exchange import make_ring_exchange
+
+    n = int(mesh.devices.size)
+    from kubedtn_tpu.parallel.mesh import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    exch = make_ring_exchange(n, axis, use_dma=False)
+    return shard_map(lambda f, i: exch(f, i), mesh=mesh,
+                     in_specs=(P(), P()), out_specs=(P(), P()))
+
+
+# -- the un-fused two-dispatch tick (jcost / dispatch counting) --------
+
+@jax.jit
+def _half_tick_a(x):
+    return x * 2.0
+
+
+@jax.jit
+def _half_tick_b(x):
+    return x + 1.0
+
+
+def mutant_two_dispatch_tick(x):
+    """The fusion regression: what used to be ONE fused device program
+    now crosses the host between two jitted dispatches. Killed by the
+    jcost dispatch gate (dispatches per tick pinned in
+    COST_BUDGET.json)."""
+    y = _half_tick_a(x)
+    return _half_tick_b(y)

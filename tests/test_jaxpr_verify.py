@@ -1,0 +1,390 @@
+"""dtnverify: mutation-fixture kills per pass family, the real-tree
+tier-1 gate (zero unwaivered jaxpr findings, ANALYSIS.json schema v2),
+and the COST_BUDGET.json dispatch pin.
+
+Mutation methodology: tests/fixtures/dtnverify/mutants.py re-introduces
+each historical bug shape (raw key() into a sampler, f32 clock-anchor
+cast, arithmetic on mailbox foreign bits, an un-fused two-dispatch
+tick); every pass must KILL its mutant while the corresponding clean
+control — and the real tree — stay silent. A pass that reports nothing
+on its mutant has rotted, whatever it says about the tree.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubedtn_tpu.analysis import default_root
+from kubedtn_tpu.analysis.verify.dtype_flow import check_dtype_flow
+from kubedtn_tpu.analysis.verify.entrypoints import EntryPoint
+from kubedtn_tpu.analysis.verify.ops_allowlist import check_keys, check_ops
+from kubedtn_tpu.analysis.verify.sharding_audit import check_sharding
+
+REPO = default_root()
+_SPEC = importlib.util.spec_from_file_location(
+    "dtnverify_mutants",
+    Path(__file__).parent / "fixtures" / "dtnverify" / "mutants.py")
+mutants = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(mutants)
+# registered so the dispatch counter can wrap its jitted callables by
+# module name, exactly as it wraps the runtime modules
+sys.modules["dtnverify_mutants"] = mutants
+
+
+def _entry(name, fn, *args, **kw) -> EntryPoint:
+    ep = EntryPoint(name, "tests/fixtures/dtnverify/mutants.py", 1, **kw)
+    ep.jaxpr = jax.make_jaxpr(fn)(*args)
+    return ep
+
+
+# ---- jkey / jops: key provenance --------------------------------------
+
+def test_raw_key_mutant_killed():
+    ep = _entry("mutant_raw_key", mutants.mutant_raw_key,
+                jnp.zeros((4,)))
+    found: list = []
+    check_keys(ep, found)
+    assert any("random_seed" in f.message for f in found), found
+    ops: list = []
+    check_ops(ep, ops)
+    assert any("denied primitive `random_seed`" in f.message
+               for f in ops), ops
+
+
+def test_unsplit_key_mutant_killed():
+    ep = _entry("mutant_unsplit_key", mutants.mutant_unsplit_key,
+                jax.random.key(0), jnp.zeros((4,)))
+    found: list = []
+    check_keys(ep, found)
+    assert any("consumed RAW" in f.message for f in found), found
+
+
+def test_clean_key_control_silent():
+    ep = _entry("clean_key_use", mutants.clean_key_use,
+                jax.random.key(0), jnp.zeros((4,)))
+    found: list = []
+    check_keys(ep, found)
+    check_ops(ep, found)
+    assert found == []
+
+
+# ---- jdtype: f64 anchor taint -----------------------------------------
+
+def test_f32_anchor_mutant_killed():
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        ep = _entry("mutant_f32_anchor", mutants.mutant_f32_anchor,
+                    jnp.arange(3, dtype=jnp.float64),
+                    jnp.zeros((4,), jnp.float32))
+        found: list = []
+        check_dtype_flow(ep, found)
+    msgs = [f.message for f in found]
+    assert any("truncating cast" in m for m in msgs), msgs
+    assert any("scattered into" in m or "written into" in m
+               for m in msgs), msgs
+
+
+def test_clean_anchor_control():
+    """The relative-time idiom still narrows f64→f32 — but only AFTER
+    the anchor subtraction; the taint pass reports the cast (the value
+    descends from the anchor) yet the scatter carries a small delta.
+    The tree-level contract is stronger: NO f64 inside traced code at
+    all (x64 off), which `test_real_tree_clean` pins; this control
+    documents what the taint sees on an x64 trace."""
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        ep = _entry("clean_anchor_use", mutants.clean_anchor_use,
+                    jnp.arange(3, dtype=jnp.float64),
+                    jnp.zeros((4,), jnp.float32))
+        found: list = []
+        check_dtype_flow(ep, found)
+    # no f64 value lands in the f32 SoA without the narrowing being
+    # visible: the cast IS reported (descends from the anchor)...
+    assert any("truncating cast" in f.message for f in found)
+
+
+def test_f32_only_program_silent():
+    ep = _entry("f32_prog", lambda x: x * 2.0, jnp.zeros((4,)))
+    found: list = []
+    check_dtype_flow(ep, found)
+    assert found == []
+
+
+# ---- jshard: mailbox select-combine -----------------------------------
+
+@pytest.fixture
+def mesh2():
+    from kubedtn_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices for a shard_map mailbox ring")
+    return make_mesh(2)
+
+
+def test_mailbox_arith_mutant_killed(mesh2):
+    from kubedtn_tpu.parallel.mesh import EDGE_AXIS
+
+    fn = mutants.make_mutant_mailbox_arith(mesh2, EDGE_AXIS)
+    ep = _entry("mutant_mailbox_arith", fn,
+                jnp.zeros((4, 3), jnp.float32),
+                jnp.zeros((4, 2), jnp.int32),
+                expect_shard_map=True,
+                allowed_collectives=("ppermute", "axis_index"))
+    found: list = []
+    check_sharding(ep, found)
+    assert any("BEFORE the ownership select" in f.message
+               for f in found), found
+
+
+def test_mailbox_cast_laundered_arith_killed(mesh2):
+    """A dtype cast must not launder foreign-bit taint: the arithmetic
+    combine hidden behind `astype` is still caught."""
+    from kubedtn_tpu.parallel.mesh import EDGE_AXIS
+
+    fn = mutants.make_mutant_mailbox_cast_arith(mesh2, EDGE_AXIS)
+    ep = _entry("mutant_mailbox_cast_arith", fn,
+                jnp.zeros((4, 3), jnp.float32),
+                jnp.zeros((4, 2), jnp.int32),
+                expect_shard_map=True,
+                allowed_collectives=("ppermute", "axis_index"))
+    found: list = []
+    check_sharding(ep, found)
+    assert any("BEFORE the ownership select" in f.message
+               for f in found), found
+
+
+def test_clean_mailbox_control_silent(mesh2):
+    from kubedtn_tpu.parallel.mesh import EDGE_AXIS
+
+    fn = mutants.make_clean_mailbox(mesh2, EDGE_AXIS)
+    ep = _entry("clean_mailbox", fn,
+                jnp.zeros((4, 3), jnp.float32),
+                jnp.zeros((4, 2), jnp.int32),
+                expect_shard_map=True,
+                allowed_collectives=("ppermute", "axis_index"))
+    found: list = []
+    check_sharding(ep, found)
+    assert found == []
+
+
+# ---- jcost: dispatch counting + budget gate ---------------------------
+
+def test_two_dispatch_mutant_counted():
+    """The dispatch counter sees BOTH jitted calls of the un-fused
+    mutant tick — a fused program would count one."""
+    from kubedtn_tpu.analysis.verify.dispatch import count_dispatches
+
+    x = jnp.zeros((8,))
+    mutants.mutant_two_dispatch_tick(x)  # warm the compiles
+    n = count_dispatches(lambda: mutants.mutant_two_dispatch_tick(x),
+                         ["dtnverify_mutants"])
+    assert n == 2
+
+
+def test_budget_flags_dispatch_regression(tmp_path):
+    """A dispatch count above the pinned budget is a jcost finding —
+    the fusion-regression gate."""
+    from kubedtn_tpu.analysis.verify import budget as bm
+
+    (tmp_path / "COST_BUDGET.json").write_text(json.dumps({
+        "schema_version": 1, "backend": jax.default_backend(),
+        "jax": jax.__version__, "tolerance": 1.5,
+        "entries": {}, "dispatch": {"fused_tick_d1": 1}}))
+    found: list = []
+    bm.check_budget(tmp_path, [], {"fused_tick_d1": 2.0}, found)
+    assert any("dispatches per tick" in f.message for f in found)
+    found2: list = []
+    bm.check_budget(tmp_path, [], {"fused_tick_d1": 1.0}, found2)
+    assert found2 == []
+
+
+def test_budget_flags_cost_regression(tmp_path):
+    from kubedtn_tpu.analysis.verify import budget as bm
+
+    (tmp_path / "COST_BUDGET.json").write_text(json.dumps({
+        "schema_version": 1, "backend": jax.default_backend(),
+        "jax": jax.__version__, "tolerance": 1.5,
+        "entries": {"e": {"flops": 100.0, "bytes": 100.0, "eqns": 1}},
+        "dispatch": {}}))
+    ep = EntryPoint("e", "kubedtn_tpu/runtime.py", 1)
+    ep.jaxpr = jax.make_jaxpr(lambda x: x)(jnp.zeros(()))
+    ep.cost = {"flops": 200.0, "bytes": 90.0}
+    found: list = []
+    bm.check_budget(tmp_path, [ep], {}, found)
+    assert any("flops regression" in f.message for f in found), found
+    assert not any("bytes regression" in f.message for f in found)
+
+
+def test_budget_missing_entry_is_finding(tmp_path):
+    from kubedtn_tpu.analysis.verify import budget as bm
+
+    (tmp_path / "COST_BUDGET.json").write_text(json.dumps({
+        "schema_version": 1, "backend": jax.default_backend(),
+        "jax": jax.__version__, "tolerance": 1.5,
+        "entries": {}, "dispatch": {}}))
+    ep = EntryPoint("brand_new", "kubedtn_tpu/runtime.py", 1)
+    ep.jaxpr = jax.make_jaxpr(lambda x: x)(jnp.zeros(()))
+    ep.cost = {"flops": 1.0, "bytes": 1.0}
+    found: list = []
+    bm.check_budget(tmp_path, [ep], {}, found)
+    assert any("no budget pinned" in f.message for f in found)
+
+
+# ---- the real tree: tier-1 gate ---------------------------------------
+
+@pytest.fixture(scope="module")
+def real_verify():
+    """ONE full dtnverify run shared by the gate assertions below
+    (tracing + compiling every entry point costs tens of seconds)."""
+    from kubedtn_tpu.analysis.verify import run_verify
+
+    return run_verify(root=REPO)
+
+
+def test_real_tree_clean_and_artifact_written(real_verify):
+    """Every entry point traces, all four pass families run, zero
+    unwaivered jaxpr findings — and the combined schema-v2 artifact
+    lands in ANALYSIS.json alongside the AST layer."""
+    findings, report = real_verify
+    active = [f for f in findings if not f.waived]
+    assert active == [], "\n" + "\n".join(f.format() for f in active)
+    eps = report["entry_points"]
+    assert set(eps) == {
+        "fused_tick_d1", "fused_tick_d2", "class_tick_tbf",
+        "class_tick_seq", "class_tick_ind", "sharded_fused",
+        "twin_sweep", "update_gate_sweep"}
+    # on the tier-1 8-device CPU mesh nothing may skip
+    skipped = {k: v for k, v in eps.items() if "skipped" in v}
+    assert not skipped, skipped
+
+    from kubedtn_tpu.analysis import run_suite, write_json
+
+    _project, ast_findings = run_suite(root=REPO)
+    section = dict(report)
+    section["findings"] = [f.to_json() for f in findings]
+    section["summary"] = {**report["summary"],
+                          "total": len(findings),
+                          "unwaivered": len(active)}
+    out = REPO / "ANALYSIS.json"
+    write_json(out, ast_findings, REPO, jaxpr=section)
+    doc = json.loads(out.read_text())
+    assert doc["schema_version"] == 2
+    assert doc["jaxpr"]["summary"]["unwaivered"] == 0
+    assert set(doc["jaxpr"]["entry_points"]) == set(eps)
+
+
+def test_fused_tick_dispatch_pinned(real_verify):
+    """COST_BUDGET.json pins the fused tick at ONE dispatch per steady
+    tick, both pipeline depths — the measured probe must agree, so a
+    fusion regression fails here before any bench run."""
+    _findings, report = real_verify
+    assert report["dispatch"]["fused_tick_d1"] == 1.0
+    assert report["dispatch"]["fused_tick_d2"] == 1.0
+    doc = json.loads((REPO / "COST_BUDGET.json").read_text())
+    assert doc["dispatch"]["fused_tick_d1"] == 1.0
+    assert doc["dispatch"]["fused_tick_d2"] == 1.0
+    assert set(doc["entries"]) == set(report["entry_points"])
+
+
+def test_sharded_entry_audited(real_verify):
+    """The sharded program actually contains the shard_map + ring the
+    audit reasons about (a trivially-empty audit would pass
+    vacuously)."""
+    from kubedtn_tpu.analysis.verify.entrypoints import trace_entry_points
+    from kubedtn_tpu.analysis.verify.jaxpr_tools import primitive_set
+
+    eps = trace_entry_points(entries=("sharded_fused",),
+                             compile_costs=False)
+    assert eps[0].jaxpr is not None, eps[0].skip_reason
+    prims = primitive_set(eps[0].jaxpr.jaxpr)
+    assert "shard_map" in prims and "ppermute" in prims
+
+
+def test_cli_verify_subset(tmp_path):
+    """`--verify --entries ...` runs the jaxpr layer end-to-end in a
+    fresh process and writes the schema-v2 artifact."""
+    out = tmp_path / "a.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "kubedtn_tpu.analysis", "-q",
+         "--root", str(REPO), "--verify",
+         "--entries", "twin_sweep", "--json", str(out)],
+        capture_output=True, text=True, timeout=560)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(out.read_text())
+    assert doc["schema_version"] == 2
+    assert "twin_sweep" in doc["jaxpr"]["entry_points"]
+
+
+def test_subset_run_merges_into_full_artifact(tmp_path):
+    """`--verify --entries X --json PATH` must not clobber a full
+    artifact's jaxpr section: the re-traced entry merges over the old
+    section, dispatch pins and other entries' findings survive."""
+    from kubedtn_tpu.analysis.__main__ import _merge_subset_section
+
+    full = {"schema_version": 2, "findings": [], "summary": {},
+            "jaxpr": {
+                "entry_points": {"fused_tick_d1": {"eqns": 10},
+                                 "twin_sweep": {"eqns": 20}},
+                "dispatch": {"fused_tick_d1": 1.0},
+                "budget": {"checked": True},
+                "findings": [
+                    {"rule": "jops", "path": "a.py", "line": 1,
+                     "message": "[fused_tick_d1] old finding",
+                     "waived": False},
+                    {"rule": "jcost", "path": "a.py", "line": 1,
+                     "message": "[twin_sweep] dispatches per tick = "
+                                "2.0 (budget 1.0)", "waived": False},
+                    {"rule": "jops", "path": "a.py", "line": 1,
+                     "message": "[twin_sweep] stale for this entry",
+                     "waived": False}],
+                "summary": {"total": 3}}}
+    p = tmp_path / "A.json"
+    p.write_text(json.dumps(full))
+    subset = {"entry_points": {"twin_sweep": {"eqns": 21}},
+              "dispatch": {}, "budget": {},
+              "findings": [], "summary": {"total": 0}}
+    merged = _merge_subset_section(p, subset, ("twin_sweep",))
+    assert merged["dispatch"] == {"fused_tick_d1": 1.0}
+    assert merged["entry_points"]["twin_sweep"]["eqns"] == 21
+    assert merged["entry_points"]["fused_tick_d1"]["eqns"] == 10
+    msgs = [f["message"] for f in merged["findings"]]
+    assert "[fused_tick_d1] old finding" in msgs      # kept
+    assert "[twin_sweep] stale for this entry" not in msgs  # re-traced
+    # jcost findings survive even for the re-traced entry: a subset run
+    # never re-measures dispatches/budgets, so dropping one would flip
+    # the artifact to clean with the regression still live
+    assert any("dispatches per tick" in m for m in msgs)
+    assert merged["summary"]["total"] == 2
+
+
+def test_verify_cache_roundtrip(tmp_path, real_verify):
+    """The result cache replays a stored run while the tree hash
+    matches and misses after any package-source edit."""
+    from kubedtn_tpu.analysis.verify import runner
+
+    findings, report = real_verify
+    (tmp_path / "kubedtn_tpu").mkdir()
+    (tmp_path / "kubedtn_tpu" / "m.py").write_text("x = 1\n")
+    key = runner._tree_hash(tmp_path)
+    runner._save_cache(tmp_path, key, findings, report)
+    hit = runner._load_cache(tmp_path, key)
+    assert hit is not None
+    cached_findings, cached_report = hit
+    assert [f.to_json() for f in cached_findings] == \
+        [f.to_json() for f in findings]
+    assert cached_report["entry_points"] == dict(report)["entry_points"]
+    # a source edit moves the hash; the old key no longer hits
+    (tmp_path / "kubedtn_tpu" / "m.py").write_text("x = 2\n")
+    new_key = runner._tree_hash(tmp_path)
+    assert new_key != key
+    assert runner._load_cache(tmp_path, new_key) is None

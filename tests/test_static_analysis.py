@@ -151,6 +151,202 @@ def test_waiver_requires_reason():
     assert m and m.group(2) == "because"
 
 
+def test_stale_waivers_detected():
+    """A `<rule>-ok` comment whose line no longer triggers the rule is
+    itself a finding on a FULL run (dead waivers rot the audit trail)."""
+    from kubedtn_tpu.analysis import run_suite
+
+    _p, f = run_suite(root=FIXTURES, packages=("stale_waiver.py",))
+    stale = [x for x in f if x.rule == "waiver"]
+    assert len(stale) == 2, [x.format() for x in f]
+    msgs = "\n".join(x.message for x in stale)
+    assert "hygiene-ok" in msgs and "key-ok" in msgs
+    assert all(not x.waived for x in stale)
+
+
+def test_live_waivers_not_reported_stale():
+    from kubedtn_tpu.analysis import run_suite
+
+    _p, f = run_suite(root=FIXTURES, packages=("waivered.py",))
+    assert [x for x in f if x.rule == "waiver"] == [], \
+        [x.format() for x in f]
+
+
+def test_subset_run_skips_stale_detection():
+    """--rules subset runs cannot judge staleness: the un-run rules'
+    waivers would all look dead."""
+    from kubedtn_tpu.analysis import run_suite
+
+    _p, f = run_suite(root=FIXTURES, packages=("stale_waiver.py",),
+                      rules=("hygiene",))
+    assert [x for x in f if x.rule == "waiver"] == []
+
+
+def test_jaxpr_rule_waiver_reported_unsupported(tmp_path):
+    """dtnverify findings are NOT waivable: a `jops-ok(...)` comment is
+    reported as targeting an unwaivable layer, not as merely stale."""
+    from kubedtn_tpu.analysis import run_suite
+
+    p = tmp_path / "jw.py"
+    p.write_text('"""f."""\n'
+                 "X = 1  # dtnlint: jops-ok(reviewed the primitive)\n")
+    _p, f = run_suite(root=tmp_path, packages=("jw.py",))
+    w = [x for x in f if x.rule == "waiver"]
+    assert len(w) == 1
+    assert "not waivable" in w[0].message
+
+
+# ---- --fix: hygiene autofixes ----------------------------------------
+
+def _fix_copy(tmp_path, name="hygiene_bad.py"):
+    import shutil
+
+    pkg = tmp_path / name
+    shutil.copy(FIXTURES / name, pkg)
+    return pkg
+
+
+def test_fix_removes_unused_imports_and_sorts(tmp_path):
+    from kubedtn_tpu.analysis import CallGraph, Project
+    from kubedtn_tpu.analysis.core import apply_waivers
+    from kubedtn_tpu.analysis.fix import fix_tree
+    from kubedtn_tpu.analysis.passes import PASSES
+
+    p = _fix_copy(tmp_path)
+    project = Project(tmp_path, packages=("hygiene_bad.py",))
+    graph = CallGraph(project)
+    findings = apply_waivers(project, PASSES["hygiene"](project, graph))
+    changed = fix_tree(tmp_path, project, findings)
+    assert changed == ["hygiene_bad.py"]
+    text = p.read_text()
+    assert "import sys" not in text          # unused import dropped
+    # groups re-sorted: stdlib now precedes the first-party import
+    assert text.index("import os") < text.index(
+        "from kubedtn_tpu import contracts")
+    # re-lint: only the bare-except remains (not mechanically fixable)
+    project2 = Project(tmp_path, packages=("hygiene_bad.py",))
+    left = PASSES["hygiene"](project2, CallGraph(project2))
+    assert [f for f in left if "bare" not in f.message] == [], \
+        [f.format() for f in left]
+
+
+def test_fix_is_idempotent_and_safe(tmp_path):
+    from kubedtn_tpu.analysis import CallGraph, Project
+    from kubedtn_tpu.analysis.core import apply_waivers
+    from kubedtn_tpu.analysis.fix import fix_tree
+    from kubedtn_tpu.analysis.passes import PASSES
+
+    p = _fix_copy(tmp_path)
+    for _ in range(2):
+        project = Project(tmp_path, packages=("hygiene_bad.py",))
+        graph = CallGraph(project)
+        findings = apply_waivers(project,
+                                 PASSES["hygiene"](project, graph))
+        fix_tree(tmp_path, project, findings)
+    import ast
+
+    ast.parse(p.read_text())  # still valid python
+    second = p.read_text()
+    project = Project(tmp_path, packages=("hygiene_bad.py",))
+    findings = apply_waivers(
+        project, PASSES["hygiene"](project, CallGraph(project)))
+    fix_tree(tmp_path, project, findings)
+    assert p.read_text() == second  # no further churn
+
+
+def test_fix_leaves_waived_findings_alone(tmp_path):
+    from kubedtn_tpu.analysis import CallGraph, Project
+    from kubedtn_tpu.analysis.core import apply_waivers
+    from kubedtn_tpu.analysis.fix import fix_tree
+    from kubedtn_tpu.analysis.passes import PASSES
+
+    p = tmp_path / "waived_import.py"
+    p.write_text(
+        '"""f."""\n'
+        "import sys  # dtnlint: hygiene-ok(kept for doctest namespace)\n"
+        "X = 1\n")
+    project = Project(tmp_path, packages=("waived_import.py",))
+    findings = apply_waivers(
+        project, PASSES["hygiene"](project, CallGraph(project)))
+    assert findings and all(f.waived for f in findings)
+    changed = fix_tree(tmp_path, project, findings)
+    assert changed == []
+    assert "import sys" in p.read_text()
+
+
+def test_fix_import_order_refuses_to_eat_free_comment(tmp_path):
+    """A free-standing comment inside the leading import block (blank
+    line between it and the next import) belongs to no reorder unit —
+    the fixer must refuse rather than silently delete it."""
+    from kubedtn_tpu.analysis.fix import fix_import_order
+
+    p = tmp_path / "m.py"
+    src = ('"""d."""\n'
+           "from kubedtn_tpu import contracts\n"
+           "\n"
+           "# TODO: revisit this dependency\n"
+           "\n"
+           "import os\n"
+           "\n"
+           "X = (os, contracts)\n")
+    p.write_text(src)
+    assert fix_import_order(p) is False
+    assert p.read_text() == src  # untouched, comment intact
+
+
+# ---- --diff: artifact deltas ------------------------------------------
+
+def test_diff_new_fixed_and_waiver_flip(tmp_path):
+    from kubedtn_tpu.analysis.diff import diff_docs, run_diff
+
+    old = {"schema_version": 1, "findings": [
+        {"rule": "key", "path": "a.py", "line": 3, "message": "m1",
+         "waived": False},
+        {"rule": "sync", "path": "b.py", "line": 9, "message": "m2",
+         "waived": False}]}
+    new = {"schema_version": 2, "findings": [
+        {"rule": "key", "path": "a.py", "line": 5, "message": "m1",
+         "waived": True},
+        {"rule": "dtype", "path": "c.py", "line": 1, "message": "m3",
+         "waived": False}],
+        "jaxpr": {"findings": [
+            {"rule": "jops", "path": "d.py", "line": 1,
+             "message": "m4", "waived": False}]}}
+    d = diff_docs(old, new)
+    assert {f["message"] for f in d["new"]} == {"m3", "m4"}
+    assert {f["message"] for f in d["fixed"]} == {"m2"}
+    assert len(d["waiver_changes"]) == 1
+    assert d["waiver_changes"][0]["now_waived"] is True
+    # exit codes: new ACTIVE findings → 1; clean delta → 0
+    import json as _json
+
+    po, pn = tmp_path / "old.json", tmp_path / "new.json"
+    po.write_text(_json.dumps(old))
+    pn.write_text(_json.dumps(new))
+    assert run_diff(po, pn) == 1
+    pn.write_text(_json.dumps(old))
+    assert run_diff(po, pn) == 0
+
+
+def test_cli_diff(tmp_path):
+    """End-to-end: two artifact writes, then --diff in a subprocess."""
+    first = tmp_path / "first.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "kubedtn_tpu.analysis", "-q",
+         "--root", str(REPO), "--json", str(first)],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    second = tmp_path / "second.json"
+    r2 = subprocess.run(
+        [sys.executable, "-m", "kubedtn_tpu.analysis", "-q",
+         "--root", str(REPO), "--json", str(second),
+         "--diff", str(first)],
+        capture_output=True, text=True, timeout=300)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "new findings: 0" in r2.stdout
+    assert "fixed findings: 0" in r2.stdout
+
+
 # ---- the tier-1 gate: the tree itself is clean ------------------------
 
 def test_tree_is_clean_and_artifact_written():
