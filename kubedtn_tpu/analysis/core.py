@@ -36,7 +36,7 @@ ALL_RULES = (RULE_PURITY, RULE_KEY, RULE_SYNC, RULE_LOCK, RULE_DTYPE,
 # vetted allowlist or --update-budgets. A `<tag>-ok(...)` comment for
 # one of these is dead by construction — stale_waivers names it as
 # such instead of pretending the rule merely stopped firing.
-JAXPR_RULES = ("jops", "jkey", "jdtype", "jshard", "jcost")
+JAXPR_RULES = ("jops", "jkey", "jdtype", "jshard", "jtenant", "jcost")
 
 # the ANALYSIS.json artifact schema. v1: flat dtnlint findings doc
 # (PRs 6-7). v2: adds `schema_version` and the dtnverify `jaxpr`

@@ -111,6 +111,8 @@ def run_verify(root: Path | None = None,
         check_ops,
     )
     from kubedtn_tpu.analysis.verify.sharding_audit import check_sharding
+    from kubedtn_tpu.analysis.verify.tenant_audit import \
+        check_tenant_isolation
 
     eps = trace_entry_points(entries=entries, compile_costs=True)
     findings: list[Finding] = []
@@ -122,6 +124,12 @@ def run_verify(root: Path | None = None,
         check_dtype_flow(ep, findings)
         if ep.expect_shard_map:
             check_sharding(ep, findings)
+        if ep.name.startswith(("fused_tick", "class_tick",
+                               "sharded_fused")):
+            # tenant-isolation: tick-program scatters must not shift
+            # row indices across tenant ranges (sweep entries advance
+            # whole-capacity state, no row-index scatters to audit)
+            check_tenant_isolation(ep, findings)
 
     # dispatch counts: only measured on a full run (the probe builds
     # and ticks a live plane; a --entries subset run stays cheap)

@@ -1,0 +1,117 @@
+"""jtenant — tenant-isolation audit of the compiled tick programs.
+
+The multi-tenant plane's isolation contract (ARCHITECTURE.md
+"Multi-tenant plane") rests on an IR-checkable fact: every scatter in
+the tick program lands on row indices that derive from the dispatch's
+ROW-INDEX INPUTS through index-preserving ops only — selects against
+the padding sentinel, clamps, dtype converts, the sharded body's
+axis-offset translation. No arithmetic ever SHIFTS an index: an
+`add`/`mul` on the index path could relocate a write into another
+tenant's edge range, silently corrupting a neighbor's shaping state
+while every per-tenant counter still balances.
+
+Mechanics (the same forward-taint machinery as the mailbox
+ownership-select rule, sharding_audit._ForeignTaint): each value
+carries (arith, axis) flags. `axis_index` outputs are axis-derived;
+index arithmetic with an axis-derived operand stays clean (the sharded
+body's `rows - shard_offset` translation is the vetted shift); any
+other add/sub/mul/div/rem taints. A scatter whose index operand is
+arith-tainted is a finding. The seeded cross-tenant-scatter mutant
+(tests/fixtures/dtnverify/mutants.py: mutant_cross_tenant_scatter)
+re-introduces the exact bug shape — `rows + stride` before the
+write-back scatter — and the pass must kill it while the real fused /
+class / sharded programs stay silent.
+"""
+
+from __future__ import annotations
+
+from kubedtn_tpu.analysis.core import Finding
+from kubedtn_tpu.analysis.verify.jaxpr_tools import Dataflow, iter_eqns
+
+RULE_JTENANT = "jtenant"
+
+# index arithmetic that can SHIFT a row index across a range boundary
+_INDEX_ARITH = {"add", "sub", "mul", "div", "rem", "pow",
+                "integer_pow", "dot_general"}
+# scatter-family primitives whose index operand must stay shift-free
+# (operand 0 = target, operand 1 = scatter indices, rest = updates)
+_SCATTER_PRIMS = {"scatter", "scatter-add", "scatter-mul",
+                  "scatter-min", "scatter-max", "scatter_add",
+                  "scatter_mul", "scatter_min", "scatter_max"}
+
+
+class _IndexTaint(Dataflow):
+    """Value lattice: (arith, axis) — `arith` marks a value that passed
+    through index-shifting arithmetic with no axis-derived operand;
+    `axis` marks descent from `axis_index` (the shard-local offset
+    translation, the one vetted shift)."""
+
+    bottom = (False, False)
+
+    def join(self, a, b):
+        a = a or self.bottom
+        b = b or self.bottom
+        return (a[0] or b[0], a[1] or b[1])
+
+    def transfer(self, eqn, in_vals):
+        name = eqn.primitive.name
+        vals = [v or self.bottom for v in in_vals]
+        if name == "axis_index":
+            return [(False, True)] * len(eqn.outvars)
+        arith = any(v[0] for v in vals)
+        axis = any(v[1] for v in vals)
+        if name in _INDEX_ARITH:
+            # arithmetic taints UNLESS an operand descends from
+            # axis_index (the sharded body's offset translation) — and
+            # propagates existing taint regardless
+            out = (arith or not axis, axis)
+            return [out] * len(eqn.outvars)
+        if name == "select_n" and len(vals) > 1:
+            # jax's indexed-update lowering normalizes negative
+            # indices as select_n(idx < 0, idx, idx + N): a select
+            # with AT LEAST ONE clean data branch yields the clean
+            # provenance (the shifted copy is only taken where the
+            # clean one wraps). A select whose EVERY branch is shifted
+            # — the cross-tenant mutant's shape — stays tainted.
+            data = vals[1:]
+            out = (all(v[0] for v in data),
+                   any(v[1] for v in data))
+            return [out] * len(eqn.outvars)
+        if name in _SCATTER_PRIMS and len(eqn.invars) >= 2:
+            idx_val = vals[1] if len(vals) >= 2 else self.bottom
+            if idx_val[0]:
+                self.emit(
+                    f"`{name}` scatter indices pass through index "
+                    f"ARITHMETIC with no axis-offset provenance — a "
+                    f"shifted row index can write into another "
+                    f"tenant's edge range; indices must derive from "
+                    f"the dispatch's row inputs via select/clamp/"
+                    f"convert only")
+        # default: propagate the join (selects, clamps, converts,
+        # gathers, reshapes all preserve whatever taint flows in)
+        return None
+
+
+def check_tenant_isolation(entry, findings: list[Finding]) -> None:
+    """Run the index-taint audit over one traced entry point; also
+    sanity-check that the program HAS write-back scatters at all — a
+    tick program with no scatter would mean the audit is pointed at
+    the wrong entry (harness drift), which must be loud."""
+
+    def add(msg: str) -> None:
+        findings.append(Finding(RULE_JTENANT, entry.path, entry.line,
+                                f"[{entry.name}] {msg}"))
+
+    jaxpr = entry.jaxpr.jaxpr
+    has_scatter = any(e.primitive.name in _SCATTER_PRIMS
+                      for e in iter_eqns(jaxpr))
+    if not has_scatter:
+        add("expected write-back scatters in the tick program, found "
+            "none — the tenant-isolation audit is pointed at a "
+            "program with no row writes (harness drift)")
+        return
+    msgs: list[str] = []
+    flow = _IndexTaint(emit=lambda m: msgs.append(m))
+    flow.run(jaxpr)
+    for m in dict.fromkeys(msgs):
+        add(m)

@@ -394,6 +394,94 @@ def cmd_top(args) -> int:
     return 0
 
 
+def cmd_tenant(args) -> int:
+    """`kdt tenant create|list|quota|stats` — the multi-tenant plane's
+    operator surface (Local.Tenant* framework extensions): register a
+    tenant with QoS class / admission budgets / an optional reserved
+    edge block, inspect per-tenant quotas and live stats."""
+    import grpc
+
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+
+    client = DaemonClient(args.daemon)
+
+    def info_dict(t) -> dict:
+        return {
+            "name": t.name, "qos": t.qos,
+            "namespaces": list(t.namespaces),
+            "frame_budget_per_s": t.frame_budget_per_s,
+            "byte_budget_per_s": t.byte_budget_per_s,
+            "block": ([t.block_lo, t.block_hi]
+                      if t.block_lo >= 0 else None),
+            "links": t.links,
+        }
+
+    try:
+        if args.action in ("create", "quota"):
+            spec = pb.TenantSpec(
+                name=args.name, qos=args.qos or "",
+                frame_budget_per_s=args.frames_per_s,
+                byte_budget_per_s=args.bytes_per_s,
+                block_edges=args.block_edges,
+                namespaces=args.namespace or [])
+            rpc = (client.TenantCreate if args.action == "create"
+                   else client.TenantQuota)
+            resp = rpc(spec, timeout=args.timeout)
+            if not resp.ok:
+                print(f"tenant {args.action}: {resp.error}",
+                      file=sys.stderr)
+                return 1
+            print(json.dumps(_json_safe(info_dict(resp.tenant))))
+            return 0
+        if args.action == "list":
+            resp = client.TenantList(pb.TenantQuery(name=args.name
+                                                    or ""),
+                                     timeout=args.timeout)
+            if not resp.ok:
+                print(f"tenant list: {resp.error}", file=sys.stderr)
+                return 1
+            print(json.dumps(_json_safe(
+                {"tenants": [info_dict(t) for t in resp.tenants]})))
+            return 0
+        # stats
+        if not args.name:
+            print("tenant stats needs a tenant name", file=sys.stderr)
+            return 1
+        resp = client.TenantStats(pb.TenantQuery(name=args.name),
+                                  timeout=args.timeout)
+        if not resp.ok:
+            print(f"tenant stats: {resp.error}", file=sys.stderr)
+            return 1
+        none_if = lambda v: None if v < 0 else v  # noqa: E731
+        out = {
+            **info_dict(resp.tenant),
+            "admitted_frames": resp.admitted_frames,
+            "admitted_bytes": resp.admitted_bytes,
+            "throttle_events": resp.throttle_events,
+            "throttled_frame_ticks": resp.throttled_frame_ticks,
+            "tx_packets": resp.tx_packets,
+            "delivered_packets": resp.delivered_packets,
+            "delivered_bytes": resp.delivered_bytes,
+            "dropped_loss": resp.dropped_loss,
+            "dropped_queue": resp.dropped_queue,
+            "dropped_ring": resp.dropped_ring,
+            "window_seconds": resp.window_seconds,
+            "delivered_pps": resp.delivered_pps,
+            "bytes_ps": resp.bytes_ps,
+            "p50_us": none_if(resp.p50_us),
+            "p99_us": none_if(resp.p99_us),
+        }
+        print(json.dumps(_json_safe(out)))
+        return 0
+    except grpc.RpcError as e:
+        print(f"tenant: daemon {args.daemon} RPC failed: "
+              f"{_rpc_code(e)}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+
+
 def cmd_scenario(args) -> int:
     from kubedtn_tpu.scenarios import LADDER
 
@@ -506,6 +594,13 @@ def cmd_daemon(args) -> int:
         daemon.capture.open(args.capture)
         log.info("capture on %s", fields(path=args.capture))
     dataplane = WireDataPlane(daemon)
+    from kubedtn_tpu.tenancy import TenantRegistry
+
+    # multi-tenant serving plane: namespace→tenant mapping, admission
+    # buckets, QoS drain weights, Local.Tenant* RPCs (empty registry =
+    # zero enforcement until `kdt tenant create` tightens quotas)
+    tenancy = TenantRegistry(engine)
+    dataplane.attach_tenancy(tenancy)
     if not getattr(args, "no_telemetry", False):
         # link telemetry plane: per-edge window ring + sampled flight
         # recorder, riding the fused tick (no extra device dispatch)
@@ -566,7 +661,8 @@ def cmd_daemon(args) -> int:
                                    sim_counters_fn=dataplane.counters_fn,
                                    dataplane=dataplane,
                                    whatif_stats=stats_for(daemon),
-                                   update_stats=update_stats_for(daemon))
+                                   update_stats=update_stats_for(daemon),
+                                   tenancy=tenancy)
     engine.stats.observer = hist
     daemon.hist = hist
     server, port = make_server(daemon, port=args.port)
@@ -906,7 +1002,8 @@ def cmd_whatif(args) -> int:
         req = pb.WhatIfRequest(
             ticks=args.ticks, dt_us=args.dt_us,
             traffic_rate_bps=float(rate_bps), seed=args.seed,
-            include_baseline=True)
+            include_baseline=True,
+            tenant=getattr(args, "tenant", "") or "")
         for sc in scenarios:
             msg = req.scenarios.add()
             msg.name = sc.name
@@ -1139,6 +1236,33 @@ def main(argv=None) -> int:
     top.add_argument("--json", action="store_true")
     top.set_defaults(fn=cmd_top)
 
+    tnp = sub.add_parser(
+        "tenant",
+        help="multi-tenant plane: create/list/quota/stats against a "
+             "live daemon (Local.Tenant*)")
+    tnp.add_argument("action",
+                     choices=("create", "list", "quota", "stats"))
+    tnp.add_argument("name", nargs="?", default="")
+    tnp.add_argument("--daemon", default="127.0.0.1:51111",
+                     metavar="HOST:PORT")
+    tnp.add_argument("--qos", default=None,
+                     choices=("gold", "silver", "bronze"),
+                     help="QoS class → drain-budget weight 1/0.5/0.25")
+    tnp.add_argument("--frames-per-s", type=float, default=-1.0,
+                     help="admission frame budget (0 = unlimited; "
+                          "omitted = leave unchanged)")
+    tnp.add_argument("--bytes-per-s", type=float, default=-1.0,
+                     help="admission byte budget (0 = unlimited; "
+                          "omitted = leave unchanged)")
+    tnp.add_argument("--block-edges", type=int, default=0,
+                     help="reserve this many contiguous SoA rows for "
+                          "the tenant (create only)")
+    tnp.add_argument("--namespace", action="append", default=None,
+                     help="bind these namespaces (default: the tenant "
+                          "name itself)")
+    tnp.add_argument("--timeout", type=float, default=30.0)
+    tnp.set_defaults(fn=cmd_tenant)
+
     sp = sub.add_parser("scenario", help="run a BASELINE ladder scenario")
     sp.add_argument("name")
     sp.add_argument("-p", "--param", action="append", metavar="k=v",
@@ -1238,6 +1362,9 @@ def main(argv=None) -> int:
     wp.add_argument("--spec", default=None, metavar="YAML",
                     help="scenario spec file (see `whatif` docs); "
                          "omitted = baseline only")
+    wp.add_argument("--tenant", default=None,
+                    help="tenant-scoped fork: sweep only this "
+                         "tenant's edge slice (daemon mode)")
     wp.add_argument("--ticks", type=int, default=1000)
     wp.add_argument("--dt-us", type=float, default=1000.0)
     wp.add_argument("--rate", default=None,

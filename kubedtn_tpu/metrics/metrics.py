@@ -419,6 +419,120 @@ class LinkTelemetryCollector:
         return out
 
 
+class TenantStatsCollector:
+    """kubedtn_tenant_* per-tenant series from the tenancy registry —
+    the multi-tenant plane's observability face: per-tenant admission
+    meters (admitted frames/bytes, typed throttle verdicts), the
+    tenant's slice of the cumulative counters (tx / delivered /
+    dropped-by-cause / bytes), its telemetry-window delivery rate and
+    p99, QoS level and link count.
+
+    Cardinality guard (the InterfaceStatsCollector truncation-guard
+    pattern): per-tenant series are exported for at most `max_tenants`
+    tenants (name-sorted, so the exported set is stable across
+    scrapes); `kubedtn_tenant_series_truncated` reports how many fell
+    past the cap — a runaway tenant-creation loop degrades to one
+    guard gauge, never an unbounded label explosion."""
+
+    COUNTER_KEYS = (
+        ("admitted_frames", "Frames admitted past the tenant's "
+                            "ingress token bucket"),
+        ("admitted_bytes", "Bytes admitted past the tenant's ingress "
+                           "token bucket"),
+        ("throttle_events", "Typed admission throttle verdicts "
+                            "(wire skipped for a tick, frames kept)"),
+        ("throttled_frame_ticks",
+         "Frame-ticks spent queued behind an admission throttle"),
+        ("tx_packets", "Frames offered by this tenant's links"),
+        ("delivered_packets", "Frames delivered on this tenant's "
+                              "links"),
+        ("delivered_bytes", "Bytes delivered on this tenant's links"),
+        ("dropped_loss", "Tenant frames dropped by netem loss"),
+        ("dropped_queue", "Tenant frames dropped by TBF queue "
+                          "overflow"),
+        ("dropped_ring", "Tenant frames dropped by egress ring "
+                         "overflow"),
+    )
+    GAUGE_KEYS = (
+        ("links", "Realized SoA rows owned by the tenant"),
+        ("qos_level", "QoS class (0=gold, 1=silver, 2=bronze)"),
+        ("frame_budget_per_s", "Admission frame budget (0=unlimited)"),
+        ("byte_budget_per_s", "Admission byte budget (0=unlimited)"),
+        ("delivered_pps", "Delivered frames/s over the telemetry "
+                          "window span"),
+        ("p99_us", "p99 shaping latency (µs) over the telemetry "
+                   "window span"),
+    )
+
+    def __init__(self, tenancy, dataplane=None,
+                 max_tenants: int = 256) -> None:
+        self._tenancy = tenancy
+        self._plane = dataplane
+        self._max_tenants = max_tenants
+
+    def collect(self):
+        from kubedtn_tpu.tenancy.registry import QOS_LEVELS
+
+        reg = self._tenancy
+        out = []
+        # ONE ring reduction per scrape, sliced per tenant below —
+        # not one full window_sum per tenant
+        tel = (getattr(self._plane, "telemetry", None)
+               if self._plane is not None else None)
+        win_sum = tel.window_sum() if tel is not None else None
+        tenants = sorted(reg.list(), key=lambda t: t.name)
+        truncated = max(0, len(tenants) - self._max_tenants)
+        shown = tenants[:self._max_tenants]
+        fams = {}
+        for key, doc in self.COUNTER_KEYS:
+            fams[key] = CounterMetricFamily(f"kubedtn_tenant_{key}",
+                                            doc, labels=["tenant"])
+        for key, doc in self.GAUGE_KEYS:
+            fams[key] = GaugeMetricFamily(f"kubedtn_tenant_{key}",
+                                          doc, labels=["tenant"])
+        for t in shown:
+            lab = [t.name]
+            adm = reg.admission.stats_for(t.name)
+            vals = {
+                "admitted_frames": t.admitted_frames,
+                "admitted_bytes": t.admitted_bytes,
+                "throttle_events": adm["throttle_events"],
+                "throttled_frame_ticks": adm["throttled_frame_ticks"],
+                "links": 0.0,
+                "qos_level": QOS_LEVELS.get(t.qos, -1),
+                "frame_budget_per_s": t.frame_budget_per_s,
+                "byte_budget_per_s": t.byte_budget_per_s,
+            }
+            if self._plane is not None:
+                c = reg.tenant_counters(self._plane, t.name)
+                vals.update({
+                    "links": c["links"],
+                    "tx_packets": c["tx_packets"],
+                    "delivered_packets": c["delivered_packets"],
+                    "delivered_bytes": c["delivered_bytes"],
+                    "dropped_loss": c["dropped_loss"],
+                    "dropped_queue": c["dropped_queue"],
+                    "dropped_ring": c["dropped_ring"],
+                })
+                win = reg.tenant_window(self._plane, t.name,
+                                        window=win_sum)
+                if win:
+                    vals["delivered_pps"] = win["delivered_pps"]
+                    if win["p99_us"] is not None:
+                        vals["p99_us"] = win["p99_us"]
+            for key, fam in fams.items():
+                if key in vals:
+                    fam.add_metric(lab, float(vals[key]))
+        out.extend(fams.values())
+        trunc = GaugeMetricFamily(
+            "kubedtn_tenant_series_truncated",
+            "Tenants beyond the per-tenant series cap "
+            "(0 = full per-tenant coverage)")
+        trunc.add_metric([], float(truncated))
+        out.append(trunc)
+        return out
+
+
 class WhatIfStatsCollector:
     """kubedtn_whatif_* counters — observability for daemon-served
     what-if sweeps (kubedtn_tpu.twin.query): volume served (sweeps,
@@ -544,7 +658,8 @@ class MetricsServer:
 
 def make_registry(engine=None, sim_counters_fn=None,
                   max_interfaces: int = 10_000, dataplane=None,
-                  whatif_stats=None, update_stats=None):
+                  whatif_stats=None, update_stats=None, tenancy=None,
+                  max_tenants: int = 256):
     """Registry with the parity collectors installed."""
     registry = CollectorRegistry()
     hist = LatencyHistograms(registry)
@@ -560,4 +675,7 @@ def make_registry(engine=None, sim_counters_fn=None,
         registry.register(WhatIfStatsCollector(whatif_stats))
     if update_stats is not None:
         registry.register(UpdateStatsCollector(update_stats))
+    if tenancy is not None:
+        registry.register(TenantStatsCollector(
+            tenancy, dataplane, max_tenants=max_tenants))
     return registry, hist

@@ -431,16 +431,48 @@ def _iid_random_rows(props):
 # mailbox exchange, run the identical core on every shard, and scatter
 # each shard's owned rows locally while staying bit-equal to the
 # unsharded plane.
+#
+# KEYING (multi-tenant byte-identity, round 10): with `key_ids` given,
+# every row draws its uniforms from fold_in(key, key_ids[r]) — a stable
+# per-row identity the host derives from (pod_key, uid), NOT from the
+# row's position in this tick's batch. A row's random stream then
+# depends only on (tick key, kernel class, link identity, slot index),
+# never on which OTHER rows happen to share the batch or how the batch
+# is padded — which is exactly what pins a tenant's delivered bytes in
+# a cohabited plane byte-identical to a solo plane running only that
+# tenant's topology (tests/test_tenant_isolation.py). With key_ids=None
+# the historical batch-position draws are preserved bit-for-bit (the
+# direct-kernel tests and embedders keep their streams).
 
 
-def shape_rows_indep(props_rows, active_rows, sizes, valid, key):
+def row_keys(key, key_ids):
+    """Per-row PRNG keys: fold each row's stable 32-bit key id into the
+    class key. key_ids[r] must not depend on batch composition — the
+    engine derives it from the link's (pod_key, uid) identity."""
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(key_ids)
+
+
+def _uniform_rows(key, key_ids, R: int, K: int):
+    """[R, K, NU] uniforms: batch-position stream when key_ids is None
+    (historical), per-row `row_keys` streams otherwise."""
+    if key_ids is None:
+        return jax.random.uniform(key, (R, K, NU), dtype=jnp.float32)
+
+    def draw_row(k):
+        return jax.random.uniform(k, (K, NU), dtype=jnp.float32)
+
+    return jax.vmap(draw_row)(row_keys(key, key_ids))
+
+
+def shape_rows_indep(props_rows, active_rows, sizes, valid, key,
+                     key_ids=None):
     """Slot-independent class core over pre-gathered rows: returns
     (ShapeResult[R, K], delta_count int32[R]) — the per-row pkt_count
     increments the caller scatter-adds (the only state this class
     advances). Gathered tokens/t_last/backlog/corr are NOT needed: the
     class predicate guarantees they are never read."""
     R, K = sizes.shape
-    u = jax.random.uniform(key, (R, K, NU), dtype=jnp.float32)
+    u = _uniform_rows(key, key_ids, R, K)
     t_arr = jnp.zeros((R,), jnp.float32)
     zeros = jnp.zeros((R,), jnp.float32)
     zcorr = jnp.zeros((R, NCORR), jnp.float32)
@@ -467,13 +499,17 @@ def shape_rows_indep(props_rows, active_rows, sizes, valid, key):
     return res, delta
 
 
-def shape_rows_seq(props_rows, active_rows, carry0, sizes, valid, key):
+def shape_rows_seq(props_rows, active_rows, carry0, sizes, valid, key,
+                   key_ids=None):
     """Sequential (correlated / reorder / general-TBF) class core over
     pre-gathered rows. `carry0` = (tokens[R], t_last[R], backlog[R],
     corr[R, NCORR], pkt_count[R]). Returns (carry', ShapeResult[R, K])
     — the caller scatters carry' back at the batch rows."""
     R, K = sizes.shape
-    u_all = jax.random.uniform(key, (K, R, NU), dtype=jnp.float32)
+    if key_ids is None:
+        u_all = jax.random.uniform(key, (K, R, NU), dtype=jnp.float32)
+    else:
+        u_all = jnp.moveaxis(_uniform_rows(key, key_ids, R, K), 0, 1)
     t_arr = jnp.zeros((R,), jnp.float32)
     active = active_rows
 
@@ -506,14 +542,19 @@ def shape_rows_seq(props_rows, active_rows, carry0, sizes, valid, key):
 
 def shape_rows_tbf(props_rows, active_rows, corr_rows, cnt_rows,
                    tokens_rows, t_last_rows, backlog_rows,
-                   sizes, valid, key):
+                   sizes, valid, key, key_ids=None):
     """Exact max-plus TBF class core over pre-gathered rows (the full
     derivation lives on shape_slots_tbf_nodonate). Returns
     (res ShapeResult[R, K], tok_row f32[R], dep_row f32[R],
     delta_count i32[R], has_accept bool[R], fallback bool[R])."""
     R, K = sizes.shape
-    u = jnp.moveaxis(
-        jax.random.uniform(key, (K, R, NU), dtype=jnp.float32), 0, 1)
+    if key_ids is None:
+        u = jnp.moveaxis(
+            jax.random.uniform(key, (K, R, NU), dtype=jnp.float32), 0, 1)
+    else:
+        # same per-(row, slot) stream as shape_rows_seq's keyed draw —
+        # the tbf ≡ exact-scan parity holds in keyed mode too
+        u = _uniform_rows(key, key_ids, R, K)
     props = props_rows
     active = active_rows
     over_slots = jax.vmap(netem_packet, in_axes=(None, None, None, 0))
@@ -583,7 +624,7 @@ _shape_slots_ind = None
 
 def shape_slots_indep_nodonate(state: EdgeState, row_idx: jax.Array,
                                sizes: jax.Array, valid: jax.Array,
-                               key: jax.Array):
+                               key: jax.Array, key_ids=None):
     """Shape K slots on R gathered rows in ONE elementwise kernel — valid
     only for rows that satisfy slot_independent_rows (callers route
     others to shape_slots_nodonate). Every slot sees the row's CURRENT
@@ -601,16 +642,16 @@ def shape_slots_indep_nodonate(state: EdgeState, row_idx: jax.Array,
     """
     global _shape_slots_ind
     if _shape_slots_ind is None:
-        def _ind(state, row_idx, sizes, valid, key):
+        def _ind(state, row_idx, sizes, valid, key, key_ids):
             res, delta = shape_rows_indep(
                 state.props[row_idx], state.active[row_idx],
-                sizes, valid, key)
+                sizes, valid, key, key_ids)
             new_count = state.pkt_count.at[row_idx].add(
                 delta.astype(state.pkt_count.dtype), mode="drop")
             return res, new_count
 
         _shape_slots_ind = jax.jit(_ind)
-    return _shape_slots_ind(state, row_idx, sizes, valid, key)
+    return _shape_slots_ind(state, row_idx, sizes, valid, key, key_ids)
 
 
 def tbf_batch_rows(props):
@@ -636,7 +677,7 @@ _MP_NEG = -1e30
 
 def shape_slots_tbf_nodonate(state: EdgeState, row_idx: jax.Array,
                              sizes: jax.Array, valid: jax.Array,
-                             key: jax.Array):
+                             key: jax.Array, key_ids=None):
     """Shape K slots on R gathered TBF rows in ONE dispatch with an
     EXACT token bucket — no sequential scan, no per-tick slot cap.
 
@@ -681,7 +722,7 @@ def shape_slots_tbf_nodonate(state: EdgeState, row_idx: jax.Array,
     """
     global _shape_slots_tbf
     if _shape_slots_tbf is None:
-        def _tbf(state, row_idx, sizes, valid, key):
+        def _tbf(state, row_idx, sizes, valid, key, key_ids):
             # the core draws [K, R, NU] then transposes: the SAME stream
             # shape_slots_nodonate draws for a given (key, R, K), which
             # is what the parity tests compare against. (The runtime's
@@ -691,14 +732,15 @@ def shape_slots_tbf_nodonate(state: EdgeState, row_idx: jax.Array,
                 state.props[row_idx], state.active[row_idx],
                 state.corr[row_idx], state.pkt_count[row_idx],
                 state.tokens[row_idx], state.t_last[row_idx],
-                state.backlog_until[row_idx], sizes, valid, key)
+                state.backlog_until[row_idx], sizes, valid, key,
+                key_ids)
             res, tok_row, dep_row, delta, has_accept, fallback = out
             return (res, tok_row, dep_row,
                     delta.astype(state.pkt_count.dtype), has_accept,
                     fallback)
 
         _shape_slots_tbf = jax.jit(_tbf)
-    return _shape_slots_tbf(state, row_idx, sizes, valid, key)
+    return _shape_slots_tbf(state, row_idx, sizes, valid, key, key_ids)
 
 
 _shape_slots_nd = None
@@ -706,7 +748,7 @@ _shape_slots_nd = None
 
 def shape_slots_nodonate(state: EdgeState, row_idx: jax.Array,
                          sizes: jax.Array, valid: jax.Array,
-                         key: jax.Array):
+                         key: jax.Array, key_ids=None):
     """Shape K packet slots on R gathered rows in ONE device dispatch,
     preserving per-row sequentiality — the slow-but-exact path for rows
     with cross-slot state (TBF token bucket, AR(1) correlations, gap
@@ -734,13 +776,13 @@ def shape_slots_nodonate(state: EdgeState, row_idx: jax.Array,
     """
     global _shape_slots_nd
     if _shape_slots_nd is None:
-        def _slots(state, row_idx, sizes, valid, key):
+        def _slots(state, row_idx, sizes, valid, key, key_ids):
             carry0 = (state.tokens[row_idx], state.t_last[row_idx],
                       state.backlog_until[row_idx], state.corr[row_idx],
                       state.pkt_count[row_idx])
             (tk, tl, nf, corr, cnt), res = shape_rows_seq(
                 state.props[row_idx], state.active[row_idx], carry0,
-                sizes, valid, key)
+                sizes, valid, key, key_ids)
             new_state = dataclasses.replace(
                 state,
                 tokens=state.tokens.at[row_idx].set(tk, mode="drop"),
@@ -753,7 +795,7 @@ def shape_slots_nodonate(state: EdgeState, row_idx: jax.Array,
             return new_state, res
 
         _shape_slots_nd = jax.jit(_slots)
-    return _shape_slots_nd(state, row_idx, sizes, valid, key)
+    return _shape_slots_nd(state, row_idx, sizes, valid, key, key_ids)
 
 
 @partial(jax.jit, donate_argnums=0, static_argnums=2)

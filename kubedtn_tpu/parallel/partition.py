@@ -23,7 +23,7 @@ import numpy as np
 import numpy.typing as npt
 
 __all__ = ["shard_ranges", "shard_of_rows", "colocation_stats",
-           "mailbox_layout", "pick_pair_rows"]
+           "mailbox_layout", "pick_pair_rows", "tenant_block"]
 
 
 def shard_ranges(capacity: int, n_shards: int) -> list[tuple[int, int]]:
@@ -68,6 +68,58 @@ def pick_pair_rows(free: list[int], capacity: int, n_shards: int,
         if free[i] // loc == blk:
             return r1, free.pop(i)
     return r1, free.pop()
+
+
+def tenant_block(free: list[int], capacity: int, n_shards: int,
+                 n_rows: int) -> tuple[int, int] | None:
+    """Carve a CONTIGUOUS run of `n_rows` currently-free rows out of the
+    engine's free list for one tenant's reserved edge block.
+
+    Composition with shard blocks: a candidate run that fits entirely
+    inside one shard's [s*E/S, (s+1)*E/S) range is preferred — a tenant
+    whose block sits inside one shard never pays the cross-shard
+    mailbox for intra-tenant hops — falling back to a boundary-spanning
+    run (still contiguous, still isolated) only when no shard-local run
+    is free. Returns [lo, hi) with the rows removed from `free`, or
+    None when no contiguous run of that length exists (the caller then
+    leaves the tenant on the shared pool)."""
+    if n_rows <= 0:
+        return None
+    rows = np.sort(np.asarray(free, np.int64))
+    if rows.size < n_rows:
+        return None
+    loc = (capacity // n_shards
+           if n_shards > 1 and capacity % n_shards == 0 else capacity)
+    # run starts: positions where a fresh contiguous run begins
+    breaks = np.nonzero(np.diff(rows) != 1)[0] + 1
+    starts = [0, *breaks.tolist(), rows.size]
+    local: tuple[int, int] | None = None
+    spanning: tuple[int, int] | None = None
+    for g in range(len(starts) - 1):
+        a, b = starts[g], starts[g + 1]
+        lo, hi = int(rows[a]), int(rows[b - 1]) + 1
+        if hi - lo < n_rows:
+            continue
+        if spanning is None:
+            spanning = (lo, lo + n_rows)
+        # the earliest window inside the run that does not straddle a
+        # shard-block boundary wins — computed directly: `lo` itself,
+        # or the next boundary when lo's window would cross it (no
+        # position in between can avoid the crossing); impossible
+        # outright when the window outsizes a shard block
+        if n_rows <= loc:
+            w_lo = (lo if lo // loc == (lo + n_rows - 1) // loc
+                    else (lo // loc + 1) * loc)
+            if w_lo + n_rows <= hi:
+                local = (w_lo, w_lo + n_rows)
+                break
+    best = local if local is not None else spanning
+    if best is None:
+        return None
+    lo, hi = best
+    taken = set(range(lo, hi))
+    free[:] = [r for r in free if r not in taken]
+    return lo, hi
 
 
 def colocation_stats(engine: Any, n_shards: int) -> dict[str, object]:

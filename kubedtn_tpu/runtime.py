@@ -758,16 +758,21 @@ def _shape_class(state, kind: str, args, sub):
     SINGLE source of truth traced by both `_fused_tick` (all classes in
     one dispatch) and `_class_tick` (the degradation ladder's un-fused
     per-class dispatches): the two paths stay byte-identical by
-    construction, not by hand-synchronized copies. Returns
+    construction, not by hand-synchronized copies. `args` is the
+    (row_idx, sizes, valid, key_ids) quadruple `_build_group` packs —
+    key_ids are the per-row identity fold_in constants that make each
+    row's uniforms independent of batch composition (the multi-tenant
+    byte-identity mechanism; ops/netem module docstring). Returns
     (state', out, res) with out = (delivered [R,K], depart_us [R,K],
     loss [R], queue [R], corrupt [R] [, fallback [R] for tbf]) and
     `res` the full ShapeResult (the telemetry reduction's feed; dead
     code when telemetry is off)."""
-    rows, sizes, valid = args
+    rows, sizes, valid, kids = args
     if kind == "tbf":
         res, tok_row, dep_row, delta, hacc, fbk = \
             netem.shape_slots_tbf_nodonate(state, rows, sizes, valid,
-                                           jax.random.fold_in(sub, 2))
+                                           jax.random.fold_in(sub, 2),
+                                           kids)
         # accepted, non-fallback rows advance their bucket state right
         # here on device (the old tick's host-side pick/scatter);
         # fallback rows stay untouched — the exact-scan re-shape reads
@@ -788,11 +793,12 @@ def _shape_class(state, kind: str, args, sub):
                        fbk), res
     if kind == "seq":
         state, res = netem.shape_slots_nodonate(
-            state, rows, sizes, valid, jax.random.fold_in(sub, 0))
+            state, rows, sizes, valid, jax.random.fold_in(sub, 0),
+            kids)
         return state, (res.delivered, res.depart_us,
                        *_row_counts(res)), res
     res, new_count = netem.shape_slots_indep_nodonate(
-        state, rows, sizes, valid, jax.random.fold_in(sub, 1))
+        state, rows, sizes, valid, jax.random.fold_in(sub, 1), kids)
     state = dataclasses.replace(state, pkt_count=new_count)
     return state, (res.delivered, res.depart_us, *_row_counts(res)), res
 
@@ -812,7 +818,7 @@ def _tel_class(tel, kind: str, args, out, res):
     link fail differently); shipping a per-slot [R, K] cause plane
     measured ~3% of the whole tick at the probe shapes, for labels
     only the 1/256 sampled frames would ever read."""
-    rows, sizes, valid = args
+    rows, sizes, valid = args[0], args[1], args[2]
     if kind == "tbf":
         fbk = out[5]
         rows = jnp.where(fbk, jnp.int32(tel.shape[0]), rows)
@@ -829,8 +835,8 @@ def _fused_tick(state, dyn, key, elapsed_us, seq_args, tbf_args,
     split, epoch roll, the three shaping-kernel classes (each over its
     gathered [R, K] batch), the TBF accepted-row state write-back, and
     the per-row counter reductions. `*_args` are (row_idx, sizes,
-    valid) triples or None; the static has_* flags pick the traced
-    branches (one executable per class mix, cached). `dyn` (when
+    valid, key_ids) quadruples or None; the static has_* flags pick the
+    traced branches (one executable per class mix, cached). `dyn` (when
     has_dyn) overrides the dynamic columns with the previous in-flight
     tick's chained outputs — possibly still computing; XLA sequences
     the dependency without a host sync. `tel` (when has_tel) is the
@@ -932,7 +938,7 @@ def _make_sharded_fused(mesh):
         owned rows' write-back locally. Returns (work', out, res) with
         `out` exactly `_shape_class`'s transfer set."""
         props_l, act_l, tok_l, tl_l, nf_l, corr_l, cnt_l = work
-        rows, sizes, valid = args
+        rows, sizes, valid, kids = args
         rows = rows.astype(jnp.int32)
         E_loc = tok_l.shape[0]
         # padding rows carry index E: clamp for the GATHER (the
@@ -963,7 +969,7 @@ def _make_sharded_fused(mesh):
             res, tok_row, dep_row, delta, hacc, fbk = \
                 netem.shape_rows_tbf(props_r, act_r, corr_r, cnt_r,
                                      tok_r, tl_r, nf_r, sizes, valid,
-                                     keyc)
+                                     keyc, kids)
             apply = hacc & ~fbk
             tok_l = tok_l.at[tgt].set(
                 jnp.where(apply, tok_row, tok_l[li]), mode="drop")
@@ -978,7 +984,7 @@ def _make_sharded_fused(mesh):
         elif kind == "seq":
             carry0 = (tok_r, tl_r, nf_r, corr_r, cnt_r)
             (tk, tl, nf, co, cn), res = netem.shape_rows_seq(
-                props_r, act_r, carry0, sizes, valid, keyc)
+                props_r, act_r, carry0, sizes, valid, keyc, kids)
             tok_l = tok_l.at[tgt].set(tk, mode="drop")
             tl_l = tl_l.at[tgt].set(tl, mode="drop")
             nf_l = nf_l.at[tgt].set(nf, mode="drop")
@@ -988,7 +994,7 @@ def _make_sharded_fused(mesh):
             out = (res.delivered, res.depart_us, *_row_counts(res))
         else:
             res, delta = netem.shape_rows_indep(props_r, act_r, sizes,
-                                                valid, keyc)
+                                                valid, keyc, kids)
             cnt_l = cnt_l.at[tgt].add(delta.astype(cnt_l.dtype),
                                       mode="drop")
             out = (res.delivered, res.depart_us, *_row_counts(res))
@@ -1000,7 +1006,7 @@ def _make_sharded_fused(mesh):
         computed replicated (tele.tel_matrix), each shard scatter-adds
         only its owned rows — the adds landing on a logical row are
         bit-identical to the unsharded accumulate."""
-        rows, sizes, valid = args
+        rows, sizes, valid = args[0], args[1], args[2]
         rows = rows.astype(jnp.int32)
         if kind == "tbf":
             fbk = out[5]
@@ -1055,7 +1061,9 @@ def _make_sharded_fused(mesh):
                 return dyn_out, tuple(outs), tel_l
             return dyn_out, tuple(outs)
 
-        arg_spec = (rep, rep, rep)
+        # (row_idx, sizes, valid, key_ids) — all replicated, so every
+        # shard draws the identical per-row-keyed uniforms
+        arg_spec = (rep, rep, rep, rep)
         in_specs = [(edge,) * 7, rep, rep]
         out_specs = [(edge,) * 5,
                      tuple(tuple([rep] * (6 if k == "tbf" else 5))
@@ -1158,22 +1166,28 @@ def _pad_slots(n: int) -> int:
     return p
 
 
-def _build_group(batches, group, E: int):
+def _build_group(batches, group, E: int, keyid_map):
     """Padded [R, K] batch arrays for one kernel class; row_idx pads
-    with E (gathers clamp harmlessly, write-back scatters drop)."""
+    with E (gathers clamp harmlessly, write-back scatters drop).
+    key_ids carries each row's stable identity fold_in constant
+    (engine.link_key_id via `keyid_map`; 0 on padding rows) — the
+    per-row keying that decouples a row's random stream from batch
+    composition (multi-tenant byte-identity)."""
     R = len(group)
     K = max(len(batches[i][2]) for i in group)
     Rp, Kp = _pad_rows(R), _pad_slots(K)
     row_idx = np.full(Rp, E, np.int32)
     sizes = np.zeros((Rp, Kp), np.float32)
     valid = np.zeros((Rp, Kp), bool)
+    key_ids = np.zeros(Rp, np.int32)
     for r, i in enumerate(group):
         _w, row, lens, _fr, _pd = batches[i]
         m = len(lens)
         row_idx[r] = row
         sizes[r, :m] = lens
         valid[r, :m] = True
-    return row_idx, sizes, valid
+        key_ids[r] = keyid_map.get(row, 0)
+    return row_idx, sizes, valid, key_ids
 
 
 class _ShapeJob:
@@ -1388,6 +1402,11 @@ class WireDataPlane:
         # optional ChaosInjector (tests / bench chaos soak); consulted
         # at the head of every dispatch when set
         self.chaos = None
+        # -- multi-tenant serving plane (round 10) ---------------------
+        # optional tenancy.TenantRegistry (attach_tenancy): per-tenant
+        # admission buckets + QoS drain weights apply at the drain
+        # stage; throttled tenants' wires stay queued, never dropped
+        self.tenancy = None
         # dispatch-failure requeue bookkeeping: what the in-progress
         # dispatch holds and whether its frames passed the decide stage
         # (single tick thread under _tick_lock)
@@ -1889,12 +1908,21 @@ class WireDataPlane:
         pipelined = depth > 1 and (
             not explicit or self.pipeline_explicit_clock)
         budget = self.max_slots if explicit else self._drain_budget
+        # tenancy: QoS drain weights + admission throttling resolve to
+        # ONE per-wire budget callable (0 = tenant over budget, wire
+        # skipped this tick with a typed verdict, frames kept queued)
+        admit = (self.tenancy.drain_policy(budget, now_s)
+                 if self.tenancy is not None else None)
         t0 = time.perf_counter()
         drained = self.daemon.drain_ingress(max_per_wire=budget,
                                             skip=self._holdback.keys()
-                                            if self._holdback else None)
+                                            if self._holdback else None,
+                                            admit=admit)
         t1 = time.perf_counter()
         stage["drain"] += t1 - t0
+        if drained and self.tenancy is not None:
+            # batch-granular debit: what was drained was admitted
+            self.tenancy.charge_drained(drained, now_s)
         if not explicit:
             self._adapt_budget()
         dispatched = False
@@ -1999,6 +2027,16 @@ class WireDataPlane:
         the per-peer egress RPCs and the dispatch hook."""
         self.chaos = injector
         injector.install_peer_faults(self.daemon)
+
+    def attach_tenancy(self, registry) -> None:
+        """Wire a tenancy.TenantRegistry into this plane: admission
+        buckets + QoS drain weights enforce at every tick's drain, and
+        the daemon's Local.Tenant* RPC surface answers from it. The
+        registry already steers the engine's row allocator (it attached
+        itself at construction)."""
+        self.tenancy = registry
+        registry.plane = self
+        self.daemon.tenancy = registry
 
     def force_degrade(self, level: int) -> None:
         """Step the degradation ladder to `level` (0 = full pipeline,
@@ -2213,10 +2251,14 @@ class WireDataPlane:
             # veth, grpcwire.go:256-271); _row_owner is maintained
             # incrementally, so this is O(batch), not O(rows)
             rowinfo: dict[int, tuple[str, int] | None] = {}
+            # per-row identity fold_in constants for the keyed uniform
+            # draws (engine.link_key_id; 0 for a row the registry lost)
+            keyid_map: dict[int, int] = {}
             for _w, row, _lens, _fr, _pd in batches:
                 key = engine._row_owner.get(row)
                 rowinfo[row] = (engine._peer.get(key, key)
                                 if key is not None else None)
+                keyid_map[row] = engine._row_keyid.get(row, 0)
             shaped_rows = set(engine._shaped_rows)
             dstrow: dict[int, int] = {}
             if self._shard_mesh is not None:
@@ -2544,7 +2586,7 @@ class WireDataPlane:
         for kind, group in (("seq", seq_group), ("tbf", tbf_group),
                             ("ind", ind_group)):
             if group:
-                args[kind] = _build_group(batches, group, E)
+                args[kind] = _build_group(batches, group, E, keyid_map)
         if self._shard_mesh is not None and args:
             # every padded batch row rides the mailbox once per ring
             # step: the per-step block size is the tick's padded row
@@ -2634,9 +2676,9 @@ class WireDataPlane:
         for kind, group in (("tbf", tbf_group), ("seq", seq_group),
                             ("ind", ind_group)):
             if group:
-                row_idx, sizes, valid = args[kind]
+                row_idx, sizes, valid, key_ids = args[kind]
                 job.groups.append((kind, group, row_idx, sizes, valid,
-                                   outs[kind]))
+                                   key_ids, outs[kind]))
         self.stage_s["kernel"] += time.perf_counter() - t_kernel0
         return job
 
@@ -2654,9 +2696,10 @@ class WireDataPlane:
         rowinfo = job.rowinfo
         t_sync0 = time.perf_counter()
         np_groups = []
-        for kind, group, row_idx, sizes, valid, outs in job.groups:
+        for kind, group, row_idx, sizes, valid, key_ids, outs \
+                in job.groups:
             np_groups.append((kind, group, row_idx, sizes, valid,
-                              [np.asarray(a) for a in outs]))
+                              key_ids, [np.asarray(a) for a in outs]))
         self.stage_s["sync"] += time.perf_counter() - t_sync0
 
         # -- TBF fallback --------------------------------------------
@@ -2669,7 +2712,7 @@ class WireDataPlane:
         # dynamics override dyn_after at write-back below.
         corrected = None
         for g in np_groups:
-            kind, group, row_idx, sizes, valid, arrs = g
+            kind, group, row_idx, sizes, valid, key_ids, arrs = g
             if kind != "tbf":
                 continue
             fbk_dev = arrs[5][:len(group)].astype(bool)
@@ -2693,9 +2736,11 @@ class WireDataPlane:
             fb_rows = np.full(Rp, E, np.int32)
             fb_sizes = np.zeros((Rp, Kp), np.float32)
             fb_valid = np.zeros((Rp, Kp), bool)
+            fb_kids = np.zeros(Rp, np.int32)
             fb_rows[:len(sel)] = row_idx[sel]
             fb_sizes[:len(sel)] = sizes[sel]
             fb_valid[:len(sel)] = valid[sel]
+            fb_kids[:len(sel)] = key_ids[sel]
             base = (job.state if job.dyn_before is None
                     else _with_dyn(job.state, job.dyn_before))
             if forced:
@@ -2720,7 +2765,8 @@ class WireDataPlane:
                                                      jnp.float32(el))
             new_state, res = netem.shape_slots_nodonate(
                 base, jnp.asarray(fb_rows), jnp.asarray(fb_sizes),
-                jnp.asarray(fb_valid), jax.random.fold_in(job.sub, 3))
+                jnp.asarray(fb_valid), jax.random.fold_in(job.sub, 3),
+                jnp.asarray(fb_kids))
             fbouts = [np.asarray(a) for a in _res_to_outs(res)]
             if job.has_tel and self.telemetry is not None:
                 # window-ring correction for the re-shaped rows: the
@@ -2820,7 +2866,8 @@ class WireDataPlane:
         now_s = job.now_s
         pending = self._pending
         rec = self.recorder
-        for kind, group, row_idx, sizes, valid, arrs in np_groups:
+        for kind, group, row_idx, sizes, valid, _kids, arrs \
+                in np_groups:
             deliv = arrs[0]
             depart = arrs[1]
             for r, i in enumerate(group):

@@ -1956,6 +1956,290 @@ def sharded_soak(pairs: int = 48, frames_per_wire: int = 6_000,
     }
 
 
+def _tenant_plane_setup(tenants: dict, latency: str, dt_us: float,
+                        prefix: str, server: bool = False):
+    """Multi-tenant plane harness: one namespace per tenant in
+    `tenants` ({name: {"pairs": N, "qos": ..., "frame_budget_per_s":
+    ..., "block_edges": ...}}), a TenantRegistry attached to engine +
+    plane, telemetry on. Returns (daemon, server_or_None, port, plane,
+    registry, {tenant: (wires_in, wires_out)})."""
+    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.tenancy import TenantRegistry
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon, make_server
+
+    total_pairs = sum(t["pairs"] for t in tenants.values())
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=4 * total_pairs + 8)
+    registry = TenantRegistry(engine)
+    for name, cfg in tenants.items():
+        registry.create(
+            name, qos=cfg.get("qos"),
+            frame_budget_per_s=cfg.get("frame_budget_per_s", 0.0),
+            byte_budget_per_s=cfg.get("byte_budget_per_s", 0.0),
+            block_edges=cfg.get("block_edges", 0))
+    props = LinkProperties(latency=latency)
+    uid = 0
+    for ns, cfg in tenants.items():
+        for i in range(cfg["pairs"]):
+            uid += 1
+            a, b = f"{prefix}-{ns}-a{i}", f"{prefix}-{ns}-b{i}"
+            store.create(Topology(name=a, namespace=ns,
+                                  spec=TopologySpec(links=[
+                Link(local_intf="eth1", peer_intf="eth1", peer_pod=b,
+                     uid=uid, properties=props)])))
+            store.create(Topology(name=b, namespace=ns,
+                                  spec=TopologySpec(links=[
+                Link(local_intf="eth1", peer_intf="eth1", peer_pod=a,
+                     uid=uid, properties=props)])))
+            engine.setup_pod(a, ns)
+            engine.setup_pod(b, ns)
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    srv = port = None
+    if server:
+        srv, port = make_server(daemon, port=0, host="127.0.0.1",
+                                log_rpcs=False)
+        srv.start()
+    plane = WireDataPlane(daemon, dt_us=dt_us)
+    plane.pipeline_explicit_clock = True
+    plane.attach_tenancy(registry)
+    plane.enable_telemetry(window_s=0.5, sample_period=256)
+    wires: dict = {}
+    uid = 0
+    for ns, cfg in tenants.items():
+        win, wout = [], []
+        for i in range(cfg["pairs"]):
+            uid += 1
+            win.append(daemon._add_wire(pb.WireDef(
+                local_pod_name=f"{prefix}-{ns}-a{i}", kube_ns=ns,
+                link_uid=uid, intf_name_in_pod="eth1")))
+            wout.append(daemon._add_wire(pb.WireDef(
+                local_pod_name=f"{prefix}-{ns}-b{i}", kube_ns=ns,
+                link_uid=uid, intf_name_in_pod="eth1")))
+        wires[ns] = (win, wout)
+    return daemon, srv, port, plane, registry, wires
+
+
+def noisy_neighbor(victim_pairs: int = 2, aggressor_pairs: int = 2,
+                   seconds: float = 4.0, dt_us: float = 2_000.0,
+                   victim_rate_fps: int = 2_000,
+                   aggressor_rate_fps: int = 20_000,
+                   aggressor_budget_fps: int = 2_000,
+                   latency: str = "2ms"):
+    """Noisy-neighbor CHAOS scenario: a gold victim and a bronze
+    aggressor share one plane; the aggressor offers ~10× its admission
+    frame budget while the victim offers a modest steady load. The
+    contract under attack: the aggressor is throttled AT ITS BUDGET by
+    the per-tenant token bucket (typed verdicts, frames queued — never
+    dropped), and the victim sees ZERO frame loss with its shaping
+    latency inside guardrails. Deterministic: explicit-clock ticks +
+    clock-driven buckets, so a given parameterization replays exactly.
+    The tier-1 smoke (tests/test_tenancy.py) runs this with small
+    parameters in <30s; the full LADDER entry is the bench shape."""
+    t_wall = time.perf_counter()
+    cfg = {
+        "victim": {"pairs": victim_pairs, "qos": "gold"},
+        "aggressor": {"pairs": aggressor_pairs, "qos": "bronze",
+                      "frame_budget_per_s": float(aggressor_budget_fps)},
+    }
+    daemon, _srv, _port, plane, registry, wires = _tenant_plane_setup(
+        cfg, latency, dt_us, "nn")
+    vin, vout = wires["victim"]
+    ain, aout = wires["aggressor"]
+    dt = dt_us / 1e6
+    t = 100.0
+    fed = {"victim": 0, "aggressor": 0}
+    got = {"victim": 0, "aggressor": 0}
+    acc = {"victim": 0.0, "aggressor": 0.0}
+    ticks = int(seconds / dt)
+    frame = _FAULT_FRAME
+    for _ in range(ticks):
+        for ns, win, rate in (("victim", vin, victim_rate_fps),
+                              ("aggressor", ain, aggressor_rate_fps)):
+            acc[ns] += rate * dt / len(win)
+            n = int(acc[ns])
+            if n:
+                acc[ns] -= n
+                for w in win:
+                    w.ingress.extend([frame] * n)
+                fed[ns] += n * len(win)
+        t += dt
+        plane.tick(now_s=t)
+        got["victim"] += _drain_wires(vout)
+        got["aggressor"] += _drain_wires(aout)
+    # drain the tail: the victim's in-flight frames must all land
+    # (zero-loss guardrail); the aggressor's QUEUED backlog stays
+    # queued — admission throttling holds while its bucket is in debt
+    for _ in range(int(0.2 / dt) + 8):
+        t += dt
+        plane.tick(now_s=t)
+        got["victim"] += _drain_wires(vout)
+        got["aggressor"] += _drain_wires(aout)
+    plane.flush()
+    got["victim"] += _drain_wires(vout)
+    got["aggressor"] += _drain_wires(aout)
+    a_stats = registry.stats(plane, "aggressor")
+    v_stats = registry.stats(plane, "victim")
+    queued = sum(len(w.ingress) for w in ain)
+    # budget guardrail: admitted ≤ burst (1s worth) + rate × seconds,
+    # with one batch of slack (admission is batch-granular)
+    budget_cap = (aggressor_budget_fps * (seconds + 1.0)
+                  + plane.max_slots * len(ain))
+    v_p99 = (v_stats.get("window") or {}).get("p99_us")
+    lat_us = 1e6 * float(latency.rstrip("ms")) / 1e3 \
+        if latency.endswith("ms") else 0.0
+    out = {
+        "scenario": "noisy_neighbor",
+        "seconds": seconds,
+        "victim_pairs": victim_pairs,
+        "aggressor_pairs": aggressor_pairs,
+        "victim_fed": fed["victim"],
+        "victim_delivered": got["victim"],
+        "victim_delivery_ratio": (got["victim"] / fed["victim"]
+                                  if fed["victim"] else 1.0),
+        "victim_lost": fed["victim"] - got["victim"],
+        "victim_p99_us": v_p99,
+        "aggressor_fed": fed["aggressor"],
+        "aggressor_delivered": got["aggressor"],
+        "aggressor_admitted": int(a_stats["admitted_frames"]),
+        "aggressor_budget_fps": aggressor_budget_fps,
+        "aggressor_budget_cap": int(budget_cap),
+        "aggressor_queued_not_dropped": int(queued),
+        "throttle_events": int(a_stats["throttle_events"]),
+        "victim_throttle_events": int(v_stats["throttle_events"]),
+        "dropped": plane.dropped,
+        "tick_errors": plane.tick_errors,
+        "wall_s": round(time.perf_counter() - t_wall, 3),
+    }
+    # the scenario's own verdict (the chaos-harness style: a record
+    # that says whether the contract held, not just numbers)
+    out["aggressor_throttled_at_budget"] = (
+        out["throttle_events"] > 0
+        and out["aggressor_admitted"] <= out["aggressor_budget_cap"])
+    out["victim_unharmed"] = (
+        out["victim_lost"] == 0
+        and out["victim_throttle_events"] == 0
+        and (v_p99 is None or v_p99 <= lat_us * 4 + 4 * dt_us))
+    out["in_guardrails"] = bool(out["aggressor_throttled_at_budget"]
+                                and out["victim_unharmed"])
+    plane.stop()
+    return out
+
+
+def tenant_soak(tenants: int = 3, pairs_per_tenant: int = 2,
+                seconds: float = 8.0, dt_us: float = 2_000.0,
+                latency: str = "2ms", budget_fps: int = 0,
+                window_s: float = 1.0, settle_s: float = 60.0):
+    """Multi-tenant SOAK bench phase, process-isolated like the other
+    live phases: `tenants` namespaces share one live plane (real gRPC
+    server + real-time runner), each fed by its OWN out-of-process
+    InjectBulk load generator; per-tenant throughput, p99 and throttle
+    counts are recorded per delivery window. With `budget_fps` > 0 the
+    LAST tenant gets that admission budget (gold/silver/bronze QoS
+    ladder across the rest), so the record shows enforcement under a
+    real runner, not just the explicit-clock chaos harness."""
+    import os
+    import statistics
+    import subprocess
+    import sys as _sys
+
+    t0 = time.perf_counter()
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    qos_ladder = ["gold", "silver", "bronze"]
+    names = [f"t{i}" for i in range(tenants)]
+    cfg = {}
+    for i, name in enumerate(names):
+        cfg[name] = {"pairs": pairs_per_tenant,
+                     "qos": qos_ladder[i % len(qos_ladder)]}
+    if budget_fps > 0:
+        cfg[names[-1]]["frame_budget_per_s"] = float(budget_fps)
+    daemon, server, port, plane, registry, wires = _tenant_plane_setup(
+        cfg, latency, dt_us, "ts", server=True)
+    plane.start()
+    _warm_drain_buckets(plane, [w for ws, _ in wires.values()
+                                for w in ws])
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = []
+    try:
+        for name in names:
+            win, _ = wires[name]
+            wid_list = ",".join(str(w.wire_id) for w in win)
+            procs.append((name, subprocess.Popen(
+                [_sys.executable, "-c", _INJECTOR_SRC, str(port),
+                 wid_list, "-1", repo_root, str(INJECTOR_CHUNK)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                env=env)))
+
+        def drain(name) -> int:
+            return _drain_wires(wires[name][1])
+
+        deadline = time.monotonic() + settle_s
+        while (sum(drain(n) for n in names) == 0
+               and time.monotonic() < deadline):
+            for name, p in procs:
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"tenant {name} injector exited "
+                        f"rc={p.returncode} before first delivery")
+            time.sleep(0.01)
+        windows: dict[str, list[float]] = {n: [] for n in names}
+        t_end = time.monotonic() + seconds
+        while time.monotonic() < t_end:
+            w0 = time.monotonic()
+            time.sleep(window_s)
+            span = time.monotonic() - w0
+            for n in names:
+                windows[n].append(drain(n) / span)
+        per_tenant = {}
+        for n in names:
+            rates = sorted(windows[n])
+            med = statistics.median(rates) if rates else 0.0
+            st = registry.stats(plane, n)
+            win = st.get("window") or {}
+            per_tenant[n] = {
+                "qos": cfg[n].get("qos"),
+                "frame_budget_per_s":
+                    cfg[n].get("frame_budget_per_s", 0.0),
+                "sustained_frames_per_s": round(med, 1),
+                "worst_window_frames_per_s":
+                    round(rates[0], 1) if rates else 0.0,
+                "p99_us": win.get("p99_us"),
+                "delivered_pps": round(win.get("delivered_pps", 0.0),
+                                       1),
+                "admitted_frames": int(st["admitted_frames"]),
+                "throttle_events": int(st["throttle_events"]),
+            }
+    finally:
+        for _name, p in procs:
+            p.kill()
+        for _name, p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+        plane.stop()
+        server.stop(0)
+    total = sum(v["sustained_frames_per_s"]
+                for v in per_tenant.values())
+    return {
+        "scenario": "tenant_soak",
+        "record": "TENANT_SOAK",
+        "tenants": tenants,
+        "pairs_per_tenant": pairs_per_tenant,
+        "seconds": seconds,
+        "window_s": window_s,
+        "per_tenant": per_tenant,
+        "plane_frames_per_s": round(total, 1),
+        "throttled_tenant": names[-1] if budget_fps > 0 else None,
+        "dropped": plane.dropped,
+        "tick_errors": plane.tick_errors,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 LADDER = {
     "3node": three_node,
     "fat_tree_64": fat_tree_64,
@@ -1974,4 +2258,6 @@ LADDER = {
     "sharded_soak": sharded_soak,
     "staged_update_soak": staged_update_soak,
     "update_under_flap": update_under_flap,
+    "noisy_neighbor": noisy_neighbor,
+    "tenant_soak": tenant_soak,
 }

@@ -1,0 +1,476 @@
+"""TenantRegistry — tenants, edge blocks, QoS, and per-tenant slicing.
+
+The registry is the single source of truth for tenant identity:
+
+- **namespace → tenant**: every pod key's namespace maps to at most
+  one tenant (default: a tenant named after the namespace, auto-bound
+  by the reconciler's `ensure_namespace` hook). Untenanted namespaces
+  keep the historical shared-pool behavior everywhere.
+- **edge blocks**: a tenant may reserve a contiguous row range in the
+  shared SoA (`parallel.partition.tenant_block` — composes with shard
+  blocks). The engine's allocator consults `alloc_row`/`alloc_pair`
+  first, so the tenant's links pack into its block; freed block rows
+  return to the tenant's pool, never to another tenant.
+- **accounting row sets**: per-tenant counter/telemetry slices derive
+  from the ENGINE REGISTRIES (`_rows` + namespace mapping), cached per
+  `engine._rows_gen` — exact through `compact()`'s renumbering (the
+  plane permutes its counters with the same mapping the registries
+  use), whether or not blocks are reserved. Blocks are an allocation
+  and isolation-audit structure, not the accounting source of truth;
+  a global compact dissolves them (rows were renumbered) and the
+  registry re-reserves lazily on the next create.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from kubedtn_tpu.tenancy.admission import (AdmissionController,
+                                           HostTokenBucket,
+                                           ThrottleVerdict)
+from kubedtn_tpu.utils.logging import fields as _fields
+from kubedtn_tpu.utils.logging import get_logger
+
+# QoS class → drain-budget weight (share of the plane's per-wire drain
+# budget a tenant's wires get under contention) and stable level code
+# for metrics (0 = gold).
+QOS_CLASSES: dict[str, float] = {"gold": 1.0, "silver": 0.5,
+                                 "bronze": 0.25}
+QOS_LEVELS: dict[str, int] = {"gold": 0, "silver": 1, "bronze": 2}
+
+
+@dataclasses.dataclass
+class Tenant:
+    """One tenant's identity, quotas, and cumulative meters."""
+
+    name: str
+    qos: str = "gold"
+    frame_budget_per_s: float = 0.0   # 0 = unlimited
+    byte_budget_per_s: float = 0.0    # 0 = unlimited
+    namespaces: set = dataclasses.field(default_factory=set)
+    block: tuple[int, int] | None = None   # reserved [lo, hi) or None
+    block_free: list = dataclasses.field(default_factory=list)
+    bucket_frames: HostTokenBucket = None
+    bucket_bytes: HostTokenBucket = None
+    admitted_frames: int = 0
+    admitted_bytes: int = 0
+    created_at: float = dataclasses.field(default_factory=time.time)
+
+    def __post_init__(self) -> None:
+        if self.qos not in QOS_CLASSES:
+            raise ValueError(f"unknown QoS class {self.qos!r}; "
+                             f"choices: {', '.join(QOS_CLASSES)}")
+        if self.bucket_frames is None:
+            self.bucket_frames = HostTokenBucket(self.frame_budget_per_s)
+        if self.bucket_bytes is None:
+            self.bucket_bytes = HostTokenBucket(self.byte_budget_per_s)
+
+    @property
+    def weight(self) -> float:
+        return QOS_CLASSES[self.qos]
+
+
+class TenantRegistry:
+    """Tenant control plane over one engine (and, once attached via
+    `WireDataPlane.attach_tenancy`, one live plane)."""
+
+    # default_qos MUST be the weight-1.0 class: cmd_daemon attaches a
+    # registry unconditionally and the reconciler auto-registers a
+    # tenant per namespace, so any other default would silently scale
+    # every wire's drain budget on a plane nobody configured tenancy on
+    # ("empty registry = zero enforcement" is a documented contract)
+    def __init__(self, engine, default_qos: str = "gold") -> None:
+        self.engine = engine
+        self.default_qos = default_qos
+        self.plane = None                  # set by attach_tenancy
+        self.admission = AdmissionController()
+        self.log = get_logger("tenancy")
+        self._lock = threading.RLock()
+        self._tenants: dict[str, Tenant] = {}
+        self._ns_map: dict[str, str] = {}  # namespace -> tenant name
+        # per-tenant row-set cache, invalidated by engine._rows_gen
+        self._rows_cache: dict[str, np.ndarray] = {}
+        self._rows_cache_gen: int = -1
+        engine.tenancy = self
+
+    # -- lifecycle -----------------------------------------------------
+
+    def create(self, name: str, qos: str | None = None,
+               frame_budget_per_s: float | None = None,
+               byte_budget_per_s: float | None = None,
+               block_edges: int = 0,
+               namespaces=None) -> Tenant:
+        """Register a tenant; with `block_edges` > 0, reserve that many
+        contiguous SoA rows for it now (growing capacity first if the
+        free list cannot hold a run). Idempotent on name: re-creating
+        binds any NEW namespaces and updates only the quotas actually
+        PROVIDED — `None` budgets/qos leave the existing values alone
+        (so the reconciler's `ensure_namespace` path can never wipe an
+        operator-set budget back to unlimited) — and never moves the
+        block. On a NEW tenant, `None` budgets mean unlimited.
+
+        Lock order is ENGINE lock before registry lock everywhere (the
+        allocator hooks run under the engine lock and read the
+        registry), so the block reservation — which needs the engine
+        lock — happens before this tenant is published."""
+        with self._lock:
+            if name in self._tenants:
+                existing = self._tenants[name]
+                for ns in (set(namespaces) if namespaces else {name}):
+                    # never steal a namespace already mapped elsewhere
+                    if self._ns_map.setdefault(ns, name) == name:
+                        existing.namespaces.add(ns)
+                self._rows_cache_gen = -1
+                return self.set_quota(name, qos=qos,
+                                      frame_budget_per_s=
+                                      frame_budget_per_s,
+                                      byte_budget_per_s=byte_budget_per_s)
+        t = Tenant(name=name, qos=qos or self.default_qos,
+                   frame_budget_per_s=frame_budget_per_s or 0.0,
+                   byte_budget_per_s=byte_budget_per_s or 0.0,
+                   namespaces=set(namespaces)
+                   if namespaces else {name})
+        if block_edges > 0:
+            self._reserve_block(t, int(block_edges))
+        with self._lock:
+            won = self._tenants.setdefault(name, t)
+            for ns in t.namespaces:
+                self._ns_map.setdefault(ns, won.name)
+            self._rows_cache_gen = -1
+        if won is not t and t.block is not None:
+            # racer published first: return our reservation (engine
+            # lock taken OUTSIDE the registry lock — the lock order)
+            with self.engine._lock:
+                self.engine._free.extend(t.block_free)
+        self.log.info("tenant created %s", _fields(
+            tenant=name, qos=won.qos,
+            frame_budget=frame_budget_per_s,
+            byte_budget=byte_budget_per_s,
+            block=list(won.block) if won.block else None))
+        return won
+
+    def _reserve_block(self, t: Tenant, n_rows: int) -> None:
+        """Carve the contiguous block under the ENGINE lock (the free
+        list is engine state)."""
+        from kubedtn_tpu.parallel.partition import tenant_block
+
+        engine = self.engine
+        with engine._lock:
+            engine._ensure_capacity(n_rows)
+            blk = tenant_block(engine._free, engine._state.capacity,
+                               getattr(engine, "shard_count", 1),
+                               n_rows)
+        if blk is None:
+            # fragmented free list: one repack restores contiguity
+            # (compact dissolves existing blocks too — their rows were
+            # renumbered; accounting is row-set based and unaffected)
+            self.engine.compact()
+            with engine._lock:
+                blk = tenant_block(engine._free,
+                                   engine._state.capacity,
+                                   getattr(engine, "shard_count", 1),
+                                   n_rows)
+        if blk is None:
+            raise ValueError(
+                f"cannot reserve {n_rows} contiguous rows for tenant "
+                f"{t.name} (capacity {self.engine._state.capacity})")
+        t.block = blk
+        # descending free list: consecutive pops hand out consecutive
+        # rows, so link pairs colocate exactly like the global pool's
+        t.block_free = list(range(blk[1] - 1, blk[0] - 1, -1))
+
+    def set_quota(self, name: str, qos: str | None = None,
+                  frame_budget_per_s: float | None = None,
+                  byte_budget_per_s: float | None = None) -> Tenant:
+        with self._lock:
+            t = self._tenants[name]
+            if qos:
+                if qos not in QOS_CLASSES:
+                    raise ValueError(f"unknown QoS class {qos!r}")
+                t.qos = qos
+            if frame_budget_per_s is not None:
+                t.frame_budget_per_s = float(frame_budget_per_s)
+                t.bucket_frames.reconfigure(t.frame_budget_per_s)
+            if byte_budget_per_s is not None:
+                t.byte_budget_per_s = float(byte_budget_per_s)
+                t.bucket_bytes.reconfigure(t.byte_budget_per_s)
+            return t
+
+    def bind_namespace(self, namespace: str, tenant: str) -> None:
+        with self._lock:
+            t = self._tenants[tenant]
+            t.namespaces.add(namespace)
+            self._ns_map[namespace] = tenant
+            self._rows_cache_gen = -1
+
+    def ensure_namespace(self, namespace: str) -> Tenant | None:
+        """Reconciler hook: namespace → tenant mapping. An unmapped
+        namespace gets a default-QoS, unlimited-quota tenant named
+        after it, so every reconciled topology is attributable from
+        its first link."""
+        if not namespace:
+            return None
+        with self._lock:
+            name = self._ns_map.get(namespace)
+            if name is not None:
+                return self._tenants.get(name)
+        return self.create(namespace)
+
+    def get(self, name: str) -> Tenant | None:
+        with self._lock:
+            return self._tenants.get(name)
+
+    def list(self) -> list[Tenant]:
+        with self._lock:
+            return list(self._tenants.values())
+
+    def tenant_of_pod_key(self, pod_key: str) -> Tenant | None:
+        ns, _, _name = pod_key.partition("/")
+        with self._lock:
+            t = self._ns_map.get(ns)
+            return self._tenants.get(t) if t is not None else None
+
+    # -- engine allocator hooks (engine lock held by the caller) -------
+
+    def alloc_row(self, pod_key: str) -> int | None:
+        t = self.tenant_of_pod_key(pod_key)
+        if t is None or not t.block_free:
+            return None
+        return t.block_free.pop()
+
+    def alloc_pair(self, k1: str, k2: str) -> tuple[int, int] | None:
+        t1 = self.tenant_of_pod_key(k1)
+        t2 = self.tenant_of_pod_key(k2)
+        if t1 is None or t1 is not t2 or len(t1.block_free) < 2:
+            return None
+        return t1.block_free.pop(), t1.block_free.pop()
+
+    def release_row(self, row: int) -> bool:
+        with self._lock:
+            for t in self._tenants.values():
+                if t.block is not None and t.block[0] <= row < t.block[1]:
+                    t.block_free.append(row)
+                    return True
+        return False
+
+    def reserved_free(self) -> int:
+        with self._lock:
+            return sum(len(t.block_free)
+                       for t in self._tenants.values())
+
+    def on_compact(self, mapping: dict) -> None:
+        """compact() renumbered every row: contiguous blocks are gone
+        (their active rows moved into [0, n), their unused reserve
+        returned to the rebuilt global free list). Accounting is
+        row-set based and unaffected; blocks re-reserve on demand."""
+        del mapping
+        with self._lock:
+            for t in self._tenants.values():
+                t.block = None
+                t.block_free = []
+            self._rows_cache_gen = -1
+
+    # -- admission + QoS (the plane's tick-path surface) ---------------
+
+    def drain_policy(self, base_budget: int, now_s: float):
+        """Per-wire drain budget callable for daemon.drain_ingress:
+        QoS weight scales the budget; an over-budget tenant's wires get
+        0 (skipped this tick, typed verdict recorded, frames kept).
+        Tenant → verdict resolution is snapshotted ONCE per tick here,
+        not per wire — O(tenants) per tick, O(1) per wire."""
+        with self._lock:
+            snap = {}
+            for name, t in self._tenants.items():
+                if not t.bucket_frames.ok(now_s):
+                    snap[name] = (0, "frame-budget")
+                elif not t.bucket_bytes.ok(now_s):
+                    snap[name] = (0, "byte-budget")
+                else:
+                    snap[name] = (max(1, int(base_budget * t.weight)),
+                                  None)
+            # inside the same lock block as `snap`: a tenant published
+            # between the two copies would be in ns_map but not snap
+            ns_map = dict(self._ns_map)
+        admission = self.admission
+
+        def budget_for(wire) -> int:
+            ns, _, _ = wire.pod_key.partition("/")
+            name = ns_map.get(ns)
+            if name is None:
+                return base_budget
+            entry = snap.get(name)
+            if entry is None:
+                return base_budget  # created after the snapshot
+            budget, reason = entry
+            if budget == 0:
+                admission.record(ThrottleVerdict(
+                    tenant=name, wire_id=wire.wire_id,
+                    queued_frames=len(wire.ingress), reason=reason,
+                    at_s=now_s))
+            return budget
+
+        return budget_for
+
+    def charge_drained(self, drained, now_s: float) -> None:
+        """Debit each drained batch against its tenant's buckets and
+        advance the admitted meters (batch-granular: what was drained
+        was admitted)."""
+        per_tenant: dict[str, tuple[int, int]] = {}
+        for wire, _row, lens, _parts in drained:
+            t = self.tenant_of_pod_key(wire.pod_key)
+            if t is None:
+                continue
+            frames = len(lens)
+            nbytes = int(np.asarray(lens, np.float64).sum())
+            f0, b0 = per_tenant.get(t.name, (0, 0))
+            per_tenant[t.name] = (f0 + frames, b0 + nbytes)
+        if not per_tenant:
+            return
+        with self._lock:
+            for name, (frames, nbytes) in per_tenant.items():
+                t = self._tenants.get(name)
+                if t is None:
+                    continue
+                t.admitted_frames += frames
+                t.admitted_bytes += nbytes
+                t.bucket_frames.charge(frames, now_s)
+                t.bucket_bytes.charge(nbytes, now_s)
+
+    # -- per-tenant slicing (counters + telemetry window ring) ---------
+
+    def rows_of(self, name: str) -> np.ndarray:
+        """Current SoA rows owned by the tenant's namespaces, derived
+        from the engine registries under the engine lock and cached per
+        registry generation (exact through compact)."""
+        engine = self.engine
+        with engine._lock:
+            gen = engine._rows_gen
+            if gen != self._rows_cache_gen:
+                self._rows_cache = {}
+                self._rows_cache_gen = gen
+            hit = self._rows_cache.get(name)
+            if hit is not None:
+                return hit
+            with self._lock:
+                t = self._tenants.get(name)
+                spaces = set(t.namespaces) if t is not None else set()
+            rows = [row for (pod_key, _uid), row in engine._rows.items()
+                    if pod_key.partition("/")[0] in spaces]
+            out = np.asarray(sorted(rows), np.int64)
+            self._rows_cache[name] = out
+            return out
+
+    def tenant_counters(self, plane, name: str) -> dict:
+        """This tenant's slice of the plane's cumulative per-edge
+        counters (tx/delivered/bytes/drops by cause)."""
+        rows = self.rows_of(name)
+        c = plane.counters
+        cap = np.asarray(c.tx_packets).shape[0]
+        rows = rows[rows < cap]
+
+        def s(arr) -> float:
+            return float(np.asarray(arr)[rows].sum())
+
+        return {
+            "links": int(rows.size),
+            "tx_packets": s(c.tx_packets),
+            "tx_bytes": s(c.tx_bytes),
+            "delivered_packets": s(c.rx_packets),
+            "delivered_bytes": s(c.rx_bytes),
+            "dropped_loss": s(c.dropped_loss),
+            "dropped_queue": s(c.dropped_queue),
+            "dropped_ring": s(c.dropped_ring),
+            "corrupted": s(c.rx_corrupted),
+        }
+
+    def tenant_window(self, plane, name: str,
+                      last: int | None = None, window=None) -> dict:
+        """This tenant's slice of the telemetry window ring: delivery
+        rate and latency percentiles over the covered span (empty dict
+        when telemetry is off). `window` takes a precomputed
+        `window_sum(...)` result so a caller slicing MANY tenants (the
+        metrics collector) reduces the ring once, not once per
+        tenant."""
+        from kubedtn_tpu import telemetry as tele
+
+        if window is None:
+            tel = getattr(plane, "telemetry", None)
+            if tel is None:
+                return {}
+            window = tel.window_sum(last=last)
+        total, seconds = window
+        rows = self.rows_of(name)
+        rows = rows[rows < total.shape[0]]
+        t = total[rows].sum(axis=0)
+        delivered = float(t[tele.T_DELIVERED])
+        secs = max(seconds, 1e-9)
+        pcts = tele.percentiles_from_hist(t[tele.T_HIST0:],
+                                          qs=(0.5, 0.99))
+        return {
+            "window_seconds": float(seconds),
+            "tx": float(t[tele.T_TX]),
+            "delivered": delivered,
+            "delivered_pps": delivered / secs,
+            "bytes_ps": float(t[tele.T_BYTES]) / secs,
+            "dropped_loss": float(t[tele.T_DROP_LOSS]),
+            "dropped_queue": float(t[tele.T_DROP_QUEUE]),
+            "queue_depth": float(t[tele.T_QDEPTH]),
+            "p50_us": pcts["p50_us"],
+            "p99_us": pcts["p99_us"],
+        }
+
+    def stats(self, plane, name: str) -> dict:
+        """The Local.TenantStats payload: identity + quotas + admitted
+        meters + throttle meters + counter slice + window slice."""
+        with self._lock:
+            t = self._tenants.get(name)
+            if t is None:
+                raise KeyError(name)
+            base = {
+                "name": t.name,
+                "qos": t.qos,
+                "namespaces": sorted(t.namespaces),
+                "frame_budget_per_s": t.frame_budget_per_s,
+                "byte_budget_per_s": t.byte_budget_per_s,
+                "block_lo": t.block[0] if t.block else -1,
+                "block_hi": t.block[1] if t.block else -1,
+                "admitted_frames": t.admitted_frames,
+                "admitted_bytes": t.admitted_bytes,
+            }
+        base.update(self.admission.stats_for(name))
+        if plane is not None:
+            base.update(self.tenant_counters(plane, name))
+            base["window"] = self.tenant_window(plane, name)
+        return base
+
+    # -- tenant-scoped twin forks --------------------------------------
+
+    def tenant_snapshot(self, plane_or_engine, name: str, q: int = 32):
+        """Snapshot-fork the live plane (or bare engine) SCOPED to one
+        tenant: every edge row outside the tenant's set is deactivated
+        in the fork, so a per-tenant what-if sweep answers "what would
+        MY slice do" without seeing (or paying for) neighbors. The live
+        plane keeps ticking — same consistency barrier as
+        twin.snapshot.snapshot_from_plane."""
+        import dataclasses as dc
+
+        import jax.numpy as jnp
+
+        from kubedtn_tpu.twin.snapshot import (snapshot_from_engine,
+                                               snapshot_from_plane)
+
+        rows = self.rows_of(name)
+        if hasattr(plane_or_engine, "_tick_lock"):
+            snap = snapshot_from_plane(plane_or_engine, q=q)
+        else:
+            snap = snapshot_from_engine(plane_or_engine, q=q)
+        edges = snap.sim.edges
+        mask = jnp.zeros((edges.capacity,), bool)
+        if rows.size:
+            mask = mask.at[jnp.asarray(rows)].set(True)
+        edges = dc.replace(edges, active=edges.active & mask)
+        return dc.replace(snap, sim=dc.replace(snap.sim, edges=edges))

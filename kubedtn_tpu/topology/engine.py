@@ -65,6 +65,25 @@ _update_links_nd = jax.jit(es.update_links.__wrapped__,
                            static_argnums=(4,))
 
 
+_FNV32_OFFSET = 0x811C9DC5
+_FNV32_PRIME = 0x01000193
+
+
+def link_key_id(pod_key: str, uid: int) -> int:
+    """Stable 31-bit key id for one directed link end — FNV-1a over the
+    (pod_key, uid) identity. This is the per-row fold_in constant the
+    shaping kernels mix into the tick key (ops/netem.row_keys): it
+    depends only on the link's declared identity, never on which SoA
+    row realized it, so a tenant's random streams are identical in a
+    cohabited plane and in a solo plane of just its topology."""
+    h = _FNV32_OFFSET
+    for b in pod_key.encode():
+        h = ((h ^ b) * _FNV32_PRIME) & 0xFFFFFFFF
+    for b in int(uid).to_bytes(8, "big", signed=True):
+        h = ((h ^ b) * _FNV32_PRIME) & 0xFFFFFFFF
+    return h & 0x7FFFFFFF
+
+
 def vni_from_uid(uid: int) -> int:
     return VXLAN_BASE + uid
 
@@ -157,6 +176,17 @@ class SimEngine:
         self._row_owner: dict[int, tuple[str, int]] = {}
         self._peer: dict[tuple[str, int], tuple[str, int]] = {}
         self._free: list[int] = list(range(capacity - 1, -1, -1))
+        # row -> stable 31-bit key id (link_key_id of the owning
+        # (pod_key, uid)): the per-row fold_in constant the shaping
+        # kernels key their uniforms by (multi-tenant byte-identity)
+        self._row_keyid: dict[int, int] = {}
+        # bumped on every registry mutation (alloc/free/compact): the
+        # tenancy layer caches its per-tenant row sets against it
+        self._rows_gen: int = 0
+        # optional tenancy.TenantRegistry (set by TenantRegistry.attach):
+        # consulted at row allocation so tenant-reserved blocks steer
+        # the free list, and at free so block rows return to their pool
+        self.tenancy = None
         # >1 when a sharded data plane is attached (set by
         # WireDataPlane.enable_sharding): row allocation colocates link
         # pairs inside one shard block (parallel.partition)
@@ -237,6 +267,11 @@ class SimEngine:
 
     def _ensure_capacity(self, extra: int) -> None:
         need = self.num_active + extra
+        if self.tenancy is not None:
+            # rows reserved inside tenant blocks but not yet realized
+            # are unavailable to the global pool: count them or an
+            # all-reserved plane pops from an empty free list
+            need += self.tenancy.reserved_free()
         cap = self._state.capacity
         if need <= cap:
             return
@@ -681,7 +716,7 @@ class SimEngine:
             self._peer.pop((local_key, link.uid), None)
             if row is not None:
                 rows.append(row)
-                self._free.append(row)
+                self._free_row(row)
                 self._row_owner.pop(row, None)
             if not (link.is_macvlan() or link.is_physical()):
                 peer_key = f"{topo.namespace}/{link.peer_pod}"
@@ -689,7 +724,7 @@ class SimEngine:
                 self._peer.pop((peer_key, link.uid), None)
                 if prow is not None:
                     rows.append(prow)
-                    self._free.append(prow)
+                    self._free_row(prow)
                     self._row_owner.pop(prow, None)
         self._enqueue_delete(rows)
         self.stats.dels += len(rows)
@@ -737,14 +772,38 @@ class SimEngine:
         self.stats.observe("remoteUpdate", (time.perf_counter() - t0) * 1e3)
         return True
 
+    def _bind_row(self, pod_key: str, uid: int, row: int) -> None:
+        k = (pod_key, uid)
+        self._rows[k] = row
+        self._row_owner[row] = k
+        self._row_keyid[row] = link_key_id(pod_key, uid)
+        self._rows_gen += 1
+
     def _alloc(self, pod_key: str, uid: int) -> int:
         k = (pod_key, uid)
         if k in self._rows:
             return self._rows[k]  # idempotent re-plumb (SetupVeth semantics)
-        row = self._free.pop()
-        self._rows[k] = row
-        self._row_owner[row] = k
+        row = None
+        if self.tenancy is not None:
+            # tenant-reserved block first: the registry hands out rows
+            # from the tenant's contiguous range, keeping its edges in
+            # one block of the shared SoA (falls through to the global
+            # free list when the tenant has no block / block is full)
+            row = self.tenancy.alloc_row(pod_key)
+        if row is None:
+            row = self._free.pop()
+        self._bind_row(pod_key, uid, row)
         return row
+
+    def _free_row(self, row: int) -> None:
+        """Return a freed row to its pool: the owning tenant's block
+        free list when the row sits in a reserved block, the global
+        free list otherwise."""
+        self._row_keyid.pop(row, None)
+        self._rows_gen += 1
+        if self.tenancy is not None and self.tenancy.release_row(row):
+            return
+        self._free.append(row)
 
     def _alloc_link_pair(self, k1: str, k2: str, uid: int):
         """Allocate both directed rows of one link, colocated in one
@@ -752,20 +811,28 @@ class SimEngine:
         set by WireDataPlane.enable_sharding): frames between colocated
         endpoints never ride the cross-shard mailbox. Idempotent like
         _alloc; unsharded behavior is byte-for-byte the historical
-        two-pop path."""
+        two-pop path. Tenant-reserved blocks take precedence: both
+        directions of an intra-tenant link land inside the tenant's
+        contiguous block (which itself avoids straddling a shard
+        boundary where it fits — parallel.partition.tenant_block)."""
         a = self._rows.get((k1, uid))
         b = self._rows.get((k2, uid))
         if a is not None and b is not None:
             return a, b
+        if (a is None and b is None and self.tenancy is not None):
+            pair = self.tenancy.alloc_pair(k1, k2)
+            if pair is not None:
+                self._bind_row(k1, uid, pair[0])
+                self._bind_row(k2, uid, pair[1])
+                return pair
         S = getattr(self, "shard_count", 1)
         if (a is None and b is None and S > 1 and len(self._free) >= 2
                 and self._state.capacity % S == 0):
             from kubedtn_tpu.parallel.partition import pick_pair_rows
 
             r1, r2 = pick_pair_rows(self._free, self._state.capacity, S)
-            for k, r in ((k1, r1), (k2, r2)):
-                self._rows[(k, uid)] = r
-                self._row_owner[r] = (k, uid)
+            self._bind_row(k1, uid, r1)
+            self._bind_row(k2, uid, r2)
             return r1, r2
         return self._alloc(k1, uid), self._alloc(k2, uid)
 
@@ -809,7 +876,18 @@ class SimEngine:
             self._row_owner = {r: k for k, r in self._rows.items()}
             self._shaped_rows = {mapping[r] for r in self._shaped_rows
                                  if r in mapping}
+            # key ids are identity-derived, so the remap is a re-derive
+            self._row_keyid = {r: link_key_id(k[0], k[1])
+                               for r, k in self._row_owner.items()}
+            self._rows_gen += 1
             self._free = list(range(cap - 1, n - 1, -1))
+            if self.tenancy is not None:
+                # contiguous tenant blocks do not survive a global
+                # repack: the registry dissolves its reservations (the
+                # rows just moved into [0, n)) and re-reserves lazily;
+                # per-tenant ACCOUNTING is row-set based via _row_owner
+                # and stays exact through the renumbering
+                self.tenancy.on_compact(mapping)
             # the data plane's next write-back must not resurrect
             # pre-compact dynamic state for any row
             self._rows_touched = set(range(cap))

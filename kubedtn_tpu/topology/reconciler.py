@@ -203,6 +203,13 @@ class Reconciler:
     def _reconcile_traced(self, namespace: str, name: str,
                           key: str) -> ReconcileResult:
         t_start = time.perf_counter()
+        tenancy = getattr(self.engine, "tenancy", None)
+        if tenancy is not None:
+            # namespace → tenant mapping: every reconciled topology is
+            # attributable to a tenant from its first link (an unmapped
+            # namespace auto-registers a default-QoS unlimited tenant
+            # named after it; operators tighten quotas via kdt tenant)
+            tenancy.ensure_namespace(namespace or "default")
         try:
             topo = self.store.get(namespace, name)
         except NotFoundError:

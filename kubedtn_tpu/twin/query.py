@@ -43,7 +43,14 @@ MAX_REPLICA_CELLS = 4_000_000
 # would starve those (breakers open, outage buffers fill). One sweep
 # computes at a time; a second request waits briefly, then is refused
 # loudly instead of parking a worker.
+#
+# TENANT SCOPING (round 10): the single-sweep slot is per TENANT — one
+# tenant's long sweep can no longer park every other tenant's
+# Local.WhatIf behind a global lock. Untenanted requests share the ""
+# pool. A small PROCESS-WIDE cap still bounds total concurrency so N
+# tenants cannot occupy the whole gRPC worker pool with sweeps.
 MAX_CONCURRENT_SWEEPS = 1
+MAX_PROCESS_SWEEPS = 4
 SWEEP_WAIT_S = 10.0
 
 
@@ -98,12 +105,28 @@ def stats_for(daemon) -> WhatIfStats:
         return st
 
 
-def _sweep_slots(daemon) -> threading.BoundedSemaphore:
+def _sweep_slots(daemon, tenant: str = "") -> threading.BoundedSemaphore:
+    """The sweep-concurrency slot for one tenant ("" = the untenanted
+    shared pool): a bounded per-tenant pool, created on first use, so
+    tenants queue behind THEIR OWN sweeps only."""
     with _ATTACH_LOCK:
-        sem = getattr(daemon, "_whatif_slots", None)
+        slots = getattr(daemon, "_whatif_slots", None)
+        if slots is None or not isinstance(slots, dict):
+            slots = daemon._whatif_slots = {}
+        sem = slots.get(tenant)
         if sem is None:
-            sem = daemon._whatif_slots = threading.BoundedSemaphore(
+            sem = slots[tenant] = threading.BoundedSemaphore(
                 MAX_CONCURRENT_SWEEPS)
+        return sem
+
+
+def _process_slots(daemon) -> threading.BoundedSemaphore:
+    """Process-wide sweep cap across ALL tenants (gRPC-pool guard)."""
+    with _ATTACH_LOCK:
+        sem = getattr(daemon, "_whatif_process_slots", None)
+        if sem is None:
+            sem = daemon._whatif_process_slots = \
+                threading.BoundedSemaphore(MAX_PROCESS_SWEEPS)
         return sem
 
 
@@ -198,14 +221,35 @@ def _serve_whatif_traced(daemon, request):
 
         # sweeps compute for seconds-to-minutes: bound how many run at
         # once so they can never occupy the gRPC pool the live data
-        # plane's peer RPCs share — refuse loudly rather than park
-        slots = _sweep_slots(daemon)
+        # plane's peer RPCs share — refuse loudly rather than park.
+        # The slot is PER TENANT (plus a process-wide cap): tenant A's
+        # sweep never parks tenant B's Local.WhatIf.
+        tenant = getattr(request, "tenant", "") or ""
+        registry = getattr(daemon, "tenancy", None)
+        if tenant and (registry is None
+                       or registry.get(tenant) is None):
+            raise ValueError(f"unknown tenant {tenant!r}")
+        slots = _sweep_slots(daemon, tenant)
         if not slots.acquire(timeout=SWEEP_WAIT_S):
             raise RuntimeError(
-                "another what-if sweep is in progress; retry later")
+                f"another what-if sweep is in progress for "
+                f"{'tenant ' + tenant if tenant else 'this daemon'}; "
+                f"retry later")
+        proc = _process_slots(daemon)
+        if not proc.acquire(timeout=SWEEP_WAIT_S):
+            slots.release()
+            raise RuntimeError(
+                "the daemon-wide what-if concurrency cap is occupied; "
+                "retry later")
         try:
             plane = getattr(daemon, "dataplane", None)
-            if plane is not None:
+            if tenant:
+                # tenant-scoped fork: only this tenant's edge slice is
+                # active in the replicas (tenancy.tenant_snapshot)
+                snap = registry.tenant_snapshot(
+                    plane if plane is not None else daemon.engine,
+                    tenant)
+            elif plane is not None:
                 snap = snapshot_from_plane(plane)
             else:
                 snap = snapshot_from_engine(daemon.engine)
@@ -236,6 +280,7 @@ def _serve_whatif_traced(daemon, request):
                 k_slots=k_slots, seed=int(request.seed),
                 pod_ids=pod_ids)
         finally:
+            proc.release()
             slots.release()
     except Exception as e:  # a bad query must not kill the worker
         stats.record_error()

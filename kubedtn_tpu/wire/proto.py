@@ -160,6 +160,10 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
         _field("k_slots", 6, I32),
         _field("seed", 7, I64),
         _field("include_baseline", 8, B),
+        # tenant-scoped fork (framework tenancy extension): non-empty =
+        # snapshot only this tenant's edge slice, gated by the tenant's
+        # own sweep-concurrency slot instead of the shared one
+        _field("tenant", 9, S),
     ))
     f.message_type.append(_msg(
         "WhatIfMetrics",
@@ -289,6 +293,65 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
         _field("reason", 5, S),
         _field("stage_s", 6, D),
     ))
+    # Framework extension (absent from reference kube_dtn.proto): the
+    # multi-tenant surface (kubedtn_tpu.tenancy) — per-tenant claims
+    # over the one shared plane, per the Kubernetes Network Driver
+    # Model's composable-claims API shape. create/list/quota/stats;
+    # reference clients never see these types.
+    f.message_type.append(_msg(
+        "TenantSpec",
+        _field("name", 1, S),
+        _field("qos", 2, S),               # gold|silver|bronze
+        # budgets: negative = leave unchanged on an existing tenant
+        # (what the CLI sends for an omitted flag; new tenants default
+        # to unlimited), 0 = explicitly unlimited
+        _field("frame_budget_per_s", 3, D),
+        _field("byte_budget_per_s", 4, D),
+        _field("block_edges", 5, I32),     # reserved contiguous rows
+        _field("namespaces", 6, S, REP),   # default: [name]
+    ))
+    f.message_type.append(_msg("TenantQuery", _field("name", 1, S)))
+    f.message_type.append(_msg(
+        "TenantInfo",
+        _field("name", 1, S), _field("qos", 2, S),
+        _field("namespaces", 3, S, REP),
+        _field("frame_budget_per_s", 4, D),
+        _field("byte_budget_per_s", 5, D),
+        _field("block_lo", 6, I32),        # -1 = no reserved block
+        _field("block_hi", 7, I32),
+        _field("links", 8, I32),
+    ))
+    f.message_type.append(_msg(
+        "TenantResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("tenant", 3, None, type_name="TenantInfo"),
+    ))
+    f.message_type.append(_msg(
+        "TenantListResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("tenants", 3, None, REP, type_name="TenantInfo"),
+    ))
+    f.message_type.append(_msg(
+        "TenantStatsResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("tenant", 3, None, type_name="TenantInfo"),
+        _field("admitted_frames", 4, I64),
+        _field("admitted_bytes", 5, I64),
+        _field("throttle_events", 6, I64),
+        _field("throttled_frame_ticks", 7, I64),
+        _field("tx_packets", 8, D),
+        _field("delivered_packets", 9, D),
+        _field("delivered_bytes", 10, D),
+        _field("dropped_loss", 11, D),
+        _field("dropped_queue", 12, D),
+        _field("dropped_ring", 13, D),
+        _field("corrupted", 14, D),
+        _field("window_seconds", 15, D),
+        _field("delivered_pps", 16, D),
+        _field("bytes_ps", 17, D),
+        _field("p50_us", 18, D),           # -1 = unknown/empty
+        _field("p99_us", 19, D),
+    ))
     return f
 
 
@@ -308,7 +371,10 @@ for _name in ("LinkProperties", "Link", "Pod", "PodQuery",
               "ObserveTraceRequest", "TraceEvent",
               "ObserveTraceResponse",
               "PlanUpdateRequest", "PlanRound", "PlanUpdateResponse",
-              "ApplyPlanRequest", "ApplyPlanResponse"):
+              "ApplyPlanRequest", "ApplyPlanResponse",
+              "TenantSpec", "TenantQuery", "TenantInfo",
+              "TenantResponse", "TenantListResponse",
+              "TenantStatsResponse"):
     _MESSAGES[_name] = message_factory.GetMessageClass(
         _pool.FindMessageTypeByName(f"{PACKAGE}.{_name}"))
 
@@ -344,6 +410,12 @@ PlanRound = _MESSAGES["PlanRound"]
 PlanUpdateResponse = _MESSAGES["PlanUpdateResponse"]
 ApplyPlanRequest = _MESSAGES["ApplyPlanRequest"]
 ApplyPlanResponse = _MESSAGES["ApplyPlanResponse"]
+TenantSpec = _MESSAGES["TenantSpec"]
+TenantQuery = _MESSAGES["TenantQuery"]
+TenantInfo = _MESSAGES["TenantInfo"]
+TenantResponse = _MESSAGES["TenantResponse"]
+TenantListResponse = _MESSAGES["TenantListResponse"]
+TenantStatsResponse = _MESSAGES["TenantStatsResponse"]
 
 # Service method tables: name -> (request class, response class, streaming)
 LOCAL_METHODS = {
@@ -372,6 +444,13 @@ LOCAL_METHODS = {
     # rollback (kubedtn_tpu.updates; not in the reference IDL)
     "PlanUpdate": (PlanUpdateRequest, PlanUpdateResponse, False),
     "ApplyPlan": (ApplyPlanRequest, ApplyPlanResponse, False),
+    # Framework extensions: the multi-tenant surface — per-tenant
+    # claims over the one shared plane (kubedtn_tpu.tenancy; not in
+    # the reference IDL)
+    "TenantCreate": (TenantSpec, TenantResponse, False),
+    "TenantList": (TenantQuery, TenantListResponse, False),
+    "TenantQuota": (TenantSpec, TenantResponse, False),
+    "TenantStats": (TenantQuery, TenantStatsResponse, False),
 }
 REMOTE_METHODS = {
     "Update": (RemotePod, BoolResponse, False),

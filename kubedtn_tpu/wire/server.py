@@ -354,6 +354,10 @@ class Daemon:
         # surface snapshots the LIVE plane through it (engine-only
         # snapshots when no plane is attached)
         self.dataplane = None
+        # tenancy.TenantRegistry installed by attach_tenancy: the
+        # Local.Tenant* RPC surface answers from it (absent = the
+        # RPCs answer ok=False "tenancy not enabled")
+        self.tenancy = None
         self.wires = WireManager(on_ingress=self.mark_hot)
         self.hist = latency_histograms
         # deadline on per-frame peer forwards: a blackholed peer must cost
@@ -587,6 +591,113 @@ class Daemon:
         from kubedtn_tpu.updates.service import serve_apply_plan
 
         return serve_apply_plan(self, request)
+
+    # -- tenancy (framework extension: kubedtn_tpu.tenancy) ------------
+
+    def _tenant_info(self, t) -> "pb.TenantInfo":
+        reg = self.tenancy
+        links = int(reg.rows_of(t.name).size) if reg is not None else 0
+        return pb.TenantInfo(
+            name=t.name, qos=t.qos, namespaces=sorted(t.namespaces),
+            frame_budget_per_s=t.frame_budget_per_s,
+            byte_budget_per_s=t.byte_budget_per_s,
+            block_lo=t.block[0] if t.block else -1,
+            block_hi=t.block[1] if t.block else -1,
+            links=links)
+
+    @staticmethod
+    def _opt_budget(v: float) -> float | None:
+        """Wire budget semantics: negative = leave unchanged (None to
+        the registry; what the CLI sends for an omitted flag), 0 =
+        explicitly unlimited."""
+        return None if v < 0 else float(v)
+
+    def TenantCreate(self, request, context):
+        """Register (or quota-update, idempotent on name) one tenant:
+        QoS class, admission budgets, optional reserved edge block,
+        namespace bindings."""
+        reg = self.tenancy
+        if reg is None:
+            return pb.TenantResponse(
+                ok=False, error="tenancy not enabled on this daemon")
+        try:
+            t = reg.create(
+                request.name, qos=request.qos or None,
+                frame_budget_per_s=self._opt_budget(
+                    request.frame_budget_per_s),
+                byte_budget_per_s=self._opt_budget(
+                    request.byte_budget_per_s),
+                block_edges=int(request.block_edges),
+                namespaces=list(request.namespaces) or None)
+        except (ValueError, KeyError) as e:
+            return pb.TenantResponse(ok=False, error=str(e))
+        return pb.TenantResponse(ok=True, tenant=self._tenant_info(t))
+
+    def TenantList(self, request, context):
+        reg = self.tenancy
+        if reg is None:
+            return pb.TenantListResponse(
+                ok=False, error="tenancy not enabled on this daemon")
+        tenants = reg.list()
+        if request.name:
+            tenants = [t for t in tenants if t.name == request.name]
+        return pb.TenantListResponse(
+            ok=True, tenants=[self._tenant_info(t) for t in tenants])
+
+    def TenantQuota(self, request, context):
+        """Update an existing tenant's QoS class / admission budgets
+        (block reservations never move here)."""
+        reg = self.tenancy
+        if reg is None:
+            return pb.TenantResponse(
+                ok=False, error="tenancy not enabled on this daemon")
+        try:
+            t = reg.set_quota(
+                request.name, qos=request.qos or None,
+                frame_budget_per_s=self._opt_budget(
+                    request.frame_budget_per_s),
+                byte_budget_per_s=self._opt_budget(
+                    request.byte_budget_per_s))
+        except KeyError:
+            return pb.TenantResponse(
+                ok=False, error=f"unknown tenant {request.name!r}")
+        except ValueError as e:
+            return pb.TenantResponse(ok=False, error=str(e))
+        return pb.TenantResponse(ok=True, tenant=self._tenant_info(t))
+
+    def TenantStats(self, request, context):
+        """One tenant's full slice: quotas, admission meters, throttle
+        meters, cumulative counter slice, telemetry window slice."""
+        reg = self.tenancy
+        if reg is None:
+            return pb.TenantStatsResponse(
+                ok=False, error="tenancy not enabled on this daemon")
+        try:
+            s = reg.stats(self.dataplane, request.name)
+        except KeyError:
+            return pb.TenantStatsResponse(
+                ok=False, error=f"unknown tenant {request.name!r}")
+        t = reg.get(request.name)
+        win = s.get("window") or {}
+        nn = lambda v: -1.0 if v is None else float(v)  # noqa: E731
+        return pb.TenantStatsResponse(
+            ok=True, tenant=self._tenant_info(t),
+            admitted_frames=int(s["admitted_frames"]),
+            admitted_bytes=int(s["admitted_bytes"]),
+            throttle_events=int(s["throttle_events"]),
+            throttled_frame_ticks=int(s["throttled_frame_ticks"]),
+            tx_packets=float(s.get("tx_packets", 0.0)),
+            delivered_packets=float(s.get("delivered_packets", 0.0)),
+            delivered_bytes=float(s.get("delivered_bytes", 0.0)),
+            dropped_loss=float(s.get("dropped_loss", 0.0)),
+            dropped_queue=float(s.get("dropped_queue", 0.0)),
+            dropped_ring=float(s.get("dropped_ring", 0.0)),
+            corrupted=float(s.get("corrupted", 0.0)),
+            window_seconds=float(win.get("window_seconds", 0.0)),
+            delivered_pps=float(win.get("delivered_pps", 0.0)),
+            bytes_ps=float(win.get("bytes_ps", 0.0)),
+            p50_us=nn(win.get("p50_us")),
+            p99_us=nn(win.get("p99_us")))
 
     # -- Remote --------------------------------------------------------
 
@@ -904,7 +1015,8 @@ class Daemon:
 
     # -- sim ingress/egress bridge ------------------------------------
 
-    def drain_ingress(self, max_per_wire: int = 64, skip=None):
+    def drain_ingress(self, max_per_wire: int = 64, skip=None,
+                      admit=None):
         """Collect queued external frames as (wire, row, sizes, frames)
         batches for the next sim step. Only wires marked hot are visited —
         O(wires with traffic), not O(all wires); a wire left with residue
@@ -915,11 +1027,20 @@ class Daemon:
         untouched but stay hot — the data plane excludes wires whose
         previous drain is still in its holdback buffer.
 
+        `admit` (optional, the tenancy layer's drain policy) maps a
+        wire to ITS per-tick budget: a QoS weight scales the default,
+        and 0 means the wire's tenant is over its admission budget this
+        tick — the wire stays hot with its frames queued (throttled,
+        never dropped; the policy records the typed verdict) and, like
+        holdback skips, is excluded from the backlog signal so the
+        runner does not busy-spin on work admission will not release.
+
         `last_drain_backlog` is left holding the entry count this drain
         had to leave behind but could take next call (budget residue
         only — the backpressure input of the plane's adaptive batching
-        and sleep-shedding; unrealized-wire and holdback-skipped queues
-        are excluded because ticking harder cannot drain them)."""
+        and sleep-shedding; unrealized-wire, holdback-skipped and
+        admission-throttled queues are excluded because ticking harder
+        cannot drain them)."""
         with self._hot_lock:
             hot, self._hot = self._hot, set()
         out: list = []
@@ -932,6 +1053,13 @@ class Daemon:
             wire = self.wires.get_by_id(wire_id)
             if wire is None:
                 continue  # deleted since marked
+            wire_budget = max_per_wire
+            if admit is not None:
+                wire_budget = min(max_per_wire, admit(wire))
+                if wire_budget <= 0:
+                    if wire.ingress:  # throttled: keep hot, keep frames
+                        self._remark(wire)
+                    continue
             row = self.engine.row_of(wire.pod_key, wire.uid)
             if row is None:
                 if wire.ingress:
@@ -944,7 +1072,7 @@ class Daemon:
             # the remaining budget is SPLIT by index, the residue goes
             # back on the left of the deque (still FIFO, still counted).
             q = wire.ingress
-            budget = max_per_wire
+            budget = wire_budget
             parts: list = []
             lens_parts: list = []
             segs = False

@@ -118,6 +118,28 @@ def make_clean_mailbox(mesh, axis):
                      in_specs=(P(), P()), out_specs=(P(), P()))
 
 
+# -- cross-tenant scatter (jtenant / tenant-isolation audit) -----------
+
+
+def mutant_cross_tenant_scatter(soa, rows, updates):
+    """The tenant-isolation violation: the write-back scatter lands on
+    `rows + stride` — an arithmetic SHIFT of the dispatch's row
+    indices, which can relocate one tenant's state write into another
+    tenant's edge block while every per-tenant counter still balances.
+    Killed by jtenant (index arithmetic with no axis-offset
+    provenance reaching a scatter)."""
+    shifted = rows + jnp.int32(8)   # the mutation: cross-range shift
+    return soa.at[shifted].set(updates, mode="drop")
+
+
+def clean_tenant_scatter(soa, rows, valid, updates):
+    """The contract-conforming shape: padding rows select the
+    out-of-bounds sentinel (select, not arithmetic) and the scatter
+    drops them."""
+    tgt = jnp.where(valid, rows, jnp.int32(soa.shape[0]))
+    return soa.at[tgt].set(updates, mode="drop")
+
+
 # -- the un-fused two-dispatch tick (jcost / dispatch counting) --------
 
 @jax.jit

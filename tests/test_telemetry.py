@@ -379,12 +379,15 @@ def test_determinism_depth_parity_with_telemetry_ring():
     """The ring's totals are identical at depth 1 vs depth 2 — the
     device reductions ride the chained dispatches without changing
     them (the delivery-order parity lives in
-    test_pipeline_determinism; this pins the telemetry outputs)."""
+    test_pipeline_determinism; this pins the telemetry outputs).
+    The SAME pod names both rounds: a row's random stream is keyed by
+    the link's (pod_key, uid) identity (the multi-tenant byte-identity
+    mechanism), so two planes agree only when their topologies do."""
     totals = {}
     for depth in (1, 2):
         daemon, engine, win, wout = _daemon_with_pairs(
             2, LinkProperties(latency="2ms", loss="20"),
-            prefix=f"d{depth}")
+            prefix="dp")
         plane = WireDataPlane(daemon, dt_us=2000.0,
                               pipeline_depth=depth)
         plane.pipeline_explicit_clock = True

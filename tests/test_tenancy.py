@@ -1,0 +1,463 @@
+"""Multi-tenant plane: registry, admission, QoS, observability, and
+the jtenant isolation audit.
+
+- block reservation composes with shard blocks (parallel.partition
+  .tenant_block) and steers the engine allocator; freed rows return to
+  the owning tenant's pool;
+- admission token buckets throttle with typed, metered verdicts and
+  never drop (noisy_neighbor smoke, <30s tier-1);
+- QoS classes scale the per-wire drain budget;
+- per-tenant counters PARTITION the plane-global counters exactly —
+  property-tested over random multi-tenant specs at both pipeline
+  depths, with compact()'s remap carried per tenant;
+- kubedtn_tenant_* series + the cardinality truncation guard;
+- Local.Tenant* RPC round-trip; reconciler namespace→tenant mapping;
+- the jtenant pass kills its seeded cross-tenant-scatter mutant while
+  the clean control stays silent.
+"""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubedtn_tpu.api.types import Link, LinkProperties, Topology, \
+    TopologySpec
+from kubedtn_tpu.runtime import WireDataPlane
+from kubedtn_tpu.tenancy import (HostTokenBucket, TenantRegistry)
+from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+
+pytestmark = pytest.mark.tenancy
+
+_SPEC = importlib.util.spec_from_file_location(
+    "dtnverify_mutants_tenancy",
+    Path(__file__).parent / "fixtures" / "dtnverify" / "mutants.py")
+mutants = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(mutants)
+
+
+# -- token bucket -------------------------------------------------------
+
+def test_token_bucket_deterministic():
+    b = HostTokenBucket(100.0)  # 100/s, burst 100
+    assert b.ok(0.0)
+    b.charge(100.0, 0.0)
+    assert not b.ok(0.0)   # fill exactly 0: throttled
+    assert b.ok(0.5)       # +50 tokens refilled: admits again
+    # overdraw into debt (batch-granular charging): throttled until
+    # the refill crosses back above zero, then admitting again
+    b.charge(200.0, 0.5)
+    assert not b.ok(1.0)   # fill = -150 + 50 = -100
+    assert b.ok(3.0)       # +200 more since 1.0s
+
+    unlimited = HostTokenBucket(0.0)
+    assert unlimited.ok(0.0)
+    unlimited.charge(1e9, 0.0)
+    assert unlimited.ok(0.0)
+
+
+# -- registry / blocks --------------------------------------------------
+
+def _engine(capacity=64):
+    store = TopologyStore()
+    return store, SimEngine(store, capacity=capacity)
+
+
+def test_tenant_block_composes_with_shard_blocks():
+    from kubedtn_tpu.parallel.partition import tenant_block
+
+    free = list(range(63, -1, -1))
+    blk = tenant_block(free, 64, 4, 10)  # shard blocks of 16
+    lo, hi = blk
+    assert hi - lo == 10
+    assert lo // 16 == (hi - 1) // 16  # inside ONE shard block
+    assert not any(lo <= r < hi for r in free)
+    # a second tenant gets a disjoint block
+    blk2 = tenant_block(free, 64, 4, 10)
+    assert blk2 is not None and (blk2[1] <= lo or blk2[0] >= hi)
+
+
+def test_block_steers_allocation_and_release():
+    _store, engine = _engine()
+    reg = TenantRegistry(engine)
+    t = reg.create("acme", block_edges=8)
+    lo, hi = t.block
+    with engine._lock:
+        r1 = engine._alloc("acme/p1", 1)
+        r2 = engine._alloc("acme/p2", 1)
+        other = engine._alloc("else/p1", 1)
+    assert lo <= r1 < hi and lo <= r2 < hi
+    assert not (lo <= other < hi)
+    # freed block rows return to the tenant pool, not the global list
+    n_free = len(t.block_free)
+    with engine._lock:
+        engine._free_row(r1)
+    assert len(t.block_free) == n_free + 1
+    assert r1 not in engine._free
+
+
+def test_registry_quota_namespace_and_compact():
+    _store, engine = _engine()
+    reg = TenantRegistry(engine)
+    reg.create("a", qos="gold", frame_budget_per_s=10.0,
+               block_edges=4)
+    reg.set_quota("a", qos="bronze", frame_budget_per_s=99.0)
+    assert reg.get("a").qos == "bronze"
+    assert reg.get("a").bucket_frames.rate_per_s == 99.0
+    reg.bind_namespace("a-extra", "a")
+    assert reg.tenant_of_pod_key("a-extra/pod").name == "a"
+    with pytest.raises(ValueError):
+        reg.create("bad", qos="platinum")
+    # compact dissolves blocks; accounting survives
+    with engine._lock:
+        engine._alloc("a/p", 1)
+    engine.compact()
+    assert reg.get("a").block is None
+    assert reg.rows_of("a").tolist() == [0]
+
+
+def test_reconciler_maps_namespace_to_tenant():
+    store, engine = _engine()
+    reg = TenantRegistry(engine)
+    store.create(Topology(name="p", namespace="team-x",
+                          spec=TopologySpec()))
+    Reconciler(store, engine).reconcile("team-x", "p")
+    assert reg.get("team-x") is not None
+    assert reg.tenant_of_pod_key("team-x/p").name == "team-x"
+
+
+# -- multi-tenant plane harness ----------------------------------------
+
+PROPS_MENU = [
+    LinkProperties(latency="1ms"),
+    LinkProperties(latency="2ms", loss="20"),
+    LinkProperties(rate="1Mbit"),
+    LinkProperties(latency="1ms", loss="15", loss_corr="30"),
+]
+
+
+def _tenant_plane(spec, depth=1, capacity=None, qos=None, budgets=None):
+    """spec: {tenant: [(uid, props_idx), ...]} — one link pair per
+    entry. Returns (plane, registry, {tenant: (wins, wouts)})."""
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    n_pairs = sum(len(v) for v in spec.values())
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=capacity or 4 * n_pairs + 8)
+    reg = TenantRegistry(engine)
+    for ns in spec:
+        reg.create(ns, qos=(qos or {}).get(ns),
+                   frame_budget_per_s=(budgets or {}).get(ns, 0.0))
+    for ns, links in spec.items():
+        for uid, pi in links:
+            a, b = f"{ns}-a{uid}", f"{ns}-b{uid}"
+            props = PROPS_MENU[pi % len(PROPS_MENU)]
+            store.create(Topology(name=a, namespace=ns,
+                                  spec=TopologySpec(links=[
+                Link(local_intf="eth1", peer_intf="eth1", peer_pod=b,
+                     uid=uid, properties=props)])))
+            store.create(Topology(name=b, namespace=ns,
+                                  spec=TopologySpec(links=[
+                Link(local_intf="eth1", peer_intf="eth1", peer_pod=a,
+                     uid=uid, properties=props)])))
+            engine.setup_pod(a, ns)
+            engine.setup_pod(b, ns)
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=2_000.0, pipeline_depth=depth)
+    plane.pipeline_explicit_clock = True
+    plane.attach_tenancy(reg)
+    wires = {}
+    for ns, links in spec.items():
+        win, wout = [], []
+        for uid, _pi in links:
+            win.append(daemon._add_wire(pb.WireDef(
+                local_pod_name=f"{ns}-a{uid}", kube_ns=ns,
+                link_uid=uid, intf_name_in_pod="eth1")))
+            wout.append(daemon._add_wire(pb.WireDef(
+                local_pod_name=f"{ns}-b{uid}", kube_ns=ns,
+                link_uid=uid, intf_name_in_pod="eth1")))
+        wires[ns] = (win, wout)
+    return plane, reg, wires
+
+
+# -- QoS drain weights --------------------------------------------------
+
+def test_qos_budget_weights():
+    spec = {"gold": [(1, 0)], "bronze": [(2, 0)]}
+    plane, reg, wires = _tenant_plane(
+        spec, qos={"gold": "gold", "bronze": "bronze"})
+    policy = reg.drain_policy(100, 0.0)
+    gw = wires["gold"][0][0]
+    bw = wires["bronze"][0][0]
+    assert policy(gw) == 100
+    assert policy(bw) == 25
+
+    class FakeWire:
+        pod_key = "untenanted/p"
+        wire_id = 1
+        ingress = []
+
+    assert policy(FakeWire()) == 100  # unmapped ns: full budget
+    plane.stop()
+
+
+# -- admission: noisy-neighbor smoke (<30s) -----------------------------
+
+def test_noisy_neighbor_smoke():
+    """The tier-1 chaos smoke: the aggressor is throttled at its
+    budget with typed metered verdicts and zero dropped frames; the
+    victim loses nothing and is never throttled."""
+    from kubedtn_tpu.scenarios import noisy_neighbor
+
+    out = noisy_neighbor(victim_pairs=1, aggressor_pairs=1,
+                         seconds=1.0, victim_rate_fps=800,
+                         aggressor_rate_fps=8_000,
+                         aggressor_budget_fps=800)
+    assert out["in_guardrails"], out
+    assert out["victim_lost"] == 0
+    assert out["throttle_events"] > 0
+    assert out["aggressor_queued_not_dropped"] > 0
+    assert (out["aggressor_admitted"] + out["aggressor_queued_not_dropped"]
+            == out["aggressor_fed"])  # throttled, never dropped
+    assert out["dropped"] == 0
+
+
+def test_throttle_verdicts_are_typed_and_metered():
+    spec = {"busy": [(1, 0)]}
+    plane, reg, wires = _tenant_plane(spec, budgets={"busy": 10.0})
+    win, wout = wires["busy"]
+    t = 50.0
+    for j in range(40):
+        win[0].ingress.extend([b"\x02" * 60] * 5)
+        t += 0.002
+        plane.tick(now_s=t)
+    verds = reg.admission.recent()
+    assert verds, "expected throttle verdicts"
+    v = verds[-1]
+    assert v.tenant == "busy" and v.reason == "frame-budget"
+    assert v.queued_frames > 0
+    st = reg.admission.stats_for("busy")
+    assert st["throttle_events"] == len(
+        [x for x in verds if x.tenant == "busy"])
+    plane.stop()
+
+
+# -- per-tenant counters partition the global ones (property test) -----
+
+@pytest.mark.parametrize("depth", [1, 2], ids=["d1", "d2"])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_tenant_counters_partition_global(depth, seed):
+    """Random multi-tenant specs: the per-tenant counter slices sum
+    EXACTLY to the plane-global counters over active rows — including
+    after a mid-run compact() (remap carried per tenant)."""
+    rng = np.random.default_rng(seed)
+    n_tenants = int(rng.integers(2, 5))
+    uid = 0
+    spec = {}
+    for i in range(n_tenants):
+        links = []
+        for _ in range(int(rng.integers(1, 4))):
+            uid += 1
+            links.append((uid, int(rng.integers(0, len(PROPS_MENU)))))
+        spec[f"ten{i}"] = links
+    plane, reg, wires = _tenant_plane(spec, depth=depth)
+    t = 80.0
+    for j in range(25):
+        for ns, (win, _wout) in wires.items():
+            for w in win:
+                n = int(rng.integers(0, 6))
+                w.ingress.extend([b"\x02" * int(rng.integers(60, 200))
+                                  for _ in range(n)])
+        t += 0.002
+        plane.tick(now_s=t)
+        if j == 12:
+            plane.flush()
+            plane.engine.compact()
+    plane.flush()
+
+    def check():
+        per = {ns: reg.tenant_counters(plane, ns) for ns in spec}
+        c = plane.counters
+        with plane.engine._lock:
+            rows = np.fromiter(plane.engine._rows.values(), np.int64,
+                               len(plane.engine._rows))
+        cap = np.asarray(c.tx_packets).shape[0]
+        rows = rows[rows < cap]
+        for key, arr in (("tx_packets", c.tx_packets),
+                         ("delivered_packets", c.rx_packets),
+                         ("delivered_bytes", c.rx_bytes),
+                         ("dropped_loss", c.dropped_loss),
+                         ("dropped_queue", c.dropped_queue),
+                         ("dropped_ring", c.dropped_ring)):
+            total = float(np.asarray(arr)[rows].sum())
+            got = sum(p[key] for p in per.values())
+            assert got == pytest.approx(total), key
+
+    check()
+    plane.engine.compact()   # remap again after the run
+    check()
+    plane.stop()
+
+
+# -- metrics: kubedtn_tenant_* + truncation guard -----------------------
+
+def test_tenant_metrics_and_truncation_guard():
+    from prometheus_client import generate_latest
+
+    from kubedtn_tpu.metrics.metrics import make_registry
+
+    spec = {"m0": [(1, 0)], "m1": [(2, 0)]}
+    plane, reg, wires = _tenant_plane(spec)
+    t = 60.0
+    for _ in range(10):
+        for ns, (win, _wout) in wires.items():
+            win[0].ingress.extend([b"\x02" * 60] * 4)
+        t += 0.002
+        plane.tick(now_s=t)
+    plane.flush()
+    registry, _h = make_registry(plane.engine,
+                                 sim_counters_fn=plane.counters_fn,
+                                 dataplane=plane, tenancy=reg)
+    text = generate_latest(registry).decode()
+    assert 'kubedtn_tenant_admitted_frames_total{tenant="m0"}' in text
+    assert 'kubedtn_tenant_delivered_packets_total{tenant="m1"}' in text
+    assert "kubedtn_tenant_series_truncated 0.0" in text
+    # cardinality cap: only max_tenants exported, the guard counts
+    registry2, _h2 = make_registry(plane.engine,
+                                   sim_counters_fn=plane.counters_fn,
+                                   dataplane=plane, tenancy=reg,
+                                   max_tenants=1)
+    text2 = generate_latest(registry2).decode()
+    assert 'tenant="m0"' in text2 and 'tenant="m1"' not in text2
+    assert "kubedtn_tenant_series_truncated 1.0" in text2
+    plane.stop()
+
+
+# -- Local.Tenant* RPC surface -----------------------------------------
+
+def test_tenant_rpc_roundtrip():
+    from kubedtn_tpu.wire import proto as pb
+
+    spec = {"rpc0": [(1, 0)]}
+    plane, reg, wires = _tenant_plane(spec)
+    daemon = plane.daemon
+    resp = daemon.TenantCreate(pb.TenantSpec(
+        name="newt", qos="gold", frame_budget_per_s=123.0,
+        block_edges=4), None)
+    assert resp.ok, resp.error
+    assert resp.tenant.qos == "gold"
+    assert resp.tenant.block_lo >= 0
+    lst = daemon.TenantList(pb.TenantQuery(), None)
+    assert lst.ok and {t.name for t in lst.tenants} == {"rpc0", "newt"}
+    q = daemon.TenantQuota(pb.TenantSpec(name="newt", qos="silver"),
+                           None)
+    assert q.ok and q.tenant.qos == "silver"
+    missing = daemon.TenantQuota(pb.TenantSpec(name="ghost"), None)
+    assert not missing.ok
+    t = 42.0
+    wires["rpc0"][0][0].ingress.extend([b"\x02" * 60] * 8)
+    plane.tick(now_s=t)
+    plane.flush()
+    plane.tick(now_s=t + 1.0)
+    st = daemon.TenantStats(pb.TenantQuery(name="rpc0"), None)
+    assert st.ok, st.error
+    assert st.admitted_frames == 8
+    assert st.tx_packets == 8.0
+    plane.stop()
+
+    # a daemon without tenancy answers loudly, not with a crash
+    from kubedtn_tpu.wire.server import Daemon
+
+    _store2, engine2 = _engine()
+    bare = Daemon(engine2)
+    r = bare.TenantCreate(pb.TenantSpec(name="x"), None)
+    assert not r.ok and "not enabled" in r.error
+
+
+# -- jtenant: the cross-tenant-scatter mutant ---------------------------
+
+def test_cross_tenant_scatter_mutant_killed():
+    from kubedtn_tpu.analysis.verify.entrypoints import EntryPoint
+    from kubedtn_tpu.analysis.verify.tenant_audit import \
+        check_tenant_isolation
+
+    soa = jnp.zeros((16,))
+    rows = jnp.zeros((4,), jnp.int32)
+    upd = jnp.ones((4,))
+    ep = EntryPoint("mutant_cross_tenant_scatter",
+                    "tests/fixtures/dtnverify/mutants.py", 1)
+    ep.jaxpr = jax.make_jaxpr(mutants.mutant_cross_tenant_scatter)(
+        soa, rows, upd)
+    found: list = []
+    check_tenant_isolation(ep, found)
+    assert any("another tenant's edge range" in f.message
+               for f in found), found
+
+
+def test_clean_tenant_scatter_control_silent():
+    from kubedtn_tpu.analysis.verify.entrypoints import EntryPoint
+    from kubedtn_tpu.analysis.verify.tenant_audit import \
+        check_tenant_isolation
+
+    soa = jnp.zeros((16,))
+    rows = jnp.zeros((4,), jnp.int32)
+    valid = jnp.ones((4,), bool)
+    upd = jnp.ones((4,))
+    ep = EntryPoint("clean_tenant_scatter",
+                    "tests/fixtures/dtnverify/mutants.py", 1)
+    ep.jaxpr = jax.make_jaxpr(mutants.clean_tenant_scatter)(
+        soa, rows, valid, upd)
+    found: list = []
+    check_tenant_isolation(ep, found)
+    assert found == []
+
+
+def test_no_scatter_program_is_harness_drift():
+    from kubedtn_tpu.analysis.verify.entrypoints import EntryPoint
+    from kubedtn_tpu.analysis.verify.tenant_audit import \
+        check_tenant_isolation
+
+    ep = EntryPoint("scatterless", "x", 1)
+    ep.jaxpr = jax.make_jaxpr(lambda x: x * 2.0)(jnp.zeros((4,)))
+    found: list = []
+    check_tenant_isolation(ep, found)
+    assert any("harness drift" in f.message for f in found)
+
+
+# -- per-row keyed draws: the kernel-level mechanism --------------------
+
+def test_keyed_draws_are_batch_composition_independent():
+    """A row's uniforms with key_ids depend only on its key id — the
+    same row alone and in a mixed batch draws identical bits (the
+    netem-level statement of the tenant byte-identity contract)."""
+    import dataclasses
+
+    from kubedtn_tpu.ops import edge_state as es
+    from kubedtn_tpu.ops import netem
+
+    state = es.init_state(8)
+    props = np.zeros((8, es.NPROP), np.float32)
+    props[:, es.P_LATENCY_US] = 500.0
+    props[:, es.P_LOSS] = 30.0
+    state = dataclasses.replace(state, props=jnp.asarray(props),
+                                active=jnp.ones((8,), bool))
+    key = jax.random.key(7)
+    sizes = jnp.full((2, 4), 100.0, jnp.float32)
+    valid = jnp.ones((2, 4), bool)
+    kids = jnp.asarray([5, 9], jnp.int32)
+    res_pair, _ = netem.shape_slots_indep_nodonate(
+        state, jnp.asarray([1, 3], jnp.int32), sizes, valid, key, kids)
+    res_solo, _ = netem.shape_slots_indep_nodonate(
+        state, jnp.asarray([3], jnp.int32), sizes[1:], valid[1:], key,
+        kids[1:])
+    np.testing.assert_array_equal(np.asarray(res_pair.delivered[1]),
+                                  np.asarray(res_solo.delivered[0]))
+    np.testing.assert_array_equal(np.asarray(res_pair.depart_us[1]),
+                                  np.asarray(res_solo.depart_us[0]))

@@ -1,0 +1,226 @@
+"""Multi-tenant isolation contract — the headline pin (ISSUE 10).
+
+A tenant's delivered byte stream and telemetry totals in a COHABITED
+plane (three tenants, three kernel classes, one shared SoA) are
+BYTE-IDENTICAL to a SOLO plane running only that tenant's topology
+with the same seed — at pipeline depths 1 and 2, unsharded and on the
+8-device forced-host mesh. The mechanism is per-row fold_in keys
+(ops/netem.row_keys keyed by engine.link_key_id): a row's uniforms
+depend on the link's declared identity and its own frame ordinals,
+never on which other tenants share the batch or how it pads.
+
+Also here: the tenant-scoped twin fork (what-if on one tenant's slice
+sees only that tenant's edges) and the per-tenant WhatIf concurrency
+pool (one tenant's sweep no longer parks another's).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubedtn_tpu.api.types import Link, LinkProperties, Topology, \
+    TopologySpec
+from kubedtn_tpu.runtime import WireDataPlane
+from kubedtn_tpu.tenancy import TenantRegistry
+from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+
+pytestmark = pytest.mark.tenancy
+
+# one tenant per kernel class: slot-independent, max-plus TBF, and the
+# correlated sequential scan (the classes the fused tick routes)
+TENANT_PROPS = {
+    "t0": LinkProperties(latency="2ms", jitter="1ms", loss="10"),
+    "t1": LinkProperties(rate="2Mbit"),
+    "t2": LinkProperties(latency="1ms", loss="10", loss_corr="25"),
+}
+PAIRS = 2
+
+
+def _build_plane(tenant_names, depth=1, mesh_n=None, seed=0):
+    """One plane hosting `tenant_names`' topologies (uids and pod
+    names are GLOBAL — identical between cohabited and solo builds, so
+    link identities match). Returns (plane, {tenant: (wins, wouts)})."""
+    from kubedtn_tpu.parallel.mesh import make_mesh
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=4 * PAIRS * len(TENANT_PROPS) + 8)
+    registry = TenantRegistry(engine)
+    all_names = sorted(TENANT_PROPS)
+    for ns in tenant_names:
+        registry.create(ns)
+        props = TENANT_PROPS[ns]
+        base_uid = all_names.index(ns) * PAIRS  # global uid space
+        for i in range(PAIRS):
+            uid = base_uid + i + 1
+            a, b = f"{ns}-a{i}", f"{ns}-b{i}"
+            store.create(Topology(name=a, namespace=ns,
+                                  spec=TopologySpec(links=[
+                Link(local_intf="eth1", peer_intf="eth1", peer_pod=b,
+                     uid=uid, properties=props)])))
+            store.create(Topology(name=b, namespace=ns,
+                                  spec=TopologySpec(links=[
+                Link(local_intf="eth1", peer_intf="eth1", peer_pod=a,
+                     uid=uid, properties=props)])))
+            engine.setup_pod(a, ns)
+            engine.setup_pod(b, ns)
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=2_000.0, pipeline_depth=depth,
+                          seed=seed)
+    plane.pipeline_explicit_clock = True
+    plane.attach_tenancy(registry)
+    plane.enable_telemetry(window_s=0.01, sample_period=4)
+    if mesh_n is not None:
+        plane.enable_sharding(make_mesh(mesh_n))
+    wires = {}
+    for ns in tenant_names:
+        base_uid = all_names.index(ns) * PAIRS
+        win, wout = [], []
+        for i in range(PAIRS):
+            uid = base_uid + i + 1
+            win.append(daemon._add_wire(pb.WireDef(
+                local_pod_name=f"{ns}-a{i}", kube_ns=ns, link_uid=uid,
+                intf_name_in_pod="eth1")))
+            wout.append(daemon._add_wire(pb.WireDef(
+                local_pod_name=f"{ns}-b{i}", kube_ns=ns, link_uid=uid,
+                intf_name_in_pod="eth1")))
+        wires[ns] = (win, wout)
+    return plane, registry, wires
+
+
+def _tagged(ns, wire_i, j, size=64):
+    tag = f"{ns}/{wire_i}".encode()
+    return tag + j.to_bytes(4, "big") + b"\x00" * (size - len(tag) - 4)
+
+
+def _run(tenant_names, depth=1, mesh_n=None, ticks=40,
+         frames_per_tick=3):
+    """Deterministic schedule: every tenant's every ingress wire gets
+    `frames_per_tick` frames EVERY tick, so the cohabited and solo
+    planes dispatch on the same ticks (same key chain)."""
+    plane, registry, wires = _build_plane(tenant_names, depth=depth,
+                                          mesh_n=mesh_n)
+    t = 100.0
+    dt = 0.002
+    j = 0
+    for _ in range(ticks):
+        for ns in tenant_names:
+            win, _ = wires[ns]
+            for k, w in enumerate(win):
+                w.ingress.extend(_tagged(ns, k, j + n)
+                                 for n in range(frames_per_tick))
+        j += frames_per_tick
+        t += dt
+        plane.tick(now_s=t)
+    # drain the tail deterministically
+    for _ in range(60):
+        t += dt
+        plane.tick(now_s=t)
+    plane.flush()
+    plane.tick(now_s=t + 10.0)
+    assert plane.tick_errors == 0
+    delivered = {ns: [list(w.egress) for w in wires[ns][1]]
+                 for ns in tenant_names}
+    # per-tenant telemetry totals: summed over the tenant's rows
+    total, _secs = plane.telemetry.window_sum()
+    tel = {}
+    for ns in tenant_names:
+        rows = registry.rows_of(ns)
+        tel[ns] = total[rows[rows < total.shape[0]]].sum(axis=0)
+    counters = {ns: registry.tenant_counters(plane, ns)
+                for ns in tenant_names}
+    return delivered, tel, counters
+
+
+@pytest.mark.parametrize("depth", [1, 2], ids=["d1", "d2"])
+def test_cohabited_vs_solo_byte_identical(depth):
+    """Three tenants sharing one plane: each tenant's per-wire
+    delivered byte sequences, telemetry ring totals, and counter
+    slices equal a solo plane of only its topology, bit for bit."""
+    co_del, co_tel, co_cnt = _run(sorted(TENANT_PROPS), depth=depth)
+    for ns in sorted(TENANT_PROPS):
+        so_del, so_tel, so_cnt = _run([ns], depth=depth)
+        assert co_del[ns] == so_del[ns], f"tenant {ns} byte stream"
+        np.testing.assert_array_equal(co_tel[ns], so_tel[ns])
+        assert co_cnt[ns] == so_cnt[ns]
+
+
+def test_cohabited_mesh8_vs_solo_unsharded():
+    """The same contract with the cohabited plane's SoA block-sharded
+    across the 8-device forced-host mesh (solo stays unsharded — the
+    sharded plane is already pinned byte-identical to the unsharded
+    one, so this closes cohabited-sharded ≡ solo-unsharded)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    co_del, co_tel, _ = _run(sorted(TENANT_PROPS), depth=1, mesh_n=8,
+                             ticks=25)
+    for ns in sorted(TENANT_PROPS):
+        so_del, so_tel, _ = _run([ns], depth=1, ticks=25)
+        assert co_del[ns] == so_del[ns], f"tenant {ns} byte stream"
+        np.testing.assert_array_equal(co_tel[ns], so_tel[ns])
+
+
+def test_cohabited_mesh8_depth2_byte_identical():
+    """Depth-2 on the 8-device mesh equals depth-1 unsharded, per
+    tenant — overlap and sharding together change nothing."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    base_del, base_tel, _ = _run(sorted(TENANT_PROPS), depth=1,
+                                 ticks=25)
+    m8_del, m8_tel, _ = _run(sorted(TENANT_PROPS), depth=2, mesh_n=8,
+                             ticks=25)
+    for ns in sorted(TENANT_PROPS):
+        assert m8_del[ns] == base_del[ns]
+        np.testing.assert_array_equal(m8_tel[ns], base_tel[ns])
+
+
+# -- tenant-scoped twin forks + per-tenant WhatIf pool -----------------
+
+def test_tenant_snapshot_scopes_edges():
+    plane, registry, _wires = _build_plane(sorted(TENANT_PROPS))
+    snap = registry.tenant_snapshot(plane, "t1")
+    rows = registry.rows_of("t1")
+    active = np.asarray(snap.sim.edges.active)
+    assert active[rows].all()
+    others = np.setdiff1d(np.arange(active.shape[0]), rows)
+    assert not active[others].any()
+    plane.stop()
+
+
+def test_whatif_per_tenant_slots_do_not_share():
+    from kubedtn_tpu.twin.query import _sweep_slots
+
+    class Dummy:
+        pass
+
+    d = Dummy()
+    a = _sweep_slots(d, "t0")
+    b = _sweep_slots(d, "t1")
+    shared = _sweep_slots(d, "")
+    assert a is not b and a is not shared
+    # tenant A's slot held: tenant B still acquires immediately
+    assert a.acquire(blocking=False)
+    try:
+        assert b.acquire(blocking=False)
+        b.release()
+    finally:
+        a.release()
+
+
+def test_whatif_tenant_scoped_sweep():
+    from kubedtn_tpu.twin.query import serve_whatif
+    from kubedtn_tpu.wire import proto as pb
+
+    plane, _registry, _wires = _build_plane(sorted(TENANT_PROPS))
+    daemon = plane.daemon
+    resp = serve_whatif(daemon, pb.WhatIfRequest(
+        ticks=20, include_baseline=True, tenant="t0"))
+    assert resp.ok, resp.error
+    assert len(resp.results) == 1
+    resp2 = serve_whatif(daemon, pb.WhatIfRequest(
+        ticks=20, include_baseline=True, tenant="nope"))
+    assert not resp2.ok and "unknown tenant" in resp2.error
+    plane.stop()
