@@ -433,33 +433,55 @@ def _iid_random_rows(props):
 # unsharded plane.
 #
 # KEYING (multi-tenant byte-identity, round 10): with `key_ids` given,
-# every row draws its uniforms from fold_in(key, key_ids[r]) — a stable
-# per-row identity the host derives from (pod_key, uid), NOT from the
-# row's position in this tick's batch. A row's random stream then
-# depends only on (tick key, kernel class, link identity, slot index),
-# never on which OTHER rows happen to share the batch or how the batch
-# is padded — which is exactly what pins a tenant's delivered bytes in
-# a cohabited plane byte-identical to a solo plane running only that
-# tenant's topology (tests/test_tenant_isolation.py). With key_ids=None
-# the historical batch-position draws are preserved bit-for-bit (the
-# direct-kernel tests and embedders keep their streams).
+# every (row, slot) cell draws its uniforms from
+# fold_in(fold_in(key, key_ids[r]), slot) — a stable per-row identity
+# the host derives from (pod_key, uid), NOT from the row's position in
+# this tick's batch, with the slot ordinal folded in per cell. A cell's
+# random stream then depends only on (tick key, kernel class, link
+# identity, slot index), never on which OTHER rows happen to share the
+# batch or how the batch is padded — which is exactly what pins a
+# tenant's delivered bytes in a cohabited plane byte-identical to a
+# solo plane running only that tenant's topology
+# (tests/test_tenant_isolation.py). With key_ids=None the historical
+# batch-position draws are preserved bit-for-bit (the direct-kernel
+# tests and embedders keep their streams).
 
 
 def row_keys(key, key_ids):
-    """Per-row PRNG keys: fold each row's stable 32-bit key id into the
-    class key. key_ids[r] must not depend on batch composition — the
-    engine derives it from the link's (pod_key, uid) identity."""
+    """Per-row PRNG keys: fold each row's stable key id into the class
+    key. key_ids[r] must not depend on batch composition — the engine
+    derives it from the link's (pod_key, uid) identity. A 1-D id array
+    folds once per row; a uint32[..., 2] array carries the (lo, hi)
+    words of the 64-bit engine.link_key_id and folds twice, keeping
+    accidental stream-sharing collisions at the 64-bit birthday bound
+    (a 31-bit id expects two links with identical loss/jitter streams
+    — possibly across tenants — around 65k links)."""
+    if key_ids.ndim == 2:
+        def fold2(w):
+            return jax.random.fold_in(jax.random.fold_in(key, w[0]),
+                                      w[1])
+
+        return jax.vmap(fold2)(key_ids)
     return jax.vmap(lambda i: jax.random.fold_in(key, i))(key_ids)
 
 
 def _uniform_rows(key, key_ids, R: int, K: int):
     """[R, K, NU] uniforms: batch-position stream when key_ids is None
-    (historical), per-row `row_keys` streams otherwise."""
+    (historical), per-(row, slot) `fold_in` streams otherwise. The
+    keyed draw is one (NU,) sample per (row, slot) key — NEVER one
+    (K, NU) sample per row key: threefry output at a given index
+    depends on the requested shape, so a per-row (K, NU) draw would
+    leak the batch's padded slot count K (set by the burstiest
+    cohabiting row in the class group) into every row's stream,
+    breaking solo-vs-cohabited byte-identity exactly when a noisy
+    neighbor bursts across a _pad_slots bucket."""
     if key_ids is None:
         return jax.random.uniform(key, (R, K, NU), dtype=jnp.float32)
 
-    def draw_row(k):
-        return jax.random.uniform(k, (K, NU), dtype=jnp.float32)
+    def draw_row(rk):
+        return jax.vmap(lambda s: jax.random.uniform(
+            jax.random.fold_in(rk, s), (NU,), dtype=jnp.float32))(
+            jnp.arange(K, dtype=jnp.uint32))
 
     return jax.vmap(draw_row)(row_keys(key, key_ids))
 

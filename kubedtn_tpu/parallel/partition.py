@@ -23,7 +23,8 @@ import numpy as np
 import numpy.typing as npt
 
 __all__ = ["shard_ranges", "shard_of_rows", "colocation_stats",
-           "mailbox_layout", "pick_pair_rows", "tenant_block"]
+           "mailbox_layout", "pick_pair_rows", "tenant_block",
+           "tenant_blocks"]
 
 
 def shard_ranges(capacity: int, n_shards: int) -> list[tuple[int, int]]:
@@ -70,56 +71,81 @@ def pick_pair_rows(free: list[int], capacity: int, n_shards: int,
     return r1, free.pop()
 
 
-def tenant_block(free: list[int], capacity: int, n_shards: int,
-                 n_rows: int) -> tuple[int, int] | None:
-    """Carve a CONTIGUOUS run of `n_rows` currently-free rows out of the
-    engine's free list for one tenant's reserved edge block.
+def tenant_blocks(free: list[int], capacity: int, n_shards: int,
+                  requests: list[int]) -> list[tuple[int, int] | None]:
+    """Carve a CONTIGUOUS run of currently-free rows out of the
+    engine's free list for EACH requested tenant edge block, in ONE
+    sorted pass — the batch behind `tenant_block` and the registry's
+    whole-registry re-carve after a compact (T tenants cost one sort
+    of the free list and one rebuild, not T of each, and the free list
+    is engine state mutated under the engine lock the tick path's
+    allocator also wants).
 
-    Composition with shard blocks: a candidate run that fits entirely
-    inside one shard's [s*E/S, (s+1)*E/S) range is preferred — a tenant
-    whose block sits inside one shard never pays the cross-shard
-    mailbox for intra-tenant hops — falling back to a boundary-spanning
-    run (still contiguous, still isolated) only when no shard-local run
-    is free. Returns [lo, hi) with the rows removed from `free`, or
-    None when no contiguous run of that length exists (the caller then
-    leaves the tenant on the shared pool)."""
-    if n_rows <= 0:
-        return None
-    rows = np.sort(np.asarray(free, np.int64))
-    if rows.size < n_rows:
-        return None
+    Composition with shard blocks: for each request, a candidate run
+    that fits entirely inside one shard's [s*E/S, (s+1)*E/S) range is
+    preferred — a tenant whose block sits inside one shard never pays
+    the cross-shard mailbox for intra-tenant hops — falling back to a
+    boundary-spanning run (still contiguous, still isolated) only when
+    no shard-local run is free. Requests are served in order; returns
+    a same-length list of [lo, hi) (rows removed from `free`) or None
+    when no contiguous run of that length exists (the caller then
+    leaves that tenant on the shared pool)."""
     loc = (capacity // n_shards
            if n_shards > 1 and capacity % n_shards == 0 else capacity)
-    # run starts: positions where a fresh contiguous run begins
-    breaks = np.nonzero(np.diff(rows) != 1)[0] + 1
-    starts = [0, *breaks.tolist(), rows.size]
-    local: tuple[int, int] | None = None
-    spanning: tuple[int, int] | None = None
-    for g in range(len(starts) - 1):
-        a, b = starts[g], starts[g + 1]
-        lo, hi = int(rows[a]), int(rows[b - 1]) + 1
-        if hi - lo < n_rows:
+    rows = np.sort(np.asarray(free, np.int64))
+    # maximal contiguous runs as half-open [lo, hi) intervals, kept
+    # sorted as carved windows split them
+    runs: list[tuple[int, int]] = []
+    if rows.size:
+        breaks = np.nonzero(np.diff(rows) != 1)[0] + 1
+        starts = [0, *breaks.tolist(), rows.size]
+        runs = [(int(rows[a]), int(rows[b - 1]) + 1)
+                for a, b in zip(starts[:-1], starts[1:])]
+    out: list[tuple[int, int] | None] = []
+    taken: set[int] = set()
+    for n_rows in requests:
+        if n_rows <= 0:
+            out.append(None)
             continue
-        if spanning is None:
-            spanning = (lo, lo + n_rows)
-        # the earliest window inside the run that does not straddle a
-        # shard-block boundary wins — computed directly: `lo` itself,
-        # or the next boundary when lo's window would cross it (no
-        # position in between can avoid the crossing); impossible
-        # outright when the window outsizes a shard block
-        if n_rows <= loc:
-            w_lo = (lo if lo // loc == (lo + n_rows - 1) // loc
-                    else (lo // loc + 1) * loc)
-            if w_lo + n_rows <= hi:
-                local = (w_lo, w_lo + n_rows)
-                break
-    best = local if local is not None else spanning
-    if best is None:
-        return None
-    lo, hi = best
-    taken = set(range(lo, hi))
-    free[:] = [r for r in free if r not in taken]
-    return lo, hi
+        local: tuple[int, int, int] | None = None
+        spanning: tuple[int, int, int] | None = None
+        for idx, (lo, hi) in enumerate(runs):
+            if hi - lo < n_rows:
+                continue
+            if spanning is None:
+                spanning = (idx, lo, lo + n_rows)
+            # the earliest window inside the run that does not
+            # straddle a shard-block boundary wins — computed
+            # directly: `lo` itself, or the next boundary when lo's
+            # window would cross it (no position in between can avoid
+            # the crossing); impossible outright when the window
+            # outsizes a shard block
+            if n_rows <= loc:
+                w_lo = (lo if lo // loc == (lo + n_rows - 1) // loc
+                        else (lo // loc + 1) * loc)
+                if w_lo + n_rows <= hi:
+                    local = (idx, w_lo, w_lo + n_rows)
+                    break
+        best = local if local is not None else spanning
+        if best is None:
+            out.append(None)
+            continue
+        idx, lo, hi = best
+        rlo, rhi = runs[idx]
+        runs[idx:idx + 1] = [r for r in ((rlo, lo), (hi, rhi))
+                             if r[1] > r[0]]
+        taken.update(range(lo, hi))
+        out.append((lo, hi))
+    if taken:
+        free[:] = [r for r in free if r not in taken]
+    return out
+
+
+def tenant_block(free: list[int], capacity: int, n_shards: int,
+                 n_rows: int) -> tuple[int, int] | None:
+    """Single-request form of `tenant_blocks` (same preference order
+    and free-list contract)."""
+    return tenant_blocks(free, capacity, n_shards, [n_rows])[0]
 
 
 def colocation_stats(engine: Any, n_shards: int) -> dict[str, object]:

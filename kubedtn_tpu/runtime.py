@@ -1169,24 +1169,27 @@ def _pad_slots(n: int) -> int:
 def _build_group(batches, group, E: int, keyid_map):
     """Padded [R, K] batch arrays for one kernel class; row_idx pads
     with E (gathers clamp harmlessly, write-back scatters drop).
-    key_ids carries each row's stable identity fold_in constant
-    (engine.link_key_id via `keyid_map`; 0 on padding rows) — the
-    per-row keying that decouples a row's random stream from batch
-    composition (multi-tenant byte-identity)."""
+    key_ids carries each row's stable identity fold_in constant as the
+    two uint32 words of the 64-bit engine.link_key_id (via
+    `keyid_map`; 0 on padding rows) — the per-row keying that
+    decouples a row's random stream from batch composition
+    (multi-tenant byte-identity)."""
     R = len(group)
     K = max(len(batches[i][2]) for i in group)
     Rp, Kp = _pad_rows(R), _pad_slots(K)
     row_idx = np.full(Rp, E, np.int32)
     sizes = np.zeros((Rp, Kp), np.float32)
     valid = np.zeros((Rp, Kp), bool)
-    key_ids = np.zeros(Rp, np.int32)
+    key_ids = np.zeros((Rp, 2), np.uint32)
     for r, i in enumerate(group):
         _w, row, lens, _fr, _pd = batches[i]
         m = len(lens)
         row_idx[r] = row
         sizes[r, :m] = lens
         valid[r, :m] = True
-        key_ids[r] = keyid_map.get(row, 0)
+        kid = keyid_map.get(row, 0)
+        key_ids[r, 0] = kid & 0xFFFFFFFF
+        key_ids[r, 1] = kid >> 32
     return row_idx, sizes, valid, key_ids
 
 
@@ -2736,7 +2739,7 @@ class WireDataPlane:
             fb_rows = np.full(Rp, E, np.int32)
             fb_sizes = np.zeros((Rp, Kp), np.float32)
             fb_valid = np.zeros((Rp, Kp), bool)
-            fb_kids = np.zeros(Rp, np.int32)
+            fb_kids = np.zeros((Rp, 2), np.uint32)
             fb_rows[:len(sel)] = row_idx[sel]
             fb_sizes[:len(sel)] = sizes[sel]
             fb_valid[:len(sel)] = valid[sel]

@@ -18,7 +18,10 @@ The registry is the single source of truth for tenant identity:
   use), whether or not blocks are reserved. Blocks are an allocation
   and isolation-audit structure, not the accounting source of truth;
   a global compact dissolves them (rows were renumbered) and the
-  registry re-reserves lazily on the next create.
+  registry immediately re-carves each tenant's reservation at its
+  full requested size from the repacked free list (`on_compact`);
+  when a re-carve no longer fits, the tenant heals on the next
+  compact or `create(block_edges=...)`.
 """
 
 from __future__ import annotations
@@ -54,6 +57,10 @@ class Tenant:
     namespaces: set = dataclasses.field(default_factory=set)
     block: tuple[int, int] | None = None   # reserved [lo, hi) or None
     block_free: list = dataclasses.field(default_factory=list)
+    # rows the tenant's reservation was REQUESTED with (block_edges):
+    # survives compact()'s dissolve so the re-carve restores the full
+    # entitlement, not whatever happened to be unused at repack time
+    block_rows: int = 0
     bucket_frames: HostTokenBucket = None
     bucket_bytes: HostTokenBucket = None
     admitted_frames: int = 0
@@ -110,42 +117,77 @@ class TenantRegistry:
         binds any NEW namespaces and updates only the quotas actually
         PROVIDED — `None` budgets/qos leave the existing values alone
         (so the reconciler's `ensure_namespace` path can never wipe an
-        operator-set budget back to unlimited) — and never moves the
-        block. On a NEW tenant, `None` budgets mean unlimited.
+        operator-set budget back to unlimited) — and never moves an
+        EXISTING block, but does reserve one when `block_edges` > 0
+        and the tenant has none (the lazy half of post-compact block
+        recovery — see `on_compact`). On a NEW tenant, `None` budgets
+        mean unlimited.
 
         Lock order is ENGINE lock before registry lock everywhere (the
         allocator hooks run under the engine lock and read the
-        registry), so the block reservation — which needs the engine
-        lock — happens before this tenant is published."""
+        registry), so block reservation — which needs the engine lock
+        — always happens OUTSIDE the registry lock."""
         with self._lock:
-            if name in self._tenants:
-                existing = self._tenants[name]
+            existing = self._tenants.get(name)
+            if existing is not None:
                 for ns in (set(namespaces) if namespaces else {name}):
                     # never steal a namespace already mapped elsewhere
                     if self._ns_map.setdefault(ns, name) == name:
                         existing.namespaces.add(ns)
                 self._rows_cache_gen = -1
-                return self.set_quota(name, qos=qos,
-                                      frame_budget_per_s=
-                                      frame_budget_per_s,
-                                      byte_budget_per_s=byte_budget_per_s)
+                out = self.set_quota(name, qos=qos,
+                                     frame_budget_per_s=
+                                     frame_budget_per_s,
+                                     byte_budget_per_s=byte_budget_per_s)
+                need_block = block_edges > 0 and existing.block is None
+                size_kept = (block_edges > 0
+                             and existing.block is not None
+                             and existing.block_rows != block_edges)
+        if existing is not None:
+            if need_block:
+                self._reserve_block(existing, int(block_edges))
+                self.log.info("tenant block reserved %s", _fields(
+                    tenant=name,
+                    block=list(existing.block) if existing.block
+                    else None))
+            elif size_kept:
+                # blocks never move or resize once reserved — say so
+                # instead of silently ignoring the differing request
+                self.log.info("tenant block size kept %s", _fields(
+                    tenant=name, requested=int(block_edges),
+                    reserved=existing.block_rows))
+            return out
         t = Tenant(name=name, qos=qos or self.default_qos,
                    frame_budget_per_s=frame_budget_per_s or 0.0,
                    byte_budget_per_s=byte_budget_per_s or 0.0,
                    namespaces=set(namespaces)
                    if namespaces else {name})
-        if block_edges > 0:
-            self._reserve_block(t, int(block_edges))
+        # publish BEFORE reserving: a block carved for an unpublished
+        # tenant would be invisible to a concurrent compact() —
+        # on_compact walks only published tenants, so the rebuilt
+        # global free list would recycle the carved rows while the
+        # tenant still held them (the same SoA rows allocatable from
+        # two pools). Published first, the tenant is dissolved and
+        # re-carved by on_compact like any other. When two creates
+        # race, the FIRST reservation to land wins (the loser may even
+        # carve it on the winner's behalf below); a racing different
+        # block_edges is ignored like any re-create's — blocks never
+        # move or resize once reserved.
         with self._lock:
             won = self._tenants.setdefault(name, t)
             for ns in t.namespaces:
-                self._ns_map.setdefault(ns, won.name)
+                # bind this call's namespaces to whoever WON the
+                # publish race: admission (ns_map) and accounting
+                # (won.namespaces) must agree on every namespace
+                if self._ns_map.setdefault(ns, won.name) == won.name:
+                    won.namespaces.add(ns)
             self._rows_cache_gen = -1
-        if won is not t and t.block is not None:
-            # racer published first: return our reservation (engine
-            # lock taken OUTSIDE the registry lock — the lock order)
-            with self.engine._lock:
-                self.engine._free.extend(t.block_free)
+            need_block = block_edges > 0 and won.block is None
+        if need_block:
+            # a reservation failure (ValueError) leaves the tenant
+            # registered without a block; the next
+            # create(block_edges=...) retries via the lazy path
+            self._reserve_block(won, int(block_edges))
         self.log.info("tenant created %s", _fields(
             tenant=name, qos=won.qos,
             frame_budget=frame_budget_per_s,
@@ -153,35 +195,68 @@ class TenantRegistry:
             block=list(won.block) if won.block else None))
         return won
 
+    @staticmethod
+    def _block_free_of(blk: tuple[int, int]) -> list[int]:
+        # descending free list: consecutive pops hand out consecutive
+        # rows, so link pairs colocate exactly like the global pool's
+        return list(range(blk[1] - 1, blk[0] - 1, -1))
+
     def _reserve_block(self, t: Tenant, n_rows: int) -> None:
-        """Carve the contiguous block under the ENGINE lock (the free
-        list is engine state)."""
+        """Reserve a contiguous block for `t`, repacking once if the
+        free list is too fragmented to hold a run. First reservation
+        wins: if a concurrent reserver (or the repack's own on_compact
+        re-carve, which uses the tenant's REMEMBERED block_rows)
+        established a block of a different size meanwhile, that block
+        is kept — blocks never move or resize — and the mismatch is
+        logged rather than silently absorbed."""
+        if not self._carve_and_publish(t, n_rows):
+            # fragmented free list: one repack restores contiguity
+            # (compact dissolves every existing block — the rows were
+            # renumbered — and on_compact eagerly re-carves the OTHER
+            # tenants' reservations; ours comes from what remains.
+            # Accounting is row-set based and unaffected)
+            self.engine.compact()
+            if not self._carve_and_publish(t, n_rows):
+                raise ValueError(
+                    f"cannot reserve {n_rows} contiguous rows for "
+                    f"tenant {t.name} (capacity "
+                    f"{self.engine._state.capacity})")
+        with self._lock:
+            reserved = t.block_rows
+        if reserved != n_rows:
+            self.log.info("tenant block size kept %s", _fields(
+                tenant=t.name, requested=int(n_rows),
+                reserved=reserved))
+
+    def _carve_and_publish(self, t: Tenant, n_rows: int) -> bool:
+        """Carve a contiguous run off the engine free list and publish
+        it as `t.block` in ONE engine-lock hold: a compact() cannot
+        interleave and recycle the carved-but-unpublished rows into
+        its rebuilt global free list, and a published tenant's
+        allocator hooks (which run under the engine lock) never see a
+        half-built reservation. `t` must already be in `_tenants`
+        (create publishes the tenant BEFORE reserving) — a block on an
+        unregistered tenant would be invisible to on_compact. True
+        when `t` has a block on return — ours, or a racing reserver's
+        (first publish wins)."""
         from kubedtn_tpu.parallel.partition import tenant_block
 
         engine = self.engine
         with engine._lock:
+            with self._lock:
+                if t.block is not None:
+                    return True
             engine._ensure_capacity(n_rows)
             blk = tenant_block(engine._free, engine._state.capacity,
                                getattr(engine, "shard_count", 1),
                                n_rows)
-        if blk is None:
-            # fragmented free list: one repack restores contiguity
-            # (compact dissolves existing blocks too — their rows were
-            # renumbered; accounting is row-set based and unaffected)
-            self.engine.compact()
-            with engine._lock:
-                blk = tenant_block(engine._free,
-                                   engine._state.capacity,
-                                   getattr(engine, "shard_count", 1),
-                                   n_rows)
-        if blk is None:
-            raise ValueError(
-                f"cannot reserve {n_rows} contiguous rows for tenant "
-                f"{t.name} (capacity {self.engine._state.capacity})")
-        t.block = blk
-        # descending free list: consecutive pops hand out consecutive
-        # rows, so link pairs colocate exactly like the global pool's
-        t.block_free = list(range(blk[1] - 1, blk[0] - 1, -1))
+            if blk is None:
+                return False
+            with self._lock:
+                t.block = blk
+                t.block_rows = n_rows
+                t.block_free = self._block_free_of(blk)
+        return True
 
     def set_quota(self, name: str, qos: str | None = None,
                   frame_budget_per_s: float | None = None,
@@ -263,15 +338,49 @@ class TenantRegistry:
                        for t in self._tenants.values())
 
     def on_compact(self, mapping: dict) -> None:
-        """compact() renumbered every row: contiguous blocks are gone
-        (their active rows moved into [0, n), their unused reserve
-        returned to the rebuilt global free list). Accounting is
-        row-set based and unaffected; blocks re-reserve on demand."""
+        """compact() renumbered every row: the old contiguous blocks
+        are gone (their active rows moved into [0, n), their unused
+        reserve returned to the rebuilt global free list). Each
+        tenant's reservation is immediately re-carved at its FULL
+        requested size (`block_rows`) — never just the unused
+        remainder, which would decay the entitlement on every
+        compact/free cycle (rows allocated before the repack live
+        outside the new block and drain back to the global pool as
+        they free) — so one tenant's repack can never silently strip
+        or shrink another tenant's reservation. A re-carve that no
+        longer fits (capacity claimed by active rows, shard-locality
+        fragmentation from earlier re-carves) leaves that tenant
+        dissolved — with `block_rows` remembered, so the NEXT compact
+        or `create(block_edges=...)` heals it. Accounting is row-set
+        based and unaffected throughout. Called by engine.compact with
+        the ENGINE lock held (re-entrant here — the lock order is
+        engine before registry)."""
+        from kubedtn_tpu.parallel.partition import tenant_blocks
+
         del mapping
-        with self._lock:
-            for t in self._tenants.values():
+        engine = self.engine
+        with engine._lock, self._lock:
+            tenants = list(self._tenants.values())
+            for t in tenants:
                 t.block = None
                 t.block_free = []
+            # ONE sorted pass over the free list for the whole
+            # registry — per-tenant carving would re-sort and rebuild
+            # the list T times under the engine lock the tick path's
+            # allocator is waiting on
+            blks = tenant_blocks(engine._free, engine._state.capacity,
+                                 getattr(engine, "shard_count", 1),
+                                 [t.block_rows for t in tenants])
+            for t, blk in zip(tenants, blks):
+                if t.block_rows <= 0:
+                    continue
+                if blk is None:
+                    self.log.warning(
+                        "tenant block not re-carved after compact %s",
+                        _fields(tenant=t.name, rows=t.block_rows))
+                    continue
+                t.block = blk
+                t.block_free = self._block_free_of(blk)
             self._rows_cache_gen = -1
 
     # -- admission + QoS (the plane's tick-path surface) ---------------

@@ -65,23 +65,28 @@ _update_links_nd = jax.jit(es.update_links.__wrapped__,
                            static_argnums=(4,))
 
 
-_FNV32_OFFSET = 0x811C9DC5
-_FNV32_PRIME = 0x01000193
+_FNV64_OFFSET = 0xCBF29CE484222325
+_FNV64_PRIME = 0x00000100000001B3
 
 
 def link_key_id(pod_key: str, uid: int) -> int:
-    """Stable 31-bit key id for one directed link end — FNV-1a over the
+    """Stable 64-bit key id for one directed link end — FNV-1a over the
     (pod_key, uid) identity. This is the per-row fold_in constant the
-    shaping kernels mix into the tick key (ops/netem.row_keys): it
-    depends only on the link's declared identity, never on which SoA
-    row realized it, so a tenant's random streams are identical in a
-    cohabited plane and in a solo plane of just its topology."""
-    h = _FNV32_OFFSET
+    shaping kernels mix into the tick key (ops/netem.row_keys, folded
+    as two 32-bit words): it depends only on the link's declared
+    identity, never on which SoA row realized it, so a tenant's random
+    streams are identical in a cohabited plane and in a solo plane of
+    just its topology. 64 bits put the birthday bound for an
+    accidental id collision — two links sharing one PRNG stream, with
+    perfectly correlated loss/jitter/reorder draws, possibly across
+    tenants — near 2^32 links, past the roadmap's scale ambition; a
+    31-bit id expects one around 65k links."""
+    h = _FNV64_OFFSET
     for b in pod_key.encode():
-        h = ((h ^ b) * _FNV32_PRIME) & 0xFFFFFFFF
+        h = ((h ^ b) * _FNV64_PRIME) & 0xFFFFFFFFFFFFFFFF
     for b in int(uid).to_bytes(8, "big", signed=True):
-        h = ((h ^ b) * _FNV32_PRIME) & 0xFFFFFFFF
-    return h & 0x7FFFFFFF
+        h = ((h ^ b) * _FNV64_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h
 
 
 def vni_from_uid(uid: int) -> int:
@@ -176,7 +181,7 @@ class SimEngine:
         self._row_owner: dict[int, tuple[str, int]] = {}
         self._peer: dict[tuple[str, int], tuple[str, int]] = {}
         self._free: list[int] = list(range(capacity - 1, -1, -1))
-        # row -> stable 31-bit key id (link_key_id of the owning
+        # row -> stable 64-bit key id (link_key_id of the owning
         # (pod_key, uid)): the per-row fold_in constant the shaping
         # kernels key their uniforms by (multi-tenant byte-identity)
         self._row_keyid: dict[int, int] = {}
@@ -883,10 +888,12 @@ class SimEngine:
             self._free = list(range(cap - 1, n - 1, -1))
             if self.tenancy is not None:
                 # contiguous tenant blocks do not survive a global
-                # repack: the registry dissolves its reservations (the
-                # rows just moved into [0, n)) and re-reserves lazily;
-                # per-tenant ACCOUNTING is row-set based via _row_owner
-                # and stays exact through the renumbering
+                # repack: the registry re-carves each tenant's
+                # reservation at its full requested size from the
+                # rebuilt free list (healing on the next compact or
+                # create when it doesn't fit); per-tenant ACCOUNTING
+                # is row-set based via _row_owner and stays exact
+                # through the renumbering
                 self.tenancy.on_compact(mapping)
             # the data plane's next write-back must not resurrect
             # pre-compact dynamic state for any row

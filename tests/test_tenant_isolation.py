@@ -37,7 +37,8 @@ TENANT_PROPS = {
 PAIRS = 2
 
 
-def _build_plane(tenant_names, depth=1, mesh_n=None, seed=0):
+def _build_plane(tenant_names, depth=1, mesh_n=None, seed=0,
+                 props_map=None):
     """One plane hosting `tenant_names`' topologies (uids and pod
     names are GLOBAL — identical between cohabited and solo builds, so
     link identities match). Returns (plane, {tenant: (wins, wouts)})."""
@@ -45,13 +46,14 @@ def _build_plane(tenant_names, depth=1, mesh_n=None, seed=0):
     from kubedtn_tpu.wire import proto as pb
     from kubedtn_tpu.wire.server import Daemon
 
+    props_map = props_map or TENANT_PROPS
     store = TopologyStore()
-    engine = SimEngine(store, capacity=4 * PAIRS * len(TENANT_PROPS) + 8)
+    engine = SimEngine(store, capacity=4 * PAIRS * len(props_map) + 8)
     registry = TenantRegistry(engine)
-    all_names = sorted(TENANT_PROPS)
+    all_names = sorted(props_map)
     for ns in tenant_names:
         registry.create(ns)
-        props = TENANT_PROPS[ns]
+        props = props_map[ns]
         base_uid = all_names.index(ns) * PAIRS  # global uid space
         for i in range(PAIRS):
             uid = base_uid + i + 1
@@ -97,22 +99,27 @@ def _tagged(ns, wire_i, j, size=64):
 
 
 def _run(tenant_names, depth=1, mesh_n=None, ticks=40,
-         frames_per_tick=3):
+         frames_per_tick=3, props_map=None):
     """Deterministic schedule: every tenant's every ingress wire gets
-    `frames_per_tick` frames EVERY tick, so the cohabited and solo
-    planes dispatch on the same ticks (same key chain)."""
+    `frames_per_tick` frames EVERY tick (an int, or a per-tenant dict
+    so an aggressor can burst while the victim's schedule stays
+    identical to its solo run), so the cohabited and solo planes
+    dispatch on the same ticks (same key chain)."""
+    fpt = (frames_per_tick if isinstance(frames_per_tick, dict)
+           else {ns: frames_per_tick for ns in tenant_names})
     plane, registry, wires = _build_plane(tenant_names, depth=depth,
-                                          mesh_n=mesh_n)
+                                          mesh_n=mesh_n,
+                                          props_map=props_map)
     t = 100.0
     dt = 0.002
-    j = 0
+    j = {ns: 0 for ns in tenant_names}
     for _ in range(ticks):
         for ns in tenant_names:
             win, _ = wires[ns]
             for k, w in enumerate(win):
-                w.ingress.extend(_tagged(ns, k, j + n)
-                                 for n in range(frames_per_tick))
-        j += frames_per_tick
+                w.ingress.extend(_tagged(ns, k, j[ns] + n)
+                                 for n in range(fpt[ns]))
+            j[ns] += fpt[ns]
         t += dt
         plane.tick(now_s=t)
     # drain the tail deterministically
@@ -146,6 +153,28 @@ def test_cohabited_vs_solo_byte_identical(depth):
         assert co_del[ns] == so_del[ns], f"tenant {ns} byte stream"
         np.testing.assert_array_equal(co_tel[ns], so_tel[ns])
         assert co_cnt[ns] == so_cnt[ns]
+
+
+def test_pad_bucket_crossing_aggressor_keeps_victim_identical():
+    """An aggressor in the SAME kernel class bursting across a
+    _pad_slots bucket (5 frames/tick pads K to 16; the victim's solo
+    plane pads its 3 to 4) must not perturb the victim: each slot's
+    uniforms come from a per-(row, slot) fold_in key, never from a
+    K-shaped per-row draw whose bits shift with the batch's padded
+    slot count. This is the noisy-neighbor case the headline
+    byte-identity contract advertises — a constant-K schedule (the
+    other tests here) cannot catch a regression in it."""
+    props = {"agg": TENANT_PROPS["t0"], "vic": TENANT_PROPS["t0"]}
+    for depth in (1, 2):
+        co_del, co_tel, co_cnt = _run(
+            ["agg", "vic"], depth=depth, props_map=props,
+            frames_per_tick={"agg": 5, "vic": 3})
+        so_del, so_tel, so_cnt = _run(
+            ["vic"], depth=depth, props_map=props,
+            frames_per_tick={"vic": 3})
+        assert co_del["vic"] == so_del["vic"], f"victim bytes d{depth}"
+        np.testing.assert_array_equal(co_tel["vic"], so_tel["vic"])
+        assert co_cnt["vic"] == so_cnt["vic"]
 
 
 def test_cohabited_mesh8_vs_solo_unsharded():
