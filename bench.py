@@ -746,6 +746,28 @@ def main() -> None:
                 "victim_unharmed", "in_guardrails", "tick_errors")
             if k in r}
 
+    def run_migration_under_flap():
+        # federation evidence: a live tenant migration lands while the
+        # src→dst peer breaker cycles — must complete (or roll back)
+        # with frames_lost == 0, byte-exact fed == delivered_src +
+        # delivered_dst accounting, window-ring totals agreeing with
+        # the counter slices on both planes, and the
+        # accounting-mismatch gauge at 0. Process-isolated like the
+        # other live phases.
+        r = _isolated_scenario("migration_under_flap", {
+            "pairs": 2,
+            "seconds": 4.0 if degraded else 6.0,
+            "offered_frames_per_s": 2_000 if degraded else 4_000})
+        extras["migration_under_flap"] = {
+            k: r[k] for k in (
+                "pairs", "seconds", "flap_hz", "offered_frames_per_s",
+                "outcome", "steps_done", "resumed", "frames_fed",
+                "frames_delivered", "frames_lost",
+                "transferred_frames", "accounting",
+                "accounting_mismatch_gauge", "ring_totals_agree",
+                "step_seconds", "breaker_cycles", "tick_errors",
+                "in_guardrails") if k in r}
+
     def run_telemetry_overhead():
         # observability cost evidence: the SAME plane-only workload
         # with the link-telemetry window ring + flight recorder off vs
@@ -893,6 +915,7 @@ def main() -> None:
     phase("staged_update_soak", run_staged_update_soak)
     phase("tenant_soak", run_tenant_soak)
     phase("noisy_neighbor", run_noisy_neighbor)
+    phase("migration_under_flap", run_migration_under_flap)
     phase("telemetry_overhead", run_telemetry_overhead)
     phase("whatif_sweep", run_whatif_sweep)
     phase("reconverge_10k", run_reconverge_10k)

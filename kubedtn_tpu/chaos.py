@@ -108,8 +108,14 @@ class ChaosInjector:
         self._fail_next_dispatches = 0
         self._fail_every_k = 0
         self._dispatch_seen = 0
+        # migration-step failure plan: step name -> remaining failures
+        # (the federation state machine calls on_migration_step at each
+        # step's crash window — after its side effects, before the
+        # journal commit — so an injected failure is exactly a daemon
+        # dying mid-step)
+        self._fail_steps: dict[str, int] = {}
         self.injected = {"peer_blackhole": 0, "peer_latency": 0,
-                         "dispatch": 0, "checkpoint": 0}
+                         "dispatch": 0, "checkpoint": 0, "migration": 0}
 
     # -- peer faults ---------------------------------------------------
 
@@ -201,6 +207,30 @@ class ChaosInjector:
             self.injected["dispatch"] += 1
             raise ChaosError(
                 f"chaos: forced dispatch failure #{self.injected['dispatch']}")
+
+    # -- migration-step faults ----------------------------------------
+
+    def fail_migration_step(self, step: str, times: int = 1) -> None:
+        """Arm a failure at the named migration step (throttle | fork |
+        restore | cutover | reconcile | release): the next `times`
+        times the state machine reaches that step's crash window —
+        side effects applied, journal commit NOT yet written — it
+        raises, modeling a daemon crash at the worst instant of that
+        step."""
+        with self._lock:
+            self._fail_steps[step] = self._fail_steps.get(step, 0) \
+                + int(times)
+
+    def on_migration_step(self, step: str) -> None:
+        """Hook the migration coordinator calls inside every step."""
+        with self._lock:
+            left = self._fail_steps.get(step, 0)
+            if left <= 0:
+                return
+            self._fail_steps[step] = left - 1
+            self.injected["migration"] += 1
+        raise ChaosError(f"chaos: forced migration failure at "
+                         f"step {step!r}")
 
     # -- checkpoint faults --------------------------------------------
 

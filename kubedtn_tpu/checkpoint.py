@@ -393,6 +393,22 @@ def _save_traced(path: str, store: TopologyStore, engine: SimEngine,
             "has_sim": sim is not None,
             "checksums": checksums,
         }
+        tenancy = getattr(engine, "tenancy", None)
+        if tenancy is not None:
+            # quotas / QoS / block entitlements / namespace bindings
+            # survive the restart (load_tenancy) — without this section
+            # a restart silently reset every tenant to unenforced,
+            # which the federation RELEASE/rollback paths must never
+            # rely on
+            manifest["tenancy"] = tenancy.export_config()
+            # reservations are registry state re-carved at restore: the
+            # persisted free list must include the blocks' unused rows,
+            # or each restart would leak them (gone from the global
+            # pool AND from the new blocks). A tenancy-less load keeps
+            # them in the global pool — also correct.
+            manifest["engine"]["free"] = (
+                engine._free + sorted(tenancy.reserved_free_rows(),
+                                      reverse=True))
         mpath = os.path.join(tmp, "manifest.json")
         with open(mpath, "w") as f:
             json.dump(manifest, f)
@@ -579,6 +595,42 @@ def consume_pending(path: str) -> None:
     p = os.path.join(dirpath, "pending_frames.npz")
     if os.path.exists(p):
         os.remove(p)
+
+
+def load_tenancy(path: str, engine: SimEngine):
+    """Rebuild the TenantRegistry from a checkpoint's tenancy section
+    against a restored engine: quotas, QoS class, namespace bindings,
+    admitted meters, and each tenant's `block_rows` entitlement (the
+    block re-carves from the restored free list — same rows when the
+    layout is unchanged; the ENTITLEMENT, not the position, is the
+    contract). None when the checkpoint (or its tenancy section)
+    doesn't exist — the caller then starts an empty registry;
+    corruption and unsupported formats raise like the other loaders."""
+    try:
+        _dirpath, manifest = _resolve_dir(os.path.abspath(path))
+    except CheckpointMissingError:
+        return None
+    section = manifest.get("tenancy")
+    if section is None:
+        return None
+    from kubedtn_tpu.tenancy import TenantRegistry
+
+    try:
+        registry = TenantRegistry(
+            engine, default_qos=section.get("default_qos", "gold"))
+        for t in section.get("tenants", ()):
+            won = registry.create(
+                t["name"], qos=t.get("qos"),
+                frame_budget_per_s=t.get("frame_budget_per_s"),
+                byte_budget_per_s=t.get("byte_budget_per_s"),
+                block_edges=int(t.get("block_rows", 0)),
+                namespaces=t.get("namespaces"))
+            won.admitted_frames = int(t.get("admitted_frames", 0))
+            won.admitted_bytes = int(t.get("admitted_bytes", 0))
+    except (KeyError, TypeError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"malformed tenancy section in {path}: {e}") from e
+    return registry
 
 
 def load_sim(path: str, engine: SimEngine):

@@ -444,6 +444,18 @@ def cmd_tenant(args) -> int:
             print(json.dumps(_json_safe(
                 {"tenants": [info_dict(t) for t in resp.tenants]})))
             return 0
+        if args.action == "delete":
+            if not args.name:
+                print("tenant delete needs a tenant name",
+                      file=sys.stderr)
+                return 1
+            resp = client.TenantDelete(pb.TenantQuery(name=args.name),
+                                       timeout=args.timeout)
+            if not resp.ok:
+                print(f"tenant delete: {resp.error}", file=sys.stderr)
+                return 1
+            print(json.dumps({"deleted": args.name}))
+            return 0
         # stats
         if not args.name:
             print("tenant stats needs a tenant name", file=sys.stderr)
@@ -476,6 +488,67 @@ def cmd_tenant(args) -> int:
         return 0
     except grpc.RpcError as e:
         print(f"tenant: daemon {args.daemon} RPC failed: "
+              f"{_rpc_code(e)}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+
+
+def cmd_migrate(args) -> int:
+    """`kdt migrate` — live tenant migration between federation planes
+    (Local.MigrateTenant), plus `--status` over the journaled records
+    (Local.MigrationStatus). Zero-loss: the state machine throttles,
+    forks, restores, cuts over make-before-break and reconciles
+    byte-exact delivery accounting across the move."""
+    import grpc
+
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+
+    client = DaemonClient(args.daemon)
+
+    def info_dict(m) -> dict:
+        return {
+            "migration_id": m.migration_id, "tenant": m.tenant,
+            "src": m.src, "dst": m.dst, "state": m.state,
+            "steps_done": list(m.steps_done),
+            "resumed": m.resumed, "rollbacks": m.rollbacks,
+            "transferred_frames": m.transferred_frames,
+            "delivered_src_frames": m.delivered_src_frames,
+            "delivered_src_bytes": m.delivered_src_bytes,
+        }
+
+    try:
+        if args.status:
+            resp = client.MigrationStatus(pb.MigrationStatusRequest(
+                migration_id=args.migration_id, tenant=args.tenant),
+                timeout=args.timeout)
+            if not resp.ok:
+                print(f"migrate status: {resp.error}", file=sys.stderr)
+                return 1
+            print(json.dumps(_json_safe(
+                {"migrations": [info_dict(m)
+                                for m in resp.migrations]})))
+            return 0
+        if args.resume:
+            if not args.migration_id:
+                print("migrate --resume needs --id", file=sys.stderr)
+                return 1
+        elif not (args.tenant and args.dst):
+            print("migrate needs a tenant and --dst", file=sys.stderr)
+            return 1
+        resp = client.MigrateTenant(pb.MigrateRequest(
+            tenant=args.tenant, src=args.src, dst=args.dst,
+            migration_id=args.migration_id, resume=args.resume,
+            reconcile_timeout_s=max(1.0, args.timeout - 5.0)),
+            timeout=args.timeout)
+        if not resp.ok:
+            print(f"migrate: {resp.error}", file=sys.stderr)
+            return 1
+        print(json.dumps(_json_safe(info_dict(resp.migration))))
+        return 0
+    except grpc.RpcError as e:
+        print(f"migrate: daemon {args.daemon} RPC failed: "
               f"{_rpc_code(e)}", file=sys.stderr)
         return 1
     finally:
@@ -598,9 +671,43 @@ def cmd_daemon(args) -> int:
 
     # multi-tenant serving plane: namespace→tenant mapping, admission
     # buckets, QoS drain weights, Local.Tenant* RPCs (empty registry =
-    # zero enforcement until `kdt tenant create` tightens quotas)
-    tenancy = TenantRegistry(engine)
+    # zero enforcement until `kdt tenant create` tightens quotas).
+    # A checkpointed registry restores quotas / QoS / block
+    # entitlements / namespace bindings so a restart never silently
+    # resets tenants to unenforced.
+    tenancy = None
+    if ckpt_dir:
+        from kubedtn_tpu import checkpoint as _ckpt
+
+        try:
+            tenancy = _ckpt.load_tenancy(ckpt_dir, engine)
+        except _ckpt.CheckpointError:
+            log.exception("tenancy restore failed; starting with an "
+                          "empty registry %s", fields(path=ckpt_dir))
+        else:
+            if tenancy is not None:
+                log.info("tenant registry restored %s", fields(
+                    tenants=len(tenancy.list())))
+    if tenancy is None:
+        tenancy = TenantRegistry(engine)
     dataplane.attach_tenancy(tenancy)
+    # federation: this plane registers with a controller so
+    # Local.MigrateTenant / MigrationStatus (and `kdt migrate`) can
+    # move tenants between planes registered in this process
+    from kubedtn_tpu.federation import (FederationController,
+                                        PlaneHandle)
+    from kubedtn_tpu.federation import stats_for as migration_stats_for
+
+    journal_root = (getattr(args, "migration_journal", None)
+                    or (os.path.join(ckpt_dir, "migrations")
+                        if ckpt_dir else
+                        os.path.join(os.path.expanduser("~"), ".cache",
+                                     "kubedtn-migrations")))
+    migration_stats = migration_stats_for(daemon)
+    federation = FederationController(journal_root,
+                                      stats=migration_stats)
+    federation.register(PlaneHandle(name=args.node_ip, daemon=daemon,
+                                    plane=dataplane, registry=tenancy))
     if not getattr(args, "no_telemetry", False):
         # link telemetry plane: per-edge window ring + sampled flight
         # recorder, riding the fused tick (no extra device dispatch)
@@ -662,7 +769,8 @@ def cmd_daemon(args) -> int:
                                    dataplane=dataplane,
                                    whatif_stats=stats_for(daemon),
                                    update_stats=update_stats_for(daemon),
-                                   tenancy=tenancy)
+                                   tenancy=tenancy,
+                                   migration_stats=migration_stats)
     engine.stats.observer = hist
     daemon.hist = hist
     server, port = make_server(daemon, port=args.port)
@@ -1241,7 +1349,8 @@ def main(argv=None) -> int:
         help="multi-tenant plane: create/list/quota/stats against a "
              "live daemon (Local.Tenant*)")
     tnp.add_argument("action",
-                     choices=("create", "list", "quota", "stats"))
+                     choices=("create", "list", "quota", "stats",
+                              "delete"))
     tnp.add_argument("name", nargs="?", default="")
     tnp.add_argument("--daemon", default="127.0.0.1:51111",
                      metavar="HOST:PORT")
@@ -1307,7 +1416,36 @@ def main(argv=None) -> int:
     dp.add_argument("--jax-profile", default=None, metavar="DIR",
                     help="opt-in jax.profiler device capture for the "
                          "daemon's lifetime (TensorBoard-loadable)")
+    dp.add_argument("--migration-journal", default=None, metavar="DIR",
+                    help="journal root for live tenant migrations "
+                         "(default: <checkpoint-dir>/migrations, or "
+                         "~/.cache/kubedtn-migrations)")
     dp.set_defaults(fn=cmd_daemon)
+
+    mgp = sub.add_parser(
+        "migrate",
+        help="live tenant migration between federation planes "
+             "(Local.MigrateTenant / Local.MigrationStatus)")
+    mgp.add_argument("tenant", nargs="?", default="")
+    mgp.add_argument("--daemon", default="127.0.0.1:51111",
+                     metavar="HOST:PORT",
+                     help="daemon whose federation controller runs "
+                          "the migration")
+    mgp.add_argument("--src", default="",
+                     help="source plane name (default: the serving "
+                          "daemon's own plane)")
+    mgp.add_argument("--dst", default="",
+                     help="destination plane name")
+    mgp.add_argument("--id", dest="migration_id", default="",
+                     help="migration id (with --resume / --status)")
+    mgp.add_argument("--resume", action="store_true",
+                     help="resume the journaled migration named by "
+                          "--id instead of starting a new one")
+    mgp.add_argument("--status", action="store_true",
+                     help="list journaled migrations (optionally "
+                          "filtered by tenant / --id)")
+    mgp.add_argument("--timeout", type=float, default=60.0)
+    mgp.set_defaults(fn=cmd_migrate)
 
     pcp = sub.add_parser("pcap", help="summarize a capture file")
     pcp.add_argument("file")

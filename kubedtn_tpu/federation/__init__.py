@@ -1,0 +1,19 @@
+"""Federated serving planes with zero-loss live tenant migration.
+
+Many daemons — each a multi-tenant (optionally sharded) plane — under
+a placement layer that moves tenants between them without losing a
+frame. See federation.migrate for the crash-safe migration state
+machine and federation.journal for its checkpoint-grade record
+persistence; ARCHITECTURE.md "Federation & live migration" documents
+the per-step crash contract and the accounting-reconciliation rule.
+"""
+
+from kubedtn_tpu.federation.migrate import (STEPS, FederationController,
+                                            MigrationCoordinator,
+                                            MigrationError,
+                                            MigrationStats, PlaneHandle,
+                                            stats_for)
+
+__all__ = ["STEPS", "FederationController", "MigrationCoordinator",
+           "MigrationError", "MigrationStats", "PlaneHandle",
+           "stats_for"]

@@ -601,6 +601,52 @@ class UpdateStatsCollector:
         return out
 
 
+class MigrationStatsCollector:
+    """kubedtn_migration_* series — observability for the federation
+    layer's live tenant migrations (kubedtn_tpu.federation): volume
+    and outcomes (attempts / completed / rolled_back / resumed), wall
+    seconds per state-machine step, bytes whose delivery accounting
+    reconciled across the move, and the alert-worthy gauge
+    `kubedtn_migration_accounting_mismatch` — |fed − (delivered_src +
+    delivered_dst)| of the latest reconciliation check, which must
+    stay 0 in every scenario."""
+
+    COUNTERS = (
+        ("attempts", "Live tenant migrations attempted"),
+        ("completed", "Migrations that reached RELEASE"),
+        ("rolled_back", "Migrations rolled back to src"),
+        ("resumed", "Migrations resumed from their journal"),
+        ("bytes_reconciled",
+         "Delivered bytes covered by a byte-exact src+dst "
+         "reconciliation"),
+    )
+
+    def __init__(self, stats) -> None:
+        self._stats = stats
+
+    def collect(self):
+        snap = self._stats.snapshot()
+        out = []
+        for name, doc in self.COUNTERS:
+            c = CounterMetricFamily(f"kubedtn_migration_{name}", doc)
+            c.add_metric([], float(snap[name]))
+            out.append(c)
+        steps = CounterMetricFamily(
+            "kubedtn_migration_step_seconds",
+            "Wall seconds spent per migration state-machine step",
+            labels=["step"])
+        for step, s in sorted(snap["step_seconds"].items()):
+            steps.add_metric([step], float(s))
+        out.append(steps)
+        g = GaugeMetricFamily(
+            "kubedtn_migration_accounting_mismatch",
+            "|fed - (delivered_src + delivered_dst)| of the latest "
+            "accounting reconciliation (alert when nonzero)")
+        g.add_metric([], float(snap["accounting_mismatch"]))
+        out.append(g)
+        return out
+
+
 class MetricsServer:
     """Serves the registry on an HTTP port — the daemon's :51112/metrics
     endpoint (reference daemon/main.go:57-66)."""
@@ -659,7 +705,7 @@ class MetricsServer:
 def make_registry(engine=None, sim_counters_fn=None,
                   max_interfaces: int = 10_000, dataplane=None,
                   whatif_stats=None, update_stats=None, tenancy=None,
-                  max_tenants: int = 256):
+                  max_tenants: int = 256, migration_stats=None):
     """Registry with the parity collectors installed."""
     registry = CollectorRegistry()
     hist = LatencyHistograms(registry)
@@ -678,4 +724,6 @@ def make_registry(engine=None, sim_counters_fn=None,
     if tenancy is not None:
         registry.register(TenantStatsCollector(
             tenancy, dataplane, max_tenants=max_tenants))
+    if migration_stats is not None:
+        registry.register(MigrationStatsCollector(migration_stats))
     return registry, hist

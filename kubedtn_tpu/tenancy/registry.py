@@ -99,6 +99,12 @@ class TenantRegistry:
         self._lock = threading.RLock()
         self._tenants: dict[str, Tenant] = {}
         self._ns_map: dict[str, str] = {}  # namespace -> tenant name
+        # tenants under a migration hold: drain budget 0 this tick,
+        # frames queue (never dropped) — the THROTTLE clamp of the
+        # federation migration state machine (process state, not
+        # persisted: a restarted daemon resumes the migration from its
+        # journal, which re-applies the hold)
+        self._holds: set[str] = set()
         # per-tenant row-set cache, invalidated by engine._rows_gen
         self._rows_cache: dict[str, np.ndarray] = {}
         self._rows_cache_gen: int = -1
@@ -295,6 +301,76 @@ class TenantRegistry:
                 return self._tenants.get(name)
         return self.create(namespace)
 
+    def hold(self, name: str) -> None:
+        """Migration hold: the tenant's wires get drain budget 0 every
+        tick (typed "migration-hold" verdicts, frames kept queued —
+        the daemon's ingress high-water backpressure bounds the
+        backlog). Idempotent; quotas are untouched."""
+        with self._lock:
+            self._holds.add(name)
+
+    def release_hold(self, name: str) -> None:
+        with self._lock:
+            self._holds.discard(name)
+
+    def held(self, name: str) -> bool:
+        with self._lock:
+            return name in self._holds
+
+    def delete(self, name: str) -> bool:
+        """Deregister a tenant: unbind its namespaces, dissolve its
+        reserved block (unused reserve rows return to the GLOBAL free
+        list; rows still realized inside the former block stay bound to
+        their links and drain back to the global pool as they free),
+        and drop the registry entry. Admission/QoS enforcement for the
+        namespaces ends immediately; accounting row sets are registry-
+        derived, so the next `rows_of` of a recreated tenant is exact.
+        Idempotent — False when the tenant does not exist. (The
+        federation RELEASE step and `kdt tenant delete` both land
+        here.)"""
+        engine = self.engine
+        with engine._lock:
+            with self._lock:
+                t = self._tenants.pop(name, None)
+                if t is None:
+                    return False
+                for ns in list(t.namespaces):
+                    if self._ns_map.get(ns) == name:
+                        del self._ns_map[ns]
+                self._holds.discard(name)
+                freed = list(t.block_free)
+                t.block = None
+                t.block_free = []
+                self._rows_cache_gen = -1
+            if freed:
+                # descending like the global pool: consecutive pops
+                # keep handing out consecutive rows
+                engine._free.extend(sorted(freed, reverse=True))
+        self.log.info("tenant deleted %s", _fields(
+            tenant=name, freed_reserve=len(freed)))
+        return True
+
+    def export_config(self) -> dict:
+        """The tenancy section of a checkpoint manifest: quotas, QoS,
+        block entitlement (`block_rows` — the reservation re-carves at
+        restore, position is an allocation detail), namespace bindings
+        and admitted meters. Restored by `checkpoint.load_tenancy` so
+        a daemon restart never silently resets tenants to unenforced."""
+        with self._lock:
+            return {
+                "default_qos": self.default_qos,
+                "tenants": [{
+                    "name": t.name,
+                    "qos": t.qos,
+                    "frame_budget_per_s": t.frame_budget_per_s,
+                    "byte_budget_per_s": t.byte_budget_per_s,
+                    "block_rows": int(t.block_rows),
+                    "namespaces": sorted(t.namespaces),
+                    "admitted_frames": int(t.admitted_frames),
+                    "admitted_bytes": int(t.admitted_bytes),
+                } for t in self._tenants.values()],
+            }
+
     def get(self, name: str) -> Tenant | None:
         with self._lock:
             return self._tenants.get(name)
@@ -336,6 +412,20 @@ class TenantRegistry:
         with self._lock:
             return sum(len(t.block_free)
                        for t in self._tenants.values())
+
+    def reserved_free_rows(self) -> list[int]:
+        """Every unused row currently held inside a tenant block. The
+        checkpoint writer folds these back into the SAVED free list:
+        a reservation is registry state re-carved at restore
+        (`load_or_rebuild` → `load_tenancy`), so leaving the rows out
+        of the persisted pool would leak them — absent from the global
+        free list AND from the freshly-carved blocks — on every
+        restart."""
+        with self._lock:
+            out: list[int] = []
+            for t in self._tenants.values():
+                out.extend(t.block_free)
+            return out
 
     def on_compact(self, mapping: dict) -> None:
         """compact() renumbered every row: the old contiguous blocks
@@ -394,7 +484,11 @@ class TenantRegistry:
         with self._lock:
             snap = {}
             for name, t in self._tenants.items():
-                if not t.bucket_frames.ok(now_s):
+                if name in self._holds:
+                    # migration hold: frames queue on their wires until
+                    # the cutover redirects (or a rollback releases)
+                    snap[name] = (0, "migration-hold")
+                elif not t.bucket_frames.ok(now_s):
                     snap[name] = (0, "frame-budget")
                 elif not t.bucket_bytes.ok(now_s):
                     snap[name] = (0, "byte-budget")

@@ -352,6 +352,48 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
         _field("p50_us", 18, D),           # -1 = unknown/empty
         _field("p99_us", 19, D),
     ))
+    # Framework extension (absent from reference kube_dtn.proto): the
+    # federation surface (kubedtn_tpu.federation) — live tenant
+    # migration between planes, with journaled crash-safe state and
+    # byte-exact accounting reconciliation. Reference clients never
+    # see these types.
+    f.message_type.append(_msg(
+        "MigrateRequest",
+        _field("tenant", 1, S),
+        _field("src", 2, S),            # empty = the serving daemon
+        _field("dst", 3, S),
+        _field("migration_id", 4, S),   # empty = allocate
+        _field("resume", 5, B),         # resume migration_id instead
+        _field("reconcile_timeout_s", 6, D),
+    ))
+    f.message_type.append(_msg(
+        "MigrationInfo",
+        _field("migration_id", 1, S),
+        _field("tenant", 2, S),
+        _field("src", 3, S), _field("dst", 4, S),
+        _field("state", 5, S),          # running|done|rolled_back
+        _field("steps_done", 6, S, REP),
+        _field("resumed", 7, I32),
+        _field("rollbacks", 8, I32),
+        _field("transferred_frames", 9, I64),
+        _field("delivered_src_frames", 10, D),
+        _field("delivered_src_bytes", 11, D),
+    ))
+    f.message_type.append(_msg(
+        "MigrateResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("migration", 3, None, type_name="MigrationInfo"),
+    ))
+    f.message_type.append(_msg(
+        "MigrationStatusRequest",
+        _field("migration_id", 1, S),   # empty = all
+        _field("tenant", 2, S),         # filter
+    ))
+    f.message_type.append(_msg(
+        "MigrationStatusResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("migrations", 3, None, REP, type_name="MigrationInfo"),
+    ))
     return f
 
 
@@ -374,7 +416,9 @@ for _name in ("LinkProperties", "Link", "Pod", "PodQuery",
               "ApplyPlanRequest", "ApplyPlanResponse",
               "TenantSpec", "TenantQuery", "TenantInfo",
               "TenantResponse", "TenantListResponse",
-              "TenantStatsResponse"):
+              "TenantStatsResponse",
+              "MigrateRequest", "MigrationInfo", "MigrateResponse",
+              "MigrationStatusRequest", "MigrationStatusResponse"):
     _MESSAGES[_name] = message_factory.GetMessageClass(
         _pool.FindMessageTypeByName(f"{PACKAGE}.{_name}"))
 
@@ -416,6 +460,11 @@ TenantInfo = _MESSAGES["TenantInfo"]
 TenantResponse = _MESSAGES["TenantResponse"]
 TenantListResponse = _MESSAGES["TenantListResponse"]
 TenantStatsResponse = _MESSAGES["TenantStatsResponse"]
+MigrateRequest = _MESSAGES["MigrateRequest"]
+MigrationInfo = _MESSAGES["MigrationInfo"]
+MigrateResponse = _MESSAGES["MigrateResponse"]
+MigrationStatusRequest = _MESSAGES["MigrationStatusRequest"]
+MigrationStatusResponse = _MESSAGES["MigrationStatusResponse"]
 
 # Service method tables: name -> (request class, response class, streaming)
 LOCAL_METHODS = {
@@ -451,6 +500,13 @@ LOCAL_METHODS = {
     "TenantList": (TenantQuery, TenantListResponse, False),
     "TenantQuota": (TenantSpec, TenantResponse, False),
     "TenantStats": (TenantQuery, TenantStatsResponse, False),
+    "TenantDelete": (TenantQuery, TenantResponse, False),
+    # Framework extensions: federated planes — live tenant migration
+    # with journaled crash-safe state (kubedtn_tpu.federation; not in
+    # the reference IDL)
+    "MigrateTenant": (MigrateRequest, MigrateResponse, False),
+    "MigrationStatus": (MigrationStatusRequest,
+                        MigrationStatusResponse, False),
 }
 REMOTE_METHODS = {
     "Update": (RemotePod, BoolResponse, False),

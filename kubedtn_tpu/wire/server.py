@@ -322,6 +322,18 @@ class WireManager:
     def get_by_key(self, pod_key: str, uid: int) -> Wire | None:
         return self._by_key.get((pod_key, uid))
 
+    def delete_by_key(self, pod_key: str, uid: int) -> bool:
+        """Remove ONE wire by its (pod, uid) identity — the federation
+        undo path deletes exactly the wires a migration restore
+        created, never a neighbor wire that happens to share the
+        namespace."""
+        with self._lock:
+            wire = self._by_key.pop((pod_key, uid), None)
+            if wire is None:
+                return False
+            self._by_id.pop(wire.wire_id, None)
+            return True
+
     def delete_by_pod(self, pod_key: str) -> int:
         with self._lock:
             doomed = [w for w in self._by_id.values()
@@ -358,6 +370,10 @@ class Daemon:
         # Local.Tenant* RPC surface answers from it (absent = the
         # RPCs answer ok=False "tenancy not enabled")
         self.tenancy = None
+        # federation.FederationController installed by its register():
+        # the Local.MigrateTenant / MigrationStatus RPC surface (absent
+        # = the RPCs answer ok=False "federation not enabled")
+        self.federation = None
         self.wires = WireManager(on_ingress=self.mark_hot)
         self.hist = latency_histograms
         # deadline on per-frame peer forwards: a blackholed peer must cost
@@ -698,6 +714,86 @@ class Daemon:
             bytes_ps=float(win.get("bytes_ps", 0.0)),
             p50_us=nn(win.get("p50_us")),
             p99_us=nn(win.get("p99_us")))
+
+    def TenantDelete(self, request, context):
+        """Deregister a tenant: free its reserved block, unbind its
+        namespaces, end admission/QoS enforcement (the tenant's
+        realized links are untouched — DestroyPod owns pod lifecycle).
+        Needed by the federation RELEASE step; ok=False on an unknown
+        name."""
+        reg = self.tenancy
+        if reg is None:
+            return pb.TenantResponse(
+                ok=False, error="tenancy not enabled on this daemon")
+        t = reg.get(request.name)
+        if t is None or not reg.delete(request.name):
+            return pb.TenantResponse(
+                ok=False, error=f"unknown tenant {request.name!r}")
+        return pb.TenantResponse(ok=True, tenant=pb.TenantInfo(
+            name=t.name, qos=t.qos, namespaces=sorted(t.namespaces)))
+
+    # -- federation (framework extension: kubedtn_tpu.federation) ------
+
+    @staticmethod
+    def _migration_info(rec: dict) -> "pb.MigrationInfo":
+        rc = rec.get("reconcile") or {}
+        return pb.MigrationInfo(
+            migration_id=rec.get("migration_id", ""),
+            tenant=rec.get("tenant", ""),
+            src=rec.get("src", ""), dst=rec.get("dst", ""),
+            state=rec.get("state", ""),
+            steps_done=list(rec.get("steps_done", ())),
+            resumed=int(rec.get("resumed", 0)),
+            rollbacks=int(rec.get("rollbacks", 0)),
+            transferred_frames=int(
+                (rec.get("cutover") or {}).get("transferred_frames",
+                                               0)),
+            delivered_src_frames=float(
+                rc.get("delivered_src_frames", 0.0)),
+            delivered_src_bytes=float(
+                rc.get("delivered_src_bytes", 0.0)))
+
+    def MigrateTenant(self, request, context):
+        """Run (or resume) a live tenant migration between two planes
+        registered with this daemon's federation controller. The RPC
+        is synchronous — migrations are barrier-scale except for the
+        RECONCILE drain, which the request's timeout bounds."""
+        fed = self.federation
+        if fed is None:
+            return pb.MigrateResponse(
+                ok=False, error="federation not enabled on this daemon")
+        from kubedtn_tpu.chaos import ChaosError
+        from kubedtn_tpu.federation import MigrationError
+        from kubedtn_tpu.federation.journal import JournalError
+
+        try:
+            if request.resume:
+                rec = fed.resume(request.migration_id)
+            else:
+                # empty src defaults to the plane this daemon serves
+                src = request.src or fed.plane_name_of(self)
+                rec = fed.migrate(
+                    request.tenant, src, request.dst,
+                    migration_id=request.migration_id or None,
+                    reconcile_timeout_s=float(
+                        request.reconcile_timeout_s) or 30.0)
+        except (MigrationError, JournalError, ChaosError, KeyError,
+                ValueError) as e:
+            return pb.MigrateResponse(
+                ok=False, error=f"{type(e).__name__}: {e}")
+        return pb.MigrateResponse(ok=True,
+                                  migration=self._migration_info(rec))
+
+    def MigrationStatus(self, request, context):
+        fed = self.federation
+        if fed is None:
+            return pb.MigrationStatusResponse(
+                ok=False, error="federation not enabled on this daemon")
+        recs = fed.status(migration_id=request.migration_id,
+                          tenant=request.tenant)
+        return pb.MigrationStatusResponse(
+            ok=True,
+            migrations=[self._migration_info(r) for r in recs])
 
     # -- Remote --------------------------------------------------------
 
