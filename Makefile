@@ -26,8 +26,8 @@ typecheck:                 ## strict types over the contract core (when installe
 		echo "pyright/mypy not installed; configs live in pyproject.toml"; \
 	fi
 
-verify: typecheck native   ## both analysis layers + types, then tier-1
-	$(PY) -m kubedtn_tpu.analysis --verify --json ANALYSIS.json
+verify: typecheck native   ## all three analysis layers + types, then tier-1
+	$(PY) -m kubedtn_tpu.analysis --verify --scale --json ANALYSIS.json
 	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
 		$(PY) -m ruff check kubedtn_tpu tests bench.py; \
 	else \
@@ -35,8 +35,8 @@ verify: typecheck native   ## both analysis layers + types, then tier-1
 	fi
 	$(PY) -m pytest tests/ -q -m "not slow"
 
-verify-fast:               ## pre-commit gate: dtnlint + dtnverify, no pytest
-	$(PY) -m kubedtn_tpu.analysis --verify --cached -q --json ANALYSIS.json
+verify-fast:               ## pre-commit gate: dtnlint + dtnverify + dtnscale (cached), no pytest
+	$(PY) -m kubedtn_tpu.analysis --verify --scale --cached -q --json ANALYSIS.json
 
 test: native               ## full suite (CPU, virtual 8-device mesh)
 	$(PY) -m pytest tests/ -q

@@ -850,6 +850,51 @@ def main() -> None:
                 for name, ep in j.get("entry_points", {}).items()},
         }
 
+    def run_host_scale():
+        # dtnscale empirical half at bench scale: the same probe the
+        # tier-1 smoke runs at small sizes, here at 10k/100k/1M rows
+        # in a FRESH subprocess (a 1M-row engine's arrays + allocator
+        # high-water must not ballast later phases). Fitted host-path
+        # slopes land in the record next to the SCALE_BUDGET.json
+        # ceilings, so the host-scalability trajectory is readable
+        # from the BENCH_r* series like the device-cost one.
+        sizes = ([10_000, 50_000] if degraded
+                 else [10_000, 100_000, 1_000_000])
+        src = ("import json, sys\n"
+               "from kubedtn_tpu.analysis.scale.probe import run_probe\n"
+               "print('___RESULT___' + json.dumps("
+               "run_probe(json.loads(sys.argv[1]))))\n")
+        p = subprocess.run(
+            [sys.executable, "-c", src, json.dumps(sizes)],
+            capture_output=True, text=True, timeout=1800.0,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        r = None
+        for line in reversed(p.stdout.splitlines()):
+            if line.startswith("___RESULT___"):
+                r = json.loads(line[len("___RESULT___"):])
+                break
+        if r is None:
+            raise RuntimeError(
+                f"host_scale probe rc={p.returncode}: "
+                f"{(p.stderr or p.stdout)[-400:]}")
+        # the SAME ceiling resolution the verify gate uses (file values
+        # over configured defaults) — the bench record and
+        # `--scale` must never disagree about one slope
+        from pathlib import Path
+
+        from kubedtn_tpu.analysis.scale import budget as _sbudget
+
+        root = Path(os.path.dirname(os.path.abspath(__file__)))
+        ceilings = _sbudget.probe_slopes(_sbudget.load_budget(root))
+        extras["host_scale"] = {
+            "sizes": r["sizes"],
+            "phases": r["phases"],
+            "ceilings": ceilings,
+            "in_budget": {
+                name: ph["slope"] <= ceilings.get(name, float("inf"))
+                for name, ph in r["phases"].items()},
+        }
+
     def run_reconverge_10k():
         from kubedtn_tpu.scenarios import reconverge_10k
 
@@ -919,6 +964,7 @@ def main() -> None:
     phase("telemetry_overhead", run_telemetry_overhead)
     phase("whatif_sweep", run_whatif_sweep)
     phase("reconverge_10k", run_reconverge_10k)
+    phase("host_scale", run_host_scale)
     phase("verify_gate", run_verify_gate)
 
     try:

@@ -52,21 +52,41 @@ def default_root() -> Path:
 def run_suite(root: Path | None = None,
               rules: tuple[str, ...] | None = None,
               packages: tuple[str, ...] = ("kubedtn_tpu",),
+              scale: dict | None = None,
               ) -> tuple[Project, list[Finding]]:
     """Parse the tree, run the selected passes, apply waivers. A full
     run (rules=None) additionally reports STALE waivers — `<rule>-ok`
     comments no finding matches anymore; a subset run cannot judge
-    staleness (the un-run rules' waivers would all look dead)."""
+    staleness (the un-run rules' waivers would all look dead).
+
+    `scale`: pass a dict to ALSO run the dtnscale static half (the
+    host-asymptotics bounds pass over the scale-critical entry
+    closures, budgets from SCALE_BUDGET.json) — scost findings join
+    the result (sharing the waiver and stale-waiver machinery) and
+    the dict is filled with the per-entry report + budget status.
+    When the scale layer is off, `scost-ok` waivers are exempt from
+    staleness (the rule didn't run, so it cannot be judged dead)."""
     root = root if root is not None else default_root()
     project = Project(root, packages=packages)
     graph = CallGraph(project)
     findings: list[Finding] = []
     for rule in (rules if rules is not None else tuple(PASSES)):
         findings.extend(PASSES[rule](project, graph))
+    if scale is not None:
+        from kubedtn_tpu.analysis.scale import budget as _sbudget
+        from kubedtn_tpu.analysis.scale.bounds import run_scale_pass
+
+        bdoc = _sbudget.load_budget(root)
+        scost, entry_report = run_scale_pass(
+            project, graph, budgets=_sbudget.budget_classes(bdoc))
+        scale["entries"] = entry_report
+        scale["budget"] = _sbudget.check_budget(root, scost)
+        findings.extend(scost)
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     used: set = set()
     findings = apply_waivers(project, findings, used=used)
     if rules is None:
-        findings.extend(stale_waivers(project, used))
+        skip = () if scale is not None else ("scost",)
+        findings.extend(stale_waivers(project, used, skip_rules=skip))
         findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return project, findings

@@ -1,21 +1,27 @@
 """``python -m kubedtn_tpu.analysis`` — run the contract suite.
 
-Two layers, one artifact:
+Three layers, one artifact:
 
 - **dtnlint** (default): the AST passes over the tree. Exit 0 iff
   every finding is waived (``# dtnlint: <rule>-ok(reason)``).
 - **dtnverify** (``--verify``): the jaxpr layer — trace the real tick/
   sweep programs and check the op-allowlist / key-provenance /
   dtype-flow / sharding contracts plus the COST_BUDGET.json dispatch &
-  cost gate. ``--cached`` replays the stored result when no package
-  source changed (the `make verify-fast` / pre-commit path);
-  ``--update-budgets`` re-baselines the budget file.
+  cost gate.
+- **dtnscale** (``--scale``): the host-asymptotics layer — bound every
+  scale-critical entry point's Python-level host complexity against
+  SCALE_BUDGET.json (steady tick/drain capacity-independent, barrier
+  bodies O(rows_touched), compact/save linear) and run the empirical
+  scaling probe (fitted wall-time slopes over a row-count ladder).
 
-``--json PATH`` writes the machine-readable artifact (schema v2; the
-tier-1 tests write ``ANALYSIS.json`` at the repo root). ``--diff
-OLD.json`` compares artifacts (new / fixed / waiver-flips) for
-reviewer use. ``--fix`` mechanically repairs hygiene findings (unused
-imports, import-group order) in place.
+``--cached`` replays the stored dtnverify/dtnscale results when no
+package source changed (the `make verify-fast` / pre-commit path);
+``--update-budgets`` re-baselines the budget file(s) of the layers
+being run. ``--json PATH`` writes the machine-readable artifact
+(schema v3; the tier-1 tests write ``ANALYSIS.json`` at the repo
+root). ``--diff OLD.json`` compares artifacts (new / fixed /
+waiver-flips) for reviewer use. ``--fix`` mechanically repairs
+hygiene findings (unused imports, import-group order) in place.
 """
 
 from __future__ import annotations
@@ -107,6 +113,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="additionally run dtnverify: trace the "
                          "compiled tick/sweep programs and check the "
                          "jaxpr-level contracts + cost budgets")
+    ap.add_argument("--scale", action="store_true",
+                    help="additionally run dtnscale: host-asymptotics "
+                         "bounds over the scale-critical entry points "
+                         "against SCALE_BUDGET.json, plus the "
+                         "empirical scaling probe")
+    ap.add_argument("--probe-sizes", default=None, metavar="N,N,...",
+                    help="override the dtnscale probe's row-count "
+                         "ladder (default: SCALE_BUDGET.json "
+                         "probe.sizes)")
     ap.add_argument("--entries", default=None, metavar="NAMES",
                     help="comma-separated dtnverify entry-point subset "
                          "(skips the dispatch/budget gate, which needs "
@@ -140,7 +155,9 @@ def main(argv: list[str] | None = None) -> int:
                      f"(have: {', '.join(PASSES)})")
 
     root = args.root if args.root is not None else default_root()
-    project, findings = run_suite(root=root, rules=rules)
+    scale_out: dict | None = {} if args.scale else None
+    project, findings = run_suite(root=root, rules=rules,
+                                  scale=scale_out)
 
     if args.fix:
         from kubedtn_tpu.analysis.fix import fix_tree
@@ -149,9 +166,40 @@ def main(argv: list[str] | None = None) -> int:
         for rel in changed:
             print(f"fixed: {rel}")
         # re-lint the repaired tree so the report reflects reality
-        project, findings = run_suite(root=root, rules=rules)
+        scale_out = {} if args.scale else None
+        project, findings = run_suite(root=root, rules=rules,
+                                      scale=scale_out)
 
-    ast_findings = findings
+    scale_section = None
+    if args.scale:
+        from kubedtn_tpu.analysis.scale.runner import run_scale
+
+        sizes = (tuple(int(s) for s in args.probe_sizes.split(",")
+                       if s.strip()) if args.probe_sizes else None)
+        pfindings, probe = run_scale(
+            root, use_cache=args.cached,
+            update_budgets=args.update_budgets,
+            sizes=list(sizes) if sizes else None)
+        findings = findings + pfindings
+        scost = [f for f in findings if f.rule == "scost"]
+        scale_section = {
+            "rules": ["scost"],
+            "entries": (scale_out or {}).get("entries", {}),
+            "budget": (scale_out or {}).get("budget", {}),
+            "probe": probe,
+            "findings": [f.to_json() for f in scost],
+            "summary": {
+                "total": len(scost),
+                "unwaivered": sum(1 for f in scost if not f.waived),
+            },
+        }
+        # scost findings live in the artifact's `scale` section; the
+        # AST section keeps its v1 shape
+        ast_findings_only = [f for f in findings
+                             if f.rule != "scost"]
+    else:
+        ast_findings_only = findings
+
     jaxpr_section = None
     if args.verify:
         from kubedtn_tpu.analysis.verify import run_verify
@@ -171,10 +219,11 @@ def main(argv: list[str] | None = None) -> int:
         if entries is not None and args.json is not None:
             jaxpr_section = _merge_subset_section(
                 args.json, jaxpr_section, entries)
-        findings = ast_findings + vfindings
+        findings = findings + vfindings
 
     if args.json is not None:
-        write_json(args.json, ast_findings, root, jaxpr=jaxpr_section)
+        write_json(args.json, ast_findings_only, root,
+                   jaxpr=jaxpr_section, scale=scale_section)
 
     if args.diff is not None:
         from kubedtn_tpu.analysis.diff import run_diff
@@ -188,7 +237,8 @@ def main(argv: list[str] | None = None) -> int:
             print(f.format())
     s = summarize(findings)
     by_rule = ", ".join(f"{k}={v}" for k, v in s["by_rule"].items())
-    layer = "dtnlint+dtnverify" if args.verify else "dtnlint"
+    layer = "dtnlint" + ("+dtnverify" if args.verify else "") \
+        + ("+dtnscale" if args.scale else "")
     print(f"{layer}: {s['total']} finding(s), {s['waived']} waived, "
           f"{s['unwaivered']} active ({by_rule or 'clean tree'})")
     return 1 if active else 0

@@ -37,12 +37,23 @@ ALL_RULES = (RULE_PURITY, RULE_KEY, RULE_SYNC, RULE_LOCK, RULE_DTYPE,
 # one of these is dead by construction — stale_waivers names it as
 # such instead of pretending the rule merely stopped firing.
 JAXPR_RULES = ("jops", "jkey", "jdtype", "jshard", "jtenant", "jcost")
+# the dtnscale (host-asymptotics layer) rule tag: Python-level host
+# complexity on the scale-critical entry points against
+# SCALE_BUDGET.json. Waivable like the AST rules (`scost-ok(reason)`)
+# — the designated slow paths are part of the contract and the reason
+# lands in the artifact for audit — but the tree policy is FIX, not
+# waive (PR 12 fixed every active finding instead of waivering it).
+RULE_SCOST = "scost"
+SCALE_RULES = (RULE_SCOST,)
 
 # the ANALYSIS.json artifact schema. v1: flat dtnlint findings doc
 # (PRs 6-7). v2: adds `schema_version` and the dtnverify `jaxpr`
-# section; the AST layer keeps its v1 top-level keys so v1 consumers
-# (and `--diff` against old artifacts) keep working.
-SCHEMA_VERSION = 2
+# section. v3: adds the dtnscale `scale` section (scost findings +
+# budgets + empirical probe); the AST layer keeps its v1 top-level
+# keys so v1 consumers (and `--diff` against old artifacts) keep
+# working, and a writer that ran only some layers preserves the
+# others' sections.
+SCHEMA_VERSION = 3
 
 # the reason may itself contain parens (`tick() re-reads...`): match
 # lazily but only stop at a ')' followed by end-of-line, another
@@ -182,19 +193,25 @@ def apply_waivers(project: Project, findings: list[Finding],
     return findings
 
 
-def stale_waivers(project: Project, used: set) -> list[Finding]:
+def stale_waivers(project: Project, used: set,
+                  skip_rules: Iterable[str] = ()) -> list[Finding]:
     """The waiver meta-rule: every ``<rule>-ok(reason)`` comment that
     matched NO finding is itself a finding — the rule stopped
     triggering (code moved, bug fixed, rule refined) and the dead
     waiver now documents a justification for nothing. Only meaningful
     after a FULL pass run: a subset run would see every other rule's
-    waivers as stale."""
+    waivers as stale. `skip_rules` names rules that did NOT run this
+    invocation (e.g. ``scost`` when the dtnscale layer was off) —
+    their waivers cannot be judged and are left alone."""
+    skip = set(skip_rules)
     out: list[Finding] = []
     for src in project:
         for line, rules in sorted(src.waivers.items()):
             for rule, reason in sorted(rules.items()):
                 if rule == RULE_WAIVER:
                     continue  # waiving stale-waiver reports is circular
+                if rule in skip:
+                    continue  # layer not run: staleness unjudgeable
                 if (src.rel, line, rule) in used:
                     continue
                 if rule in JAXPR_RULES:
@@ -230,19 +247,25 @@ def summarize(findings: list[Finding]) -> dict[str, object]:
 
 
 def write_json(path: Path, findings: list[Finding], root: Path,
-               jaxpr: dict | None = None) -> None:
-    """The machine-readable artifact (ANALYSIS.json, schema v2):
+               jaxpr: dict | None = None,
+               scale: dict | None = None) -> None:
+    """The machine-readable artifact (ANALYSIS.json, schema v3):
     stable ordering, no timestamps — diffs track the findings-count
     trajectory. The AST layer keeps the v1 top-level keys; the
-    dtnverify layer lands in the `jaxpr` section. A writer that ran
-    only one layer PRESERVES the other layer's existing section, so
-    the artifact stays complete whichever gate wrote last."""
+    dtnverify layer lands in the `jaxpr` section and the dtnscale
+    layer in the `scale` section. A writer that ran only some layers
+    PRESERVES the other layers' existing sections, so the artifact
+    stays complete whichever gate wrote last."""
     findings = sorted(findings, key=lambda f: (f.path, f.line, f.rule))
-    if jaxpr is None and path.exists():
+    if (jaxpr is None or scale is None) and path.exists():
         try:
-            jaxpr = json.loads(path.read_text()).get("jaxpr")
+            old = json.loads(path.read_text())
         except (OSError, ValueError):
-            jaxpr = None
+            old = {}
+        if jaxpr is None:
+            jaxpr = old.get("jaxpr")
+        if scale is None:
+            scale = old.get("scale")
     doc = {
         "tool": "dtnlint",
         "schema_version": SCHEMA_VERSION,
@@ -252,6 +275,8 @@ def write_json(path: Path, findings: list[Finding], root: Path,
     }
     if jaxpr is not None:
         doc["jaxpr"] = dict(jaxpr)
+    if scale is not None:
+        doc["scale"] = dict(scale)
     path.write_text(json.dumps(doc, indent=2) + "\n")
 
 

@@ -27,6 +27,8 @@ def _index(doc: dict) -> dict[tuple, dict]:
     out: dict[tuple, dict] = {}
     for layer, findings in (("ast", doc.get("findings", [])),
                             ("jaxpr", (doc.get("jaxpr") or {})
+                             .get("findings", [])),
+                            ("scale", (doc.get("scale") or {})
                              .get("findings", []))):
         for f in findings:
             out[(layer, f["rule"], f["path"], f["message"])] = f

@@ -1,0 +1,50 @@
+"""dtnscale — host-asymptotics analysis of the scale-critical paths.
+
+The third analysis layer. dtnlint (AST) checks the determinism
+contracts where they are written and dtnverify (jaxpr) where they are
+staked in the compiled programs; neither sees the HOST side — the
+Python bookkeeping that runs under the engine/tick locks on every
+tick, drain, barrier, compact, checkpoint, and migration step. At the
+roadmap's million-edge scale that bookkeeping is the ceiling: a free
+list rebuilt ``list(range(capacity...))`` per grow/compact, a
+per-dispatch ``set(engine._shaped_rows)`` copy, per-generation
+O(all-rows) tenant row-set re-derives — all invisible to the first
+two layers, all measured in hundreds of milliseconds of runner pause
+at 1M rows. Beehive's thesis (PAPERS.md, arxiv 2403.14770) is that
+the host must stay OFF the data path for accelerator-attached
+networking to scale; dtnscale enforces that as a machine-checked
+budget, the way COST_BUDGET.json pins device flops and dispatches.
+
+Two halves, one ``scale`` section in ANALYSIS.json (schema v3):
+
+- **static** (`bounds.py` + `entrypoints.py`): reuse the PR 6
+  call-graph machinery to close over each scale-critical entry point
+  (tick/dispatch/complete, drain, barrier bodies, compact,
+  checkpoint save/load, migration fork/restore/cutover), infer the
+  bound class of every *Python-level* loop/comprehension/
+  materialization in the closure (rows-touched / tenants / capacity —
+  vectorized numpy passes are free), and flag ``scost`` findings
+  where an entry exceeds its ``SCALE_BUDGET.json`` class: the steady
+  tick and drain must be capacity-independent, barrier bodies at most
+  O(rows_touched), compact/save linear.
+- **empirical** (`probe.py`): run the REAL engine at increasing row
+  counts, fit log-log wall-time slopes for alloc-churn / drain-policy
+  / stage-barrier / compact / checkpoint-save, and fail on
+  superlinear drift past the budget file's slope ceilings — the same
+  pattern as the dtnverify dispatch probe. ``bench.py``'s
+  ``host_scale`` phase runs the same probe at 10k/100k/1M rows.
+
+Waiver tag: ``# dtnlint: scost-ok(reason)`` — reason mandatory,
+audited in the artifact, stale-detected like every other rule. The
+tree policy is fix-not-waive: PR 12 made the columnar-bookkeeping
+refactor (FreeStack, vectorized compact, incremental tenant masks)
+instead of waivering the findings that forced it.
+"""
+
+from __future__ import annotations
+
+from kubedtn_tpu.analysis.scale.bounds import run_scale_pass
+from kubedtn_tpu.analysis.scale.entrypoints import SCALE_ENTRIES
+from kubedtn_tpu.analysis.scale.runner import run_scale
+
+__all__ = ["run_scale", "run_scale_pass", "SCALE_ENTRIES"]

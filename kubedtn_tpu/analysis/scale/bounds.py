@@ -1,0 +1,352 @@
+"""dtnscale static half: per-function loop-bound inference.
+
+For every function in an entry's call-graph closure, find the
+*Python-level* iteration constructs — ``for`` loops, comprehensions
+and generator expressions, linear builtins (``sorted``/``list``/
+``set``/``tuple``/``sum``/``max``/``min``) over classified
+containers, ``list(range(...))`` materializations, and per-element
+free-list scans — and classify each one's bound against the
+vocabulary in `entrypoints.py`:
+
+- a ``range()`` whose argument mentions a capacity bound name, or an
+  iteration over a capacity-classified container → ``O(capacity)``;
+- iteration over the tenant registry → ``O(tenants)``;
+- everything else (batch parameters, local collections, unresolvable
+  names) → ``O(rows_touched)`` — the conservative default that keeps
+  the pass quiet on the batch-shaped hot loops;
+- a classified loop nested inside another classified loop →
+  superlinear (``nested`` kind), never budgetable.
+
+Vectorized numpy calls are exempt by construction: their arguments
+are not visited as iteration (``np.fromiter(owned.keys(), ...)`` is a
+C-speed pass), which is exactly the columnar-bookkeeping contract the
+budgets enforce.
+
+Findings carry the entry name, the construct's inferred class, and
+the entry's budget, and are waivable with ``scost-ok(reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from kubedtn_tpu.analysis.callgraph import CallGraph, FuncRef
+from kubedtn_tpu.analysis.core import (
+    RULE_SCOST,
+    Finding,
+    Project,
+    call_name,
+)
+from kubedtn_tpu.analysis.scale.entrypoints import (
+    CAPACITY_BOUNDS,
+    CAPACITY_CONTAINERS,
+    CAPACITY_LISTS,
+    CLASS_CAPACITY,
+    CLASS_O1,
+    CLASS_ORDER,
+    CLASS_RANK,
+    CLASS_ROWS,
+    CLASS_SUPER,
+    CLASS_TENANTS,
+    SCALE_ENTRIES,
+    TENANT_CONTAINERS,
+)
+
+# builtins that walk their (first) argument linearly at Python speed
+_LINEAR_BUILTINS = {"sorted", "list", "set", "tuple", "sum", "max",
+                    "min", "frozenset"}
+# call prefixes whose arguments are C-speed array passes — NOT
+# Python-level iteration (the contract the budgets enforce)
+_VECTORIZED_PREFIXES = ("np.", "numpy.", "jnp.", "jax.")
+
+
+@dataclasses.dataclass
+class Contribution:
+    """One classified construct inside an entry closure."""
+
+    line: int
+    kind: str        # loop | linear-call | range-materialize | scan
+    klass: str       # inferred bound class
+    detail: str      # what was iterated/scanned
+    always_flag: bool = False
+
+
+def _name_class(name: str) -> str | None:
+    """Class of a bare/attribute NAME, or None when unclassified."""
+    if name in CAPACITY_BOUNDS or name in CAPACITY_CONTAINERS:
+        return CLASS_CAPACITY
+    if name in TENANT_CONTAINERS:
+        return CLASS_TENANTS
+    return None
+
+
+def _leaf_name(node: ast.AST) -> str | None:
+    """The classification-relevant final name of an expression:
+    ``self._rows`` → ``_rows``, ``engine._free`` → ``_free``,
+    ``cap`` → ``cap``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def classify_expr(node: ast.AST) -> tuple[str, str]:
+    """(class, detail) for an iterable/bound expression. Constants →
+    O(1); classified names dominate; anything else defaults to
+    O(rows_touched)."""
+    if isinstance(node, ast.Constant):
+        return CLASS_O1, repr(node.value)
+    if isinstance(node, ast.Call):
+        cn = call_name(node)
+        # range(X) / reversed(X) / enumerate(X) / zip(...) / X.items()
+        if cn == "range":
+            best, det = CLASS_O1, "range(<const>)"
+            for a in node.args:
+                k, d = classify_expr(a)
+                if CLASS_RANK[k] > CLASS_RANK[best]:
+                    best, det = k, f"range({d})"
+            return best, det
+        if cn in ("reversed", "enumerate", "iter"):
+            if node.args:
+                return classify_expr(node.args[0])
+            return CLASS_ROWS, cn
+        if cn == "zip":
+            best, det = CLASS_O1, "zip()"
+            for a in node.args:
+                k, d = classify_expr(a)
+                if CLASS_RANK[k] > CLASS_RANK[best]:
+                    best, det = k, d
+            return (best if best != CLASS_O1 else CLASS_ROWS), det
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("items", "values", "keys"):
+            return classify_expr(node.func.value)
+        # unknown call → bounded by its own result: batch default
+        return CLASS_ROWS, cn or "<call>"
+    if isinstance(node, (ast.GeneratorExp, ast.ListComp,
+                         ast.SetComp, ast.DictComp)):
+        # a comprehension used as an iterable is bounded by its own
+        # sources
+        best, det = CLASS_O1, "<genexp>"
+        for gen in node.generators:
+            k, d = classify_expr(gen.iter)
+            if CLASS_RANK[k] > CLASS_RANK[best]:
+                best, det = k, d
+        return (best if best != CLASS_O1 else CLASS_ROWS), det
+    leaf = _leaf_name(node)
+    if leaf is not None:
+        k = _name_class(leaf)
+        if k is not None:
+            return k, leaf
+        return CLASS_ROWS, leaf
+    # composite expressions (``cap - 1``, conditionals, subscripts):
+    # classified by the names they mention — the strongest wins.
+    # Names inside a nested call's FUNC position are skipped: a
+    # method call ON a container (`_by_key.get(k)`) is not an
+    # iteration OVER it.
+    best: str | None = None
+    best_name = "<expr>"
+    saw_name = [False]
+
+    def scan(n: ast.AST) -> None:
+        nonlocal best, best_name
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, ast.Call):
+                for a in (*child.args,
+                          *(kw.value for kw in child.keywords)):
+                    scan_node(a)
+                continue
+            scan_node(child)
+
+    def scan_node(n: ast.AST) -> None:
+        nonlocal best, best_name
+        nm = _leaf_name(n) if isinstance(
+            n, (ast.Name, ast.Attribute)) else None
+        if nm is not None:
+            saw_name[0] = True
+            k = _name_class(nm)
+            if k is not None and (
+                    best is None or CLASS_RANK[k] > CLASS_RANK[best]):
+                best, best_name = k, nm
+        scan(n)
+
+    scan_node(node)
+    if best is not None:
+        return best, best_name
+    return (CLASS_ROWS if saw_name[0] else CLASS_O1), "<expr>"
+
+
+def _combine_nested(outer: str, inner: str) -> str:
+    """Effective class of an `inner`-classified construct under an
+    `outer` enclosing loop. O(1) never multiplies; rows×rows stays
+    rows_touched (a batch of batches is still the batch) and a rows
+    walk under a tenant loop is the per-tenant slice of one batch —
+    but capacity×anything (and tenants×tenants) is superlinear."""
+    ro, ri = CLASS_RANK[outer], CLASS_RANK[inner]
+    if ro == 0 or ri == 0:
+        return inner
+    if CLASS_CAPACITY in (outer, inner):
+        return CLASS_SUPER
+    if outer == CLASS_TENANTS and inner == CLASS_TENANTS:
+        return CLASS_SUPER
+    return CLASS_ORDER[max(ro, ri)]
+
+
+def analyze_function(fn: ast.FunctionDef) -> list[Contribution]:
+    """Classified constructs of `fn`'s own body (nested defs are their
+    own closure members)."""
+    out: list[Contribution] = []
+
+    def visit(node: ast.AST, loop_stack: tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack = loop_stack
+            if isinstance(child, (ast.For, ast.AsyncFor)):
+                k, det = classify_expr(child.iter)
+                eff = k
+                for outer in loop_stack:
+                    eff = _combine_nested(outer, eff)
+                if CLASS_RANK[eff] > 0:
+                    out.append(Contribution(
+                        child.lineno,
+                        "nested" if eff == CLASS_SUPER else "loop",
+                        eff, det))
+                stack = loop_stack + (k,)
+            elif isinstance(child, (ast.ListComp, ast.SetComp,
+                                    ast.DictComp, ast.GeneratorExp)):
+                for gen in child.generators:
+                    k, det = classify_expr(gen.iter)
+                    eff = k
+                    for outer in loop_stack:
+                        eff = _combine_nested(outer, eff)
+                    if CLASS_RANK[eff] > 0:
+                        out.append(Contribution(
+                            child.lineno,
+                            "nested" if eff == CLASS_SUPER
+                            else "comprehension", eff, det))
+            elif isinstance(child, ast.Call):
+                _classify_call(child, loop_stack, out)
+            elif isinstance(child, ast.Compare):
+                _classify_membership(child, out)
+            visit(child, stack)
+
+    visit(fn, ())
+    return out
+
+
+def _classify_call(node: ast.Call, loop_stack: tuple[str, ...],
+                   out: list[Contribution]) -> None:
+    cn = call_name(node)
+    if cn is None:
+        return
+    if cn.startswith(_VECTORIZED_PREFIXES):
+        return  # C-speed array pass — the budgeted alternative
+    # list(range(CAP)) / set(range(CAP)): materializing an O(capacity)
+    # Python collection — flagged regardless of the entry budget (the
+    # columnar structures exist so this never happens)
+    if cn in ("list", "set", "tuple") and node.args and \
+            isinstance(node.args[0], ast.Call) and \
+            call_name(node.args[0]) == "range":
+        k, det = classify_expr(node.args[0])
+        if k == CLASS_CAPACITY:
+            out.append(Contribution(
+                node.lineno, "range-materialize", k,
+                f"{cn}({det})", always_flag=True))
+            return
+    if cn in _LINEAR_BUILTINS and node.args:
+        if isinstance(node.args[0], (ast.GeneratorExp, ast.ListComp,
+                                     ast.SetComp, ast.DictComp)):
+            return  # the comprehension visitor owns that construct
+        k, det = classify_expr(node.args[0])
+        eff = k
+        for outer in loop_stack:
+            eff = _combine_nested(outer, eff)
+        if CLASS_RANK[eff] >= CLASS_RANK[CLASS_TENANTS]:
+            out.append(Contribution(
+                node.lineno,
+                "nested" if eff == CLASS_SUPER else "linear-call",
+                eff, f"{cn}({det})"))
+        return
+    # free-list element scans: c.remove(x) / c.pop(i)
+    if isinstance(node.func, ast.Attribute) and \
+            node.func.attr in ("remove", "pop"):
+        leaf = _leaf_name(node.func.value)
+        if leaf in CAPACITY_LISTS and node.args:
+            out.append(Contribution(
+                node.lineno, "scan", CLASS_CAPACITY,
+                f"{leaf}.{node.func.attr}(...)", always_flag=True))
+
+
+def _classify_membership(node: ast.Compare,
+                         out: list[Contribution]) -> None:
+    """``x in _free`` — a linear scan of the columnar free list per
+    call (set/dict membership is O(1) and exempt by vocabulary)."""
+    for op, comp in zip(node.ops, node.comparators):
+        if not isinstance(op, (ast.In, ast.NotIn)):
+            continue
+        leaf = _leaf_name(comp)
+        if leaf in CAPACITY_LISTS:
+            out.append(Contribution(
+                node.lineno, "scan", CLASS_CAPACITY,
+                f"<x> in {leaf}", always_flag=True))
+
+
+def run_scale_pass(project: Project, graph: CallGraph,
+                   entries: dict | None = None,
+                   budgets: dict[str, str] | None = None,
+                   ) -> tuple[list[Finding], dict]:
+    """Run the static half over `entries` (default: the configured
+    SCALE_ENTRIES). `budgets` overrides each entry's budget class
+    (the SCALE_BUDGET.json values; defaults come from the entry
+    config). Returns (findings, per-entry report)."""
+    entries = entries if entries is not None else SCALE_ENTRIES
+    findings: list[Finding] = []
+    report: dict[str, dict] = {}
+    for name, spec in entries.items():
+        budget = (budgets or {}).get(name, spec["budget"])
+        budget_rank = CLASS_RANK[budget]
+        roots = [FuncRef(p, q) for p, q in spec["roots"]
+                 if FuncRef(p, q) in graph.functions]
+        closure = graph.closure(roots)
+        worst = CLASS_O1
+        n_constructs = 0
+        for ref in sorted(closure, key=lambda r: (r.path, r.qual)):
+            fn = graph.functions[ref]
+            for c in analyze_function(fn):
+                n_constructs += 1
+                if CLASS_RANK[c.klass] > CLASS_RANK[worst]:
+                    worst = c.klass
+                over = CLASS_RANK[c.klass] > budget_rank
+                if not (over or c.always_flag):
+                    continue
+                if c.kind == "range-materialize":
+                    why = ("materializes an O(capacity) Python "
+                           "collection — keep it columnar "
+                           "(np.arange / FreeStack)")
+                elif c.kind == "scan":
+                    why = ("per-element scan of the free list — "
+                           "O(capacity) per call, superlinear in any "
+                           "loop (use FreeStack.remove_rows / "
+                           "drop_top_while_in)")
+                elif c.kind == "nested":
+                    why = "nested data-dependent loops — superlinear"
+                else:
+                    why = (f"exceeds the entry budget {budget} "
+                           f"(one {c.klass} Python walk per "
+                           f"invocation)")
+                findings.append(Finding(
+                    RULE_SCOST, ref.path, c.line,
+                    f"[{name}] {c.kind} over `{c.detail}` in "
+                    f"`{ref.qual}` is {c.klass}: {why}"))
+        report[name] = {
+            "budget": budget,
+            "inferred": worst,
+            "functions": len(closure),
+            "constructs": n_constructs,
+            "roots_resolved": len(roots),
+            "roots_configured": len(spec["roots"]),
+        }
+    return findings, report

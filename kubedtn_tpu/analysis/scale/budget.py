@@ -1,0 +1,137 @@
+"""SCALE_BUDGET.json — the checked-in host-complexity budgets.
+
+Layout::
+
+    {
+      "classes": [...],                  # the ordered class ladder
+      "entries": {"tick": "O(rows_touched)", ...},
+      "probe": {
+        "sizes": [2048, 8192, 32768],    # default CLI probe sizes
+        "max_slope": {"compact": 1.35, ...}
+      }
+    }
+
+`check_budget` compares the static pass's configuration against the
+file (a configured entry with no budget record is itself a finding —
+new scale-critical paths must be budgeted deliberately, same rule as
+COST_BUDGET.json) and hands the per-entry budget classes to the
+bounds pass. `write_budget` (--update-budgets) re-baselines: entries
+get their configured defaults where missing (an EXISTING budget is
+kept — tightening or loosening a class is a reviewed hand edit, not
+a mechanical refresh), and probe slope ceilings become
+measured + margin, never below the configured defaults.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from kubedtn_tpu.analysis.core import RULE_SCOST, Finding
+from kubedtn_tpu.analysis.scale.entrypoints import (
+    CLASS_ORDER,
+    PROBE_DEFAULT_SLOPES,
+    SCALE_ENTRIES,
+)
+
+BUDGET_FILE = "SCALE_BUDGET.json"
+_SLOPE_MARGIN = 0.25
+
+
+def load_budget(root: Path) -> dict | None:
+    p = root / BUDGET_FILE
+    if not p.exists():
+        return None
+    try:
+        return json.loads(p.read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def budget_classes(doc: dict | None) -> dict[str, str]:
+    """entry -> budget class, file values over configured defaults."""
+    out = {name: spec["budget"] for name, spec in SCALE_ENTRIES.items()}
+    for name, cls in ((doc or {}).get("entries") or {}).items():
+        if cls in CLASS_ORDER:
+            out[name] = cls
+    return out
+
+
+def probe_slopes(doc: dict | None) -> dict[str, float]:
+    out = dict(PROBE_DEFAULT_SLOPES)
+    for phase, v in (((doc or {}).get("probe") or {})
+                     .get("max_slope") or {}).items():
+        try:
+            out[phase] = float(v)
+        except (TypeError, ValueError):
+            pass
+    return out
+
+
+def probe_sizes(doc: dict | None) -> list[int]:
+    sizes = ((doc or {}).get("probe") or {}).get("sizes")
+    if isinstance(sizes, list) and sizes:
+        return [int(s) for s in sizes]
+    return [2048, 8192, 32768]
+
+
+def check_budget(root: Path, findings: list[Finding]) -> dict:
+    """Gate the budget file itself: missing file / unbudgeted entries
+    are findings (a scale-critical path nobody budgeted is exactly
+    the drift this layer exists to catch)."""
+    doc = load_budget(root)
+    if doc is None:
+        findings.append(Finding(
+            RULE_SCOST, BUDGET_FILE, 1,
+            "SCALE_BUDGET.json missing or unreadable — run "
+            "`python -m kubedtn_tpu.analysis --scale "
+            "--update-budgets` to baseline it"))
+        return {"file": BUDGET_FILE, "present": False}
+    recorded = set((doc.get("entries") or {}))
+    missing = sorted(set(SCALE_ENTRIES) - recorded)
+    for name in missing:
+        findings.append(Finding(
+            RULE_SCOST, BUDGET_FILE, 1,
+            f"entry `{name}` has no budget record — new "
+            f"scale-critical paths must be budgeted deliberately "
+            f"(--update-budgets adds the configured default)"))
+    stale = sorted(recorded - set(SCALE_ENTRIES))
+    return {"file": BUDGET_FILE, "present": True,
+            "missing_entries": missing, "stale_entries": stale}
+
+
+def write_budget(root: Path, measured_slopes: dict[str, float] | None
+                 ) -> dict:
+    """--update-budgets: rewrite SCALE_BUDGET.json. Existing entry
+    classes are KEPT; missing entries get their configured defaults;
+    probe ceilings become max(default, measured + margin)."""
+    old = load_budget(root) or {}
+    entries = {name: spec["budget"]
+               for name, spec in SCALE_ENTRIES.items()}
+    for name, cls in (old.get("entries") or {}).items():
+        if name in entries and cls in CLASS_ORDER:
+            entries[name] = cls
+    slopes = dict(PROBE_DEFAULT_SLOPES)
+    for phase, v in (measured_slopes or {}).items():
+        if phase in slopes:
+            slopes[phase] = round(
+                max(slopes[phase], float(v) + _SLOPE_MARGIN), 2)
+    doc = {
+        "comment": (
+            "dtnscale host-complexity budgets (see "
+            "ARCHITECTURE.md 'Host scalability contract'). "
+            "`entries` pins each scale-critical entry point's "
+            "allowed Python-level bound class; `probe.max_slope` "
+            "ceilings the empirical log-log wall-time slopes the "
+            "scaling probe fits. Checked by `python -m "
+            "kubedtn_tpu.analysis --scale` (tier-1) and re-baselined "
+            "by --update-budgets."),
+        "classes": list(CLASS_ORDER),
+        "entries": dict(sorted(entries.items())),
+        "probe": {
+            "sizes": probe_sizes(old),
+            "max_slope": dict(sorted(slopes.items())),
+        },
+    }
+    (root / BUDGET_FILE).write_text(json.dumps(doc, indent=2) + "\n")
+    return doc

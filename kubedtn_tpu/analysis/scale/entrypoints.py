@@ -1,0 +1,233 @@
+"""dtnscale entry-point configuration: roots, vocabulary, budgets.
+
+An *entry* is one scale-critical host path: a set of call-graph roots
+(the same (path, qualname) addressing the PR 6 machinery uses) whose
+closure the bounds pass analyzes as one unit, plus a complexity
+budget class. The class ladder, coarsest host-cost vocabulary that
+still separates the real offenders:
+
+======================  ================================================
+class                   meaning (Python-level work per invocation)
+======================  ================================================
+``O(1)``                no data-dependent Python iteration at all
+``O(rows_touched)``     bounded by the operation's own batch — frames
+                        drained this tick, links in this call, rows in
+                        this journal — never by how big the plane is
+``O(tenants)``          one pass over the tenant registry (drain-policy
+                        snapshot) is allowed on top of rows_touched
+``O(capacity)``         linear in the SoA — legal only for the paths
+                        DOCUMENTED as one linear pass (compact,
+                        checkpoint save/load, accounting rebuild)
+======================  ================================================
+
+Vectorized numpy work (``np.*`` calls, FreeStack ops) is NOT counted:
+the columnar-bookkeeping contract is precisely that linear row work
+happens as C-speed array passes, and what the budget polices is
+Python-level iteration under the engine/tick locks. Two shapes are
+flagged regardless of budget: materializing an O(capacity) Python
+collection (``list(range(cap))`` — the columnar structures exist so
+this never happens), and per-element scans of the free list
+(``row in _free`` / ``_free.remove(row)``), which turn any enclosing
+loop quadratic.
+"""
+
+from __future__ import annotations
+
+# ---- complexity classes (ordered) -------------------------------------
+
+CLASS_O1 = "O(1)"
+CLASS_ROWS = "O(rows_touched)"
+CLASS_TENANTS = "O(tenants)"
+CLASS_CAPACITY = "O(capacity)"
+CLASS_SUPER = "O(capacity x N)"   # nested/superlinear — never budgeted
+
+CLASS_ORDER = (CLASS_O1, CLASS_ROWS, CLASS_TENANTS, CLASS_CAPACITY,
+               CLASS_SUPER)
+CLASS_RANK = {c: i for i, c in enumerate(CLASS_ORDER)}
+
+# ---- bound-classification vocabulary ----------------------------------
+# Names (bare or as the final attribute of a dotted chain) whose size
+# scales with the SoA / total realized rows. Iterating one of these —
+# or a range() over one of the bound names — is an O(capacity) walk.
+CAPACITY_BOUNDS = {"capacity", "cap", "new_cap", "old_cap"}
+CAPACITY_CONTAINERS = {
+    "_free",          # engine free list (FreeStack)
+    "_rows",          # (pod_key, uid) -> row registry
+    "_row_owner",     # row -> (pod_key, uid)
+    "_peer",          # directed-link peer map
+    "_row_keyid",     # per-row identity key-id column
+    "_shaped_rows",   # shaped-row mirror
+    "_pod_ids",       # endpoint name -> node id
+    "_pod_names",     # node id -> endpoint name
+    "_by_id",         # wire registries
+    "_by_key",
+    "_objects",       # topology store records
+}
+# capacity containers with LIST semantics: `x in c` / `c.remove(x)` /
+# `c.pop(i)` is a linear scan per call (set/dict membership is O(1)
+# and exempt)
+CAPACITY_LISTS = {"_free"}
+# tenant-registry-sized containers: one pass = O(tenants)
+TENANT_CONTAINERS = {"_tenants", "_ns_map", "ns_map", "_holds",
+                     "_masks", "tenants"}
+
+# ---- entries ----------------------------------------------------------
+# name -> (budget class, ((path, qualname), ...) call-graph roots).
+# Unresolvable calls (attr chains through self.daemon / self.tenancy /
+# handle.engine ...) are not followed by the closure — the cross-object
+# hops each path takes are therefore listed as EXPLICIT roots of the
+# entry that reaches them, same discipline as dtnlint's hot-path list.
+_RT = "kubedtn_tpu/runtime.py"
+_SRV = "kubedtn_tpu/wire/server.py"
+_ENG = "kubedtn_tpu/topology/engine.py"
+_REG = "kubedtn_tpu/tenancy/registry.py"
+_PAR = "kubedtn_tpu/parallel/partition.py"
+_STG = "kubedtn_tpu/updates/stager.py"
+_CKP = "kubedtn_tpu/checkpoint.py"
+_MIG = "kubedtn_tpu/federation/migrate.py"
+_TEL = "kubedtn_tpu/telemetry.py"
+
+SCALE_ENTRIES: dict[str, dict] = {
+    # the steady data path: host work per tick must scale with the
+    # frames drained THIS tick, never with plane size
+    "tick": {
+        "budget": CLASS_ROWS,
+        "roots": (
+            (_RT, "WireDataPlane.tick"),
+            (_RT, "WireDataPlane._tick_inner"),
+            (_RT, "WireDataPlane._dispatch"),
+            (_RT, "WireDataPlane._dispatch_inner"),
+            (_RT, "WireDataPlane._complete"),
+            (_RT, "WireDataPlane._complete_or_requeue"),
+            (_RT, "WireDataPlane._release"),
+            (_RT, "WireDataPlane._adapt_budget"),
+        ),
+    },
+    "drain_ingress": {
+        "budget": CLASS_ROWS,
+        "roots": ((_SRV, "Daemon.drain_ingress"),),
+    },
+    # admission: one registry snapshot per tick, O(1) per wire
+    "drain_policy": {
+        "budget": CLASS_TENANTS,
+        "roots": (
+            (_REG, "TenantRegistry.drain_policy"),
+            (_REG, "TenantRegistry.charge_drained"),
+        ),
+    },
+    # row allocation/free — the per-link hot path of every realize,
+    # delete, adopt and rollback
+    "alloc": {
+        "budget": CLASS_ROWS,
+        "roots": (
+            (_ENG, "SimEngine._alloc"),
+            (_ENG, "SimEngine._alloc_link_pair"),
+            (_ENG, "SimEngine._bind_row"),
+            (_ENG, "SimEngine._free_row"),
+            (_ENG, "SimEngine._ensure_capacity"),
+            (_REG, "TenantRegistry.alloc_row"),
+            (_REG, "TenantRegistry.alloc_pair"),
+            (_REG, "TenantRegistry.release_row"),
+            (_REG, "TenantRegistry.reserved_free"),
+            (_REG, "TenantRegistry.note_bind"),
+            (_REG, "TenantRegistry.note_unbind"),
+            (_PAR, "pick_pair_rows"),
+        ),
+    },
+    "add_links": {
+        "budget": CLASS_ROWS,
+        "roots": (
+            (_ENG, "SimEngine._add_links_locked"),
+            (_ENG, "SimEngine.del_links"),
+            (_ENG, "SimEngine.update_links"),
+            (_ENG, "SimEngine.adopt_rows"),
+            (_ENG, "SimEngine.abandon_rows"),
+        ),
+    },
+    # every tick-lock staging barrier body: planned-update rounds,
+    # journal capture, rollback replay
+    "stage_barrier": {
+        "budget": CLASS_ROWS,
+        "roots": (
+            (_RT, "WireDataPlane.stage_update_round"),
+            (_STG, "UpdateStager._apply_round"),
+            (_STG, "UpdateStager._capture_images"),
+            (_STG, "UpdateStager._endpoints"),
+            (_STG, "UpdateStager._rollback"),
+            (_STG, "UpdateStager._restore_image_locked"),
+        ),
+    },
+    # the documented linear passes
+    "compact": {
+        "budget": CLASS_CAPACITY,
+        "roots": (
+            (_ENG, "SimEngine.compact"),
+            (_REG, "TenantRegistry.on_compact"),
+            (_PAR, "tenant_blocks"),
+            (_RT, "WireDataPlane._on_rows_remapped"),
+            (_TEL, "LinkTelemetry.remap_rows"),
+        ),
+    },
+    "checkpoint_save": {
+        "budget": CLASS_CAPACITY,
+        "roots": (
+            (_CKP, "_save_traced"),
+            (_CKP, "store_records"),
+            (_CKP, "save_pending"),
+        ),
+    },
+    "checkpoint_load": {
+        "budget": CLASS_CAPACITY,
+        "roots": (
+            (_CKP, "_load_traced"),
+            (_CKP, "restore_store"),
+            (_CKP, "load_pending"),
+            (_CKP, "load_tenancy"),
+            (_CKP, "rebuild_engine"),
+        ),
+    },
+    # per-tenant slicing: one vectorized mask read per query, with the
+    # namespace-binding rebuild as the documented linear slow path
+    "tenant_accounting": {
+        "budget": CLASS_CAPACITY,
+        "roots": (
+            (_REG, "TenantRegistry.rows_of"),
+            (_REG, "TenantRegistry._rebuild_masks_locked"),
+            (_REG, "TenantRegistry.tenant_counters"),
+            (_REG, "TenantRegistry.tenant_window"),
+        ),
+    },
+    # live-migration steps: tenant-scoped, so rows_touched = the
+    # migrating tenant's rows/wires — never the whole plane's
+    "migration_fork": {
+        "budget": CLASS_ROWS,
+        "roots": ((_MIG, "MigrationCoordinator._step_fork"),),
+    },
+    "migration_restore": {
+        "budget": CLASS_ROWS,
+        "roots": ((_MIG, "MigrationCoordinator._step_restore"),),
+    },
+    "migration_cutover": {
+        "budget": CLASS_ROWS,
+        "roots": (
+            (_MIG, "MigrationCoordinator._step_cutover"),
+            (_MIG, "MigrationCoordinator._wire_pairs"),
+            (_MIG, "MigrationCoordinator._transfer"),
+            (_SRV, "WireManager.in_namespaces"),
+        ),
+    },
+}
+
+# empirical probe phases -> default max fitted log-log slope. The
+# capacity-independent phases get a near-flat ceiling (constant
+# overhead dominates at probe sizes, so honest slopes sit near 0);
+# the documented linear passes get a generous ≤ ~1.35 (compression,
+# allocator noise). Re-baselined by --update-budgets (measured+margin,
+# never below the default).
+PROBE_DEFAULT_SLOPES: dict[str, float] = {
+    "alloc_churn": 0.35,
+    "drain_policy": 0.35,
+    "stage_barrier": 0.35,
+    "compact": 1.35,
+    "checkpoint_save": 1.35,
+}

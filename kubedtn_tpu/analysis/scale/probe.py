@@ -1,0 +1,185 @@
+"""dtnscale empirical half: the host-scaling probe.
+
+Builds the REAL engine (and a real data plane on top of it) at a
+ladder of row counts, times the scale-critical host operations at
+each size, and fits log-log wall-time slopes — the empirical check
+that the static budgets describe the code that actually runs, in the
+same pattern as the dtnverify dispatch probe. Phases:
+
+=================  ====================================================
+phase              measures (expected)
+=================  ====================================================
+``alloc_churn``    row alloc/free through the engine allocator +
+                   columnar free list (capacity-independent)
+``drain_policy``   the tenancy admission snapshot per tick
+                   (O(tenants), capacity-independent)
+``stage_barrier``  an empty `stage_update_round` — the tick-lock
+                   flush barrier every staged change pays
+                   (capacity-independent)
+``compact``        full engine.compact() — repack + registry rebuild
+                   + tenant re-carve (one linear pass)
+``checkpoint_save``  checkpoint.save of store+engine+arrays
+                   (one linear pass)
+=================  ====================================================
+
+A fitted slope above the ``SCALE_BUDGET.json`` ``probe.max_slope``
+ceiling for its phase is a ``scost`` finding — superlinear drift on a
+path the static pass believes is budgeted. ``bench.py``'s
+``host_scale`` phase runs this probe process-isolated at
+10k/100k/1M rows and banks the slopes in the bench record; the CLI
+(``--scale``) runs the small default ladder so tier-1 stays fast.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import tempfile
+import time
+
+import numpy as np
+
+# number of timed repetitions per (phase, size); min is kept (load
+# spikes on shared hosts only ever inflate)
+_REPS = 3
+_ALLOC_OPS = 256
+_POLICY_CALLS = 64
+_BARRIER_CALLS = 8
+_PROBE_TENANTS = 8
+
+
+def _next_pow2(n: int) -> int:
+    p = 8
+    while p < n:
+        p *= 2
+    return p
+
+
+def _build(n_rows: int):
+    """A real engine + registry + plane realized at `n_rows` directed
+    rows (pair-allocated like add_links, flushed to device)."""
+    from kubedtn_tpu.ops import edge_state as es
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.tenancy import TenantRegistry
+    from kubedtn_tpu.topology.engine import SimEngine
+    from kubedtn_tpu.topology.store import TopologyStore
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    # capacity = 2× the realized rows at every size (the churn phase
+    # needs free headroom, and a proportional cap keeps the fitted
+    # slopes honest — cap-linear passes scale with rows exactly);
+    # the alloc-churn floor keeps tiny probe sizes from exhausting
+    # the pool mid-phase
+    engine = SimEngine(store, capacity=_next_pow2(
+        max(n_rows * 2, n_rows + 2 * _ALLOC_OPS)))
+    registry = TenantRegistry(engine)
+    for t in range(_PROBE_TENANTS):
+        registry.create(f"probe-t{t}", namespaces=[f"ns{t}"])
+    props = np.zeros((es.NPROP,), np.float32)
+    with engine._lock:
+        entries = []
+        for i in range(n_rows // 2):
+            ns = f"ns{i % _PROBE_TENANTS}"
+            k1, k2 = f"{ns}/p{i}a", f"{ns}/p{i}b"
+            r1, r2 = engine._alloc_link_pair(k1, k2, 1)
+            a, b = engine._pod_id(k1), engine._pod_id(k2)
+            entries.append((r1, 1, a, b, props, False))
+            entries.append((r2, 1, b, a, props, False))
+        engine._enqueue_apply(entries)
+        engine._flush_device_locked()
+    daemon = Daemon(engine)
+    plane = WireDataPlane(daemon, dt_us=10_000.0)
+    return store, engine, registry, daemon, plane
+
+
+def _timed(fn) -> float:
+    best = math.inf
+    for _ in range(_REPS):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _probe_size(n_rows: int) -> dict[str, float]:
+    import jax
+
+    from kubedtn_tpu import checkpoint
+
+    store, engine, registry, _daemon, plane = _build(n_rows)
+
+    def alloc_churn():
+        with engine._lock:
+            rows = []
+            for j in range(_ALLOC_OPS):
+                rows.append(engine._alloc("probe/churn", 900_000 + j))
+            for j, r in enumerate(rows):
+                engine._rows.pop(("probe/churn", 900_000 + j), None)
+                engine._row_owner.pop(r, None)
+                engine._free_row(r)
+
+    def drain_policy():
+        now = 1.0
+        for _ in range(_POLICY_CALLS):
+            registry.drain_policy(64, now)
+            now += 0.01
+
+    def stage_barrier():
+        for _ in range(_BARRIER_CALLS):
+            plane.stage_update_round(lambda: None)
+
+    def compact():
+        out = engine.compact()
+        jax.block_until_ready(engine.state.props)
+        return out
+
+    def save():
+        with tempfile.TemporaryDirectory() as td:
+            checkpoint.save(os.path.join(td, "ckpt"), store, engine)
+
+    times = {}
+    # warm each phase once (jit compiles, allocator high-water) before
+    # the timed reps
+    for name, fn in (("alloc_churn", alloc_churn),
+                     ("drain_policy", drain_policy),
+                     ("stage_barrier", stage_barrier),
+                     ("compact", compact),
+                     ("checkpoint_save", save)):
+        fn()
+        times[name] = _timed(fn)
+    # explicit teardown: 1M-row planes hold ~100MB of device arrays
+    del plane, engine, store, registry
+    return times
+
+
+def fit_slope(sizes, seconds) -> float:
+    """Least-squares slope of log(seconds) vs log(rows). Times are
+    floored at 20µs first: below that the measurement is timer noise
+    and a 2µs→8µs wobble must not read as 'superlinear'."""
+    xs = np.log(np.asarray(sizes, np.float64))
+    ys = np.log(np.maximum(np.asarray(seconds, np.float64), 2e-5))
+    if xs.size < 2:
+        return 0.0
+    return float(np.polyfit(xs, ys, 1)[0])
+
+
+def run_probe(sizes: list[int]) -> dict:
+    """The probe report: per-phase wall times at each size + fitted
+    slope. Sizes are directed-row counts (engine capacity pads to the
+    next power of two)."""
+    per_phase: dict[str, list[float]] = {}
+    for n in sizes:
+        times = _probe_size(int(n))
+        for name, s in times.items():
+            per_phase.setdefault(name, []).append(s)
+    return {
+        "sizes": [int(s) for s in sizes],
+        "phases": {
+            name: {
+                "seconds": [round(s, 6) for s in secs],
+                "slope": round(fit_slope(sizes, secs), 3),
+            }
+            for name, secs in per_phase.items()
+        },
+    }

@@ -1,0 +1,119 @@
+"""dtnscale runner: the empirical probe gate + its result cache.
+
+The static half runs inside `analysis.run_suite` (so scost findings
+share the waiver/stale machinery with every other rule); this module
+owns the part that costs real time — building engines and timing the
+host paths — and caches it exactly like the dtnverify trace cache:
+keyed on a content hash of the package tree plus SCALE_BUDGET.json,
+replayed only under ``--cached`` (`make verify-fast`), refreshed by
+every full run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+from kubedtn_tpu.analysis.core import RULE_SCOST, Finding
+from kubedtn_tpu.analysis.scale import budget as budget_mod
+
+CACHE_FILE = ".dtnscale-cache.json"
+_CACHE_SCHEMA = 1
+
+
+def _tree_hash(root: Path) -> str:
+    import numpy as np
+
+    h = hashlib.sha256()
+    # numpy drives every columnar path the probe times; a version
+    # change must miss the cache like a jax change misses dtnverify's
+    h.update(f"numpy={np.__version__};".encode())
+    for p in sorted((root / "kubedtn_tpu").rglob("*.py")):
+        h.update(p.relative_to(root).as_posix().encode())
+        h.update(p.read_bytes())
+    budget = root / budget_mod.BUDGET_FILE
+    if budget.exists():
+        h.update(budget.read_bytes())
+    return h.hexdigest()
+
+
+def _load_cache(root: Path, key: str):
+    p = root / CACHE_FILE
+    if not p.exists():
+        return None
+    try:
+        doc = json.loads(p.read_text())
+    except (OSError, ValueError):
+        return None
+    if doc.get("tree_hash") != key or doc.get("schema") != _CACHE_SCHEMA:
+        return None
+    findings = [Finding(**f) for f in doc.get("findings", [])]
+    return findings, dict(doc.get("probe", {}))
+
+
+def _save_cache(root: Path, key: str, findings, probe: dict) -> None:
+    doc = {"schema": _CACHE_SCHEMA, "tree_hash": key,
+           "findings": [f.to_json() for f in findings],
+           "probe": dict(probe)}
+    try:
+        (root / CACHE_FILE).write_text(json.dumps(doc) + "\n")
+    except OSError:
+        pass  # the cache is an optimization, never a failure
+
+
+def run_scale(root: Path, use_cache: bool = False,
+              update_budgets: bool = False,
+              sizes: list[int] | None = None,
+              ) -> tuple[list[Finding], dict]:
+    """Run (or replay) the empirical probe and gate its fitted slopes
+    against SCALE_BUDGET.json. With `update_budgets`, re-baseline the
+    budget file from the measured slopes instead of checking.
+    Returns (findings, probe report)."""
+    from kubedtn_tpu.analysis.scale.probe import run_probe
+
+    doc = budget_mod.load_budget(root)
+    cache_key = (_tree_hash(root)
+                 if sizes is None and not update_budgets else None)
+    if use_cache and cache_key is not None:
+        hit = _load_cache(root, cache_key)
+        if hit is not None:
+            findings, probe = hit
+            probe["cache"] = "hit"
+            return findings, probe
+
+    probe = run_probe(sizes if sizes is not None
+                      else budget_mod.probe_sizes(doc))
+    measured = {name: ph["slope"]
+                for name, ph in probe["phases"].items()}
+
+    findings: list[Finding] = []
+    if update_budgets:
+        newdoc = budget_mod.write_budget(root, measured)
+        probe["budget_updated"] = True
+        probe["ceilings"] = newdoc["probe"]["max_slope"]
+        return findings, probe
+
+    ceilings = budget_mod.probe_slopes(doc)
+    probe["ceilings"] = ceilings
+    for name, slope in sorted(measured.items()):
+        limit = ceilings.get(name)
+        if limit is None or slope <= limit:
+            continue
+        secs = probe["phases"][name]["seconds"]
+        if max(secs) < 0.005:
+            # sub-5ms at the largest size: pure timer noise, and
+            # trivially within any host budget — a path that later
+            # grows with plane size will cross the floor and get
+            # judged (the bench-scale 1M run makes real growth
+            # unmissable)
+            continue
+        findings.append(Finding(
+            RULE_SCOST, budget_mod.BUDGET_FILE, 1,
+            f"[probe] `{name}` wall time scales superlinearly past "
+            f"its budget: fitted slope {slope:.2f} > {limit:.2f} "
+            f"over rows {probe['sizes']} — host work on this path "
+            f"grew with plane size"))
+    if cache_key is not None:
+        _save_cache(root, cache_key, findings, probe)
+    return findings, probe

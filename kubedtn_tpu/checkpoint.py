@@ -387,7 +387,7 @@ def _save_traced(path: str, store: TopologyStore, engine: SimEngine,
                 "rows": [[k[0], k[1], v] for k, v in engine._rows.items()],
                 "peer": [[k[0], k[1], v[0], v[1]]
                          for k, v in engine._peer.items()],
-                "free": engine._free,
+                "free": engine._free.tolist(),
                 "alive": sorted(engine._topology_manager),
             },
             "has_sim": sim is not None,
@@ -407,8 +407,8 @@ def _save_traced(path: str, store: TopologyStore, engine: SimEngine,
             # pool AND from the new blocks). A tenancy-less load keeps
             # them in the global pool — also correct.
             manifest["engine"]["free"] = (
-                engine._free + sorted(tenancy.reserved_free_rows(),
-                                      reverse=True))
+                engine._free.tolist()
+                + sorted(tenancy.reserved_free_rows(), reverse=True))
         mpath = os.path.join(tmp, "manifest.json")
         with open(mpath, "w") as f:
             json.dump(manifest, f)
@@ -477,15 +477,28 @@ def _load_traced(path: str) -> tuple[TopologyStore, SimEngine]:
         engine._shaped_rows = set(int(r) for r in shaped)
 
     try:
+        from kubedtn_tpu.topology.engine import link_key_id
+        from kubedtn_tpu.topology.freelist import FreeStack
+
         eng = manifest["engine"]
         engine._pod_ids = dict(eng["pod_ids"])
+        engine._pod_names = {v: k for k, v in engine._pod_ids.items()}
         engine._rows = {(p, int(u)): int(r) for p, u, r in eng["rows"]}
         engine._row_owner = {r: k for k, r in engine._rows.items()}
+        # per-row identity key ids are derivable state: re-derive the
+        # columnar column in the same registry pass (a restored link
+        # must keep its identity-keyed PRNG stream — leaving the
+        # column zeroed would silently drop every restored row back
+        # to the legacy unkeyed draws)
+        for (p, u), r in engine._rows.items():
+            engine._row_keyid[r] = link_key_id(p, int(u))
         engine._peer = {(p, int(u)): (pp, int(pu))
                         for p, u, pp, pu in eng["peer"]}
-        engine._free = [int(x) for x in eng["free"]]
+        engine._free = FreeStack(eng["free"])
         engine._topology_manager = set(eng["alive"])
-    except (KeyError, TypeError, ValueError) as e:
+    except (KeyError, TypeError, ValueError, IndexError) as e:
+        # IndexError: a manifest row beyond the stated capacity hits
+        # the columnar key-id write — damage, same typed contract
         raise CheckpointCorruptError(
             f"malformed engine registries in {dirpath}: {e}") from e
     return store, engine

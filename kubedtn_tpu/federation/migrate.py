@@ -400,7 +400,11 @@ class MigrationCoordinator:
             with engine._lock:
                 engine._flush_device_locked()
                 st = engine._state
-                id_to_name = {v: k for k, v in engine._pod_ids.items()}
+                # incrementally-maintained inverse of _pod_ids: the
+                # fork barrier must be O(tenant rows), and rebuilding
+                # the whole inverse map here was an O(all pods) walk
+                # inside the tick-lock barrier (dtnscale scost)
+                id_to_name = engine._pod_names
                 src_col = np.asarray(st.src)
                 dst_col = np.asarray(st.dst)
                 identities = []
@@ -413,9 +417,13 @@ class MigrationCoordinator:
                         id_to_name.get(int(src_col[r]), pod_key),
                         id_to_name.get(int(dst_col[r]), pod_key),
                         bool(r in engine._shaped_rows)])
-                peers = [[k[0], k[1], p[0], p[1]]
-                         for k, p in engine._peer.items()
-                         if k in keyset and p in keyset]
+                # walk the TENANT's keys, not the whole peer registry
+                # (sorted for a deterministic fork record)
+                peers = []
+                for k in sorted(keyset):
+                    p = engine._peer.get(k)
+                    if p is not None and p in keyset:
+                        peers.append([k[0], k[1], p[0], p[1]])
                 arrays = {
                     "rows": rows.astype(np.int64),
                     "props": np.asarray(st.props)[rows],
@@ -434,8 +442,7 @@ class MigrationCoordinator:
                     })
             wires = [[w.pod_key, int(w.uid), w.peer_ip,
                       int(w.peer_intf_id), w.node_iface_name]
-                     for w in src.daemon.wires.all()
-                     if w.pod_key.partition("/")[0] in set(spaces)]
+                     for w in src.daemon.wires.in_namespaces(spaces)]
             fork = {
                 "identities": identities,
                 "peers": peers,
@@ -753,8 +760,8 @@ class MigrationCoordinator:
             return src.engine.abandon_rows(keys)
 
         freed = src.plane.stage_update_round(_free)
-        pod_keys = {w.pod_key for w in src.daemon.wires.all()
-                    if w.pod_key.partition("/")[0] in spaces}
+        pod_keys = {w.pod_key
+                    for w in src.daemon.wires.in_namespaces(spaces)}
         for pk in pod_keys:
             src.daemon.wires.delete_by_pod(pk)
         for rec in fork["topologies"]:

@@ -44,34 +44,39 @@ def shard_of_rows(rows: npt.ArrayLike, capacity: int,
     return np.asarray(rows, np.int64) // loc
 
 
-def pick_pair_rows(free: list[int], capacity: int, n_shards: int,
+def pick_pair_rows(free, capacity: int, n_shards: int,
                    scan_limit: int = 64) -> tuple[int, int]:
     """Pop TWO free rows colocated in one shard block where possible.
 
-    `free` is the engine's free-list STACK (pop from the end). The first
-    row pops normally; the second is the nearest free row (scanning at
-    most `scan_limit` entries from the top) in the SAME block — falling
+    `free` is the engine's columnar free-list STACK
+    (`topology.freelist.FreeStack`; pop from the top). The first row
+    pops normally; the second is the nearest free row to the top —
+    within a `scan_limit`-entry window — in the SAME block, falling
     back to a plain pop when the block has no other free row in reach.
-    O(scan_limit) worst case, O(1) in the common fresh-allocation case
-    (the free list is initialized descending, so consecutive pops are
-    consecutive rows)."""
+    The window scan is ONE vectorized compare over at most
+    `scan_limit` int32 entries (the historical per-element Python
+    scan, byte-identical pick order), O(1) in the common
+    fresh-allocation case (the free list is initialized descending,
+    so consecutive pops are consecutive rows)."""
     r1 = free.pop()
     if n_shards <= 1:
         return r1, free.pop()
     loc = capacity // n_shards
     blk = r1 // loc
-    top = free[-1]
-    if top // loc == blk:
-        free.pop()
-        return r1, top
-    lo = max(0, len(free) - scan_limit)
-    for i in range(len(free) - 2, lo - 1, -1):
-        if free[i] // loc == blk:
-            return r1, free.pop(i)
+    # duck-typed: FreeStack gives a zero-copy window; a plain list
+    # (tests, embedders) pays one small copy
+    window = (free.top_view(scan_limit) if hasattr(free, "top_view")
+              else np.asarray(free[max(0, len(free) - scan_limit):],
+                              np.int64))
+    hits = np.nonzero(window // loc == blk)[0]
+    if hits.size:
+        i = len(free) - window.shape[0] + int(hits[-1])
+        return r1, (free.pop_at(i) if hasattr(free, "pop_at")
+                    else free.pop(i))
     return r1, free.pop()
 
 
-def tenant_blocks(free: list[int], capacity: int, n_shards: int,
+def tenant_blocks(free, capacity: int, n_shards: int,
                   requests: list[int]) -> list[tuple[int, int] | None]:
     """Carve a CONTIGUOUS run of currently-free rows out of the
     engine's free list for EACH requested tenant edge block, in ONE
@@ -92,7 +97,8 @@ def tenant_blocks(free: list[int], capacity: int, n_shards: int,
     leaves that tenant on the shared pool)."""
     loc = (capacity // n_shards
            if n_shards > 1 and capacity % n_shards == 0 else capacity)
-    rows = np.sort(np.asarray(free, np.int64))
+    rows = np.sort(np.asarray(
+        free.view() if hasattr(free, "view") else free, np.int64))
     # maximal contiguous runs as half-open [lo, hi) intervals, kept
     # sorted as carved windows split them
     runs: list[tuple[int, int]] = []
@@ -102,7 +108,7 @@ def tenant_blocks(free: list[int], capacity: int, n_shards: int,
         runs = [(int(rows[a]), int(rows[b - 1]) + 1)
                 for a, b in zip(starts[:-1], starts[1:])]
     out: list[tuple[int, int] | None] = []
-    taken: set[int] = set()
+    carved: list[tuple[int, int]] = []
     for n_rows in requests:
         if n_rows <= 0:
             out.append(None)
@@ -134,14 +140,24 @@ def tenant_blocks(free: list[int], capacity: int, n_shards: int,
         rlo, rhi = runs[idx]
         runs[idx:idx + 1] = [r for r in ((rlo, lo), (hi, rhi))
                              if r[1] > r[0]]
-        taken.update(range(lo, hi))
+        carved.append((lo, hi))
         out.append((lo, hi))
-    if taken:
-        free[:] = [r for r in free if r not in taken]
+    if carved:
+        # ONE vectorized order-preserving filter of the free stack
+        # (FreeStack.remove_rows) — the historical per-element
+        # `[r for r in free if r not in taken]` rebuild was an
+        # O(capacity) Python walk under the engine lock
+        taken = np.concatenate(
+            [np.arange(lo, hi, dtype=np.int64) for lo, hi in carved])
+        if hasattr(free, "remove_rows"):
+            free.remove_rows(taken)
+        else:  # plain-list callers (tests, embedders)
+            tset = set(taken.tolist())
+            free[:] = [r for r in free if r not in tset]
     return out
 
 
-def tenant_block(free: list[int], capacity: int, n_shards: int,
+def tenant_block(free, capacity: int, n_shards: int,
                  n_rows: int) -> tuple[int, int] | None:
     """Single-request form of `tenant_blocks` (same preference order
     and free-list contract)."""

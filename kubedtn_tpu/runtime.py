@@ -1209,8 +1209,8 @@ class _ShapeJob:
 
     __slots__ = ("now_s", "base_us", "shaped_at", "prev_shaped_s",
                  "batches", "rowinfo", "groups", "state", "dyn_before",
-                 "dyn_after", "sub", "touched_after", "force_rows",
-                 "samples", "has_tel")
+                 "dyn_after", "sub", "touched_after", "touched_all",
+                 "force_rows", "samples", "has_tel")
 
     def __init__(self, now_s, base_us, shaped_at, prev_shaped_s,
                  batches, rowinfo, state) -> None:
@@ -1226,6 +1226,10 @@ class _ShapeJob:
         self.dyn_after = None
         self.sub = None
         self.touched_after: set[int] = set()
+        # compact() renumbered every row after this dispatch: the whole
+        # write-back is void (the "all rows touched" form, raised as a
+        # flag so nobody materializes an O(capacity) row set)
+        self.touched_all: bool = False
         # rows an OLDER job's TBF fallback corrected after this job
         # dispatched: this job's device results for them came from the
         # stale pre-correction chain, so _complete re-shapes them with
@@ -2257,12 +2261,18 @@ class WireDataPlane:
             # per-row identity fold_in constants for the keyed uniform
             # draws (engine.link_key_id; 0 for a row the registry lost)
             keyid_map: dict[int, int] = {}
+            keyid_col = engine._row_keyid
             for _w, row, _lens, _fr, _pd in batches:
                 key = engine._row_owner.get(row)
                 rowinfo[row] = (engine._peer.get(key, key)
                                 if key is not None else None)
-                keyid_map[row] = engine._row_keyid.get(row, 0)
-            shaped_rows = set(engine._shaped_rows)
+                keyid_map[row] = int(keyid_col[row])
+            # batch-scoped shaped verdicts: only THIS dispatch's rows
+            # are ever tested downstream, so snapshotting the whole
+            # engine set was an O(active-rows) copy per tick (dtnscale:
+            # the steady tick must be capacity-independent)
+            shaped_all = engine._shaped_rows
+            shaped_rows = {b[1] for b in batches if b[1] in shaped_all}
             dstrow: dict[int, int] = {}
             if self._shard_mesh is not None:
                 # destination (peer) edge rows, for the cross-shard
@@ -2296,6 +2306,16 @@ class WireDataPlane:
             # columns are patched to the engine's fresh values so THIS
             # dispatch shapes them from their re-initialized state —
             # after which the touch is fully incorporated and clears.
+            # compact() raises the whole-capacity form as a FLAG
+            # (_touched_all): in-flight write-backs are void wholesale
+            # and the chain restarts from the repacked engine columns —
+            # one vectorized refresh, never an O(capacity) Python set.
+            if engine._touched_all:
+                for j in self._inflight:
+                    j.touched_all = True
+                if self._pipe_state is not None:
+                    self._pipe_state = _dyn_of(state)
+                engine._touched_all = False
             touched = engine._rows_touched
             if touched:
                 for j in self._inflight:
@@ -2831,7 +2851,16 @@ class WireDataPlane:
                         for col, val in zip(dyn, cols))
         with engine._lock:
             cur = engine._state
-            if cur.capacity == dyn[0].shape[0]:
+            if job.touched_all or engine._touched_all:
+                # compact() renumbered every row since this job
+                # dispatched: the merge-out rule covers ALL rows, so
+                # the write-back is a whole-state no-op — keep the
+                # engine's (repacked) columns and only advance the
+                # shaping clock (byte-identical to the historical
+                # all-rows skip set, without materializing it)
+                if cur.capacity == dyn[0].shape[0]:
+                    self._last_shaped_s = job.shaped_at
+            elif cur.capacity == dyn[0].shape[0]:
                 skip = job.touched_after
                 if engine._rows_touched:
                     # touched after this job's dispatch but not yet

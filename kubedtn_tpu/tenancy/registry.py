@@ -11,17 +11,25 @@ The registry is the single source of truth for tenant identity:
   blocks). The engine's allocator consults `alloc_row`/`alloc_pair`
   first, so the tenant's links pack into its block; freed block rows
   return to the tenant's pool, never to another tenant.
-- **accounting row sets**: per-tenant counter/telemetry slices derive
-  from the ENGINE REGISTRIES (`_rows` + namespace mapping), cached per
-  `engine._rows_gen` — exact through `compact()`'s renumbering (the
-  plane permutes its counters with the same mapping the registries
-  use), whether or not blocks are reserved. Blocks are an allocation
-  and isolation-audit structure, not the accounting source of truth;
-  a global compact dissolves them (rows were renumbered) and the
-  registry immediately re-carves each tenant's reservation at its
-  full requested size from the repacked free list (`on_compact`);
-  when a re-carve no longer fits, the tenant heals on the next
-  compact or `create(block_edges=...)`.
+- **accounting row sets**: per-tenant counter/telemetry slices come
+  from COLUMNAR OWNERSHIP MASKS (one capacity-sized bool column per
+  tenant plus a row→tenant int column), maintained incrementally at
+  every row bind/unbind (`note_bind`/`note_unbind`, O(1) per row) and
+  permuted through `compact()`'s renumbering with the same vectorized
+  gather the SoA columns use — exact through the repack, whether or
+  not blocks are reserved. The historical accounting re-derived each
+  tenant's row set from the engine registries per registry
+  generation: an O(all-rows) Python walk after EVERY alloc/free,
+  which the dtnscale layer now budgets out. A namespace-binding
+  change (tenant create/delete, bind_namespace) is the one slow
+  path: it marks the masks stale and the next `rows_of` rebuilds
+  them in one pass. Blocks are an allocation and isolation-audit
+  structure, not the accounting source of truth; a global compact
+  dissolves them (rows were renumbered) and the registry immediately
+  re-carves each tenant's reservation at its full requested size from
+  the repacked free list (`on_compact`); when a re-carve no longer
+  fits, the tenant heals on the next compact or
+  `create(block_edges=...)`.
 """
 
 from __future__ import annotations
@@ -105,9 +113,31 @@ class TenantRegistry:
         # persisted: a restarted daemon resumes the migration from its
         # journal, which re-applies the hold)
         self._holds: set[str] = set()
-        # per-tenant row-set cache, invalidated by engine._rows_gen
-        self._rows_cache: dict[str, np.ndarray] = {}
-        self._rows_cache_gen: int = -1
+        # -- columnar per-tenant accounting (see module docstring) ----
+        # row → tenant id (-1 = untenanted) and one bool ownership
+        # mask per tenant, maintained incrementally by note_bind/
+        # note_unbind and permuted vectorized through compact
+        cap = int(engine._state.capacity)
+        self._cap = cap
+        self._row_tenant: np.ndarray = np.full((cap,), -1, np.int32)
+        # row → id of the tenant whose RESERVED BLOCK contains it
+        # (-1 = global pool): release_row resolves a freed row's pool
+        # in O(1) instead of scanning every tenant's block bounds
+        # per row (freeing N rows was O(N·tenants))
+        self._block_owner: np.ndarray = np.full((cap,), -1, np.int32)
+        self._masks: dict[str, np.ndarray] = {}
+        self._tenant_ids: dict[str, int] = {}   # name → stable int id
+        self._tenant_names: list[str] = []      # id → name
+        # namespace bindings changed since the masks were built: the
+        # next rows_of rebuilds them in ONE pass (the rare control-
+        # plane path; the steady alloc/free path stays incremental)
+        self._masks_stale: bool = True
+        # unused rows currently held inside tenant blocks, maintained
+        # as ONE counter at carve/alloc/release/dissolve time — the
+        # engine's _ensure_capacity reads it on barrier paths, where
+        # a per-call walk of every tenant's pool was a redundant
+        # accounting re-derive (dtnscale scost)
+        self._reserved_free_n: int = 0
         engine.tenancy = self
 
     # -- lifecycle -----------------------------------------------------
@@ -137,10 +167,14 @@ class TenantRegistry:
             existing = self._tenants.get(name)
             if existing is not None:
                 for ns in (set(namespaces) if namespaces else {name}):
+                    newly = ns not in self._ns_map
                     # never steal a namespace already mapped elsewhere
                     if self._ns_map.setdefault(ns, name) == name:
                         existing.namespaces.add(ns)
-                self._rows_cache_gen = -1
+                        if newly:
+                            # a new binding may adopt already-realized
+                            # rows: rebuild the masks on next query
+                            self._masks_stale = True
                 out = self.set_quota(name, qos=qos,
                                      frame_budget_per_s=
                                      frame_budget_per_s,
@@ -181,13 +215,19 @@ class TenantRegistry:
         # move or resize once reserved.
         with self._lock:
             won = self._tenants.setdefault(name, t)
+            if won.name not in self._tenant_ids:
+                # stable accounting id (never reused — a deleted
+                # tenant's residual _row_tenant entries must not alias
+                # a later tenant's mask)
+                self._tenant_ids[won.name] = len(self._tenant_names)
+                self._tenant_names.append(won.name)
             for ns in t.namespaces:
                 # bind this call's namespaces to whoever WON the
                 # publish race: admission (ns_map) and accounting
                 # (won.namespaces) must agree on every namespace
                 if self._ns_map.setdefault(ns, won.name) == won.name:
                     won.namespaces.add(ns)
-            self._rows_cache_gen = -1
+            self._masks_stale = True
             need_block = block_edges > 0 and won.block is None
         if need_block:
             # a reservation failure (ValueError) leaves the tenant
@@ -262,7 +302,23 @@ class TenantRegistry:
                 t.block = blk
                 t.block_rows = n_rows
                 t.block_free = self._block_free_of(blk)
+                self._reserved_free_n += len(t.block_free)
+                self._set_block_owner_locked(t, blk)
         return True
+
+    def _set_block_owner_locked(self, t: Tenant,
+                                blk: tuple[int, int]) -> None:
+        """Vectorized range-write of the block-owner column (caller
+        holds the registry lock; the tenant must be published so it
+        has a stable id)."""
+        tid = self._tenant_ids.get(t.name)
+        if tid is None:
+            return
+        if blk[1] > self._block_owner.shape[0]:
+            grown = np.full((blk[1],), -1, np.int32)
+            grown[:self._block_owner.shape[0]] = self._block_owner
+            self._block_owner = grown
+        self._block_owner[blk[0]:blk[1]] = tid
 
     def set_quota(self, name: str, qos: str | None = None,
                   frame_budget_per_s: float | None = None,
@@ -286,7 +342,7 @@ class TenantRegistry:
             t = self._tenants[tenant]
             t.namespaces.add(namespace)
             self._ns_map[namespace] = tenant
-            self._rows_cache_gen = -1
+            self._masks_stale = True
 
     def ensure_namespace(self, namespace: str) -> Tenant | None:
         """Reconciler hook: namespace → tenant mapping. An unmapped
@@ -339,12 +395,17 @@ class TenantRegistry:
                         del self._ns_map[ns]
                 self._holds.discard(name)
                 freed = list(t.block_free)
+                if t.block is not None:
+                    self._block_owner[t.block[0]:t.block[1]] = -1
                 t.block = None
                 t.block_free = []
-                self._rows_cache_gen = -1
+                self._reserved_free_n -= len(freed)
+                self._masks.pop(name, None)
+                self._masks_stale = True
             if freed:
                 # descending like the global pool: consecutive pops
-                # keep handing out consecutive rows
+                # keep handing out consecutive rows (vectorized fold —
+                # the extend is one numpy copy, not a per-row append)
                 engine._free.extend(sorted(freed, reverse=True))
         self.log.info("tenant deleted %s", _fields(
             tenant=name, freed_reserve=len(freed)))
@@ -391,27 +452,46 @@ class TenantRegistry:
         t = self.tenant_of_pod_key(pod_key)
         if t is None or not t.block_free:
             return None
-        return t.block_free.pop()
+        row = t.block_free.pop()
+        with self._lock:
+            self._reserved_free_n -= 1
+        return row
 
     def alloc_pair(self, k1: str, k2: str) -> tuple[int, int] | None:
         t1 = self.tenant_of_pod_key(k1)
         t2 = self.tenant_of_pod_key(k2)
         if t1 is None or t1 is not t2 or len(t1.block_free) < 2:
             return None
-        return t1.block_free.pop(), t1.block_free.pop()
+        pair = t1.block_free.pop(), t1.block_free.pop()
+        with self._lock:
+            self._reserved_free_n -= 2
+        return pair
 
     def release_row(self, row: int) -> bool:
         with self._lock:
-            for t in self._tenants.values():
-                if t.block is not None and t.block[0] <= row < t.block[1]:
-                    t.block_free.append(row)
-                    return True
-        return False
+            # O(1) via the columnar block-owner column — the per-row
+            # scan of every tenant's block bounds made freeing N rows
+            # O(N·tenants) (dtnscale scost on the alloc path). The
+            # positional re-check keeps a stale column entry (block
+            # dissolved out-of-band) from resurrecting a dead pool.
+            tid = (int(self._block_owner[row])
+                   if row < self._block_owner.shape[0] else -1)
+            if tid < 0:
+                return False
+            t = self._tenants.get(self._tenant_names[tid])
+            if t is None or t.block is None or \
+                    not t.block[0] <= row < t.block[1]:
+                return False
+            t.block_free.append(row)
+            self._reserved_free_n += 1
+            return True
 
     def reserved_free(self) -> int:
+        """Unused rows inside tenant blocks — ONE incrementally-
+        maintained counter (O(1)); callers on the barrier paths
+        (engine._ensure_capacity) read it per operation."""
         with self._lock:
-            return sum(len(t.block_free)
-                       for t in self._tenants.values())
+            return self._reserved_free_n
 
     def reserved_free_rows(self) -> list[int]:
         """Every unused row currently held inside a tenant block. The
@@ -427,31 +507,45 @@ class TenantRegistry:
                 out.extend(t.block_free)
             return out
 
-    def on_compact(self, mapping: dict) -> None:
-        """compact() renumbered every row: the old contiguous blocks
-        are gone (their active rows moved into [0, n), their unused
-        reserve returned to the rebuilt global free list). Each
-        tenant's reservation is immediately re-carved at its FULL
-        requested size (`block_rows`) — never just the unused
-        remainder, which would decay the entitlement on every
-        compact/free cycle (rows allocated before the repack live
-        outside the new block and drain back to the global pool as
-        they free) — so one tenant's repack can never silently strip
-        or shrink another tenant's reservation. A re-carve that no
-        longer fits (capacity claimed by active rows, shard-locality
-        fragmentation from earlier re-carves) leaves that tenant
-        dissolved — with `block_rows` remembered, so the NEXT compact
-        or `create(block_edges=...)` heals it. Accounting is row-set
-        based and unaffected throughout. Called by engine.compact with
+    def on_compact(self, old_rows: np.ndarray, n_active: int,
+                   capacity: int) -> None:
+        """compact() renumbered every row (new row i held
+        ``old_rows[i]``): the old contiguous blocks are gone (their
+        active rows moved into [0, n), their unused reserve returned
+        to the rebuilt global free list). Each tenant's reservation is
+        immediately re-carved at its FULL requested size
+        (`block_rows`) — never just the unused remainder, which would
+        decay the entitlement on every compact/free cycle (rows
+        allocated before the repack live outside the new block and
+        drain back to the global pool as they free) — so one tenant's
+        repack can never silently strip or shrink another tenant's
+        reservation. A re-carve that no longer fits (capacity claimed
+        by active rows, shard-locality fragmentation from earlier
+        re-carves) leaves that tenant dissolved — with `block_rows`
+        remembered, so the NEXT compact or `create(block_edges=...)`
+        heals it. The accounting masks permute with the SAME
+        vectorized `old_rows` gather the SoA columns used, staying
+        exact through the renumbering. Called by engine.compact with
         the ENGINE lock held (re-entrant here — the lock order is
         engine before registry)."""
         from kubedtn_tpu.parallel.partition import tenant_blocks
 
-        del mapping
         engine = self.engine
         with engine._lock, self._lock:
+            if not self._masks_stale:
+                rt = np.full((capacity,), -1, np.int32)
+                rt[:n_active] = self._row_tenant[old_rows]
+                self._row_tenant = rt
+                for name, m in list(self._masks.items()):
+                    nm = np.zeros((capacity,), bool)
+                    nm[:n_active] = m[old_rows]
+                    self._masks[name] = nm
+                self._cap = capacity
             tenants = list(self._tenants.values())
+            self._block_owner = np.full(
+                (capacity,), -1, np.int32)  # blocks dissolve wholesale
             for t in tenants:
+                self._reserved_free_n -= len(t.block_free)
                 t.block = None
                 t.block_free = []
             # ONE sorted pass over the free list for the whole
@@ -471,7 +565,8 @@ class TenantRegistry:
                     continue
                 t.block = blk
                 t.block_free = self._block_free_of(blk)
-            self._rows_cache_gen = -1
+                self._reserved_free_n += len(t.block_free)
+                self._set_block_owner_locked(t, blk)
 
     # -- admission + QoS (the plane's tick-path surface) ---------------
 
@@ -543,29 +638,99 @@ class TenantRegistry:
                 t.bucket_frames.charge(frames, now_s)
                 t.bucket_bytes.charge(nbytes, now_s)
 
+    # -- columnar accounting maintenance (engine lock held) ------------
+
+    def note_bind(self, row: int, pod_key: str) -> None:
+        """Engine hook at row bind: set the owning tenant's mask bit —
+        O(1) per row, the incremental half of the columnar accounting.
+        Skipped while the masks are stale (the pending rebuild will
+        see this row in the registries)."""
+        with self._lock:
+            if self._masks_stale:
+                return
+            name = self._ns_map.get(pod_key.partition("/")[0])
+            if name is None:
+                return
+            m = self._masks.get(name)
+            if m is None or row >= m.shape[0]:
+                # capacity raced ahead of on_capacity (defensive):
+                # fall back to a rebuild
+                self._masks_stale = True
+                return
+            m[row] = True
+            self._row_tenant[row] = self._tenant_ids[name]
+
+    def note_unbind(self, row: int) -> None:
+        """Engine hook at row free: clear the owner's mask bit."""
+        with self._lock:
+            if self._masks_stale:
+                return
+            tid = int(self._row_tenant[row]) \
+                if row < self._row_tenant.shape[0] else -1
+            if tid < 0:
+                return
+            self._row_tenant[row] = -1
+            m = self._masks.get(self._tenant_names[tid])
+            if m is not None and row < m.shape[0]:
+                m[row] = False
+
+    def on_capacity(self, new_cap: int) -> None:
+        """Engine hook at capacity growth: pad the accounting columns
+        (vectorized copies, like the SoA growth itself)."""
+        with self._lock:
+            if new_cap <= self._cap:
+                return
+            # the block-owner column is allocation state, correct even
+            # while the accounting masks are stale — pad unconditionally
+            bo = np.full((new_cap,), -1, np.int32)
+            bo[:self._block_owner.shape[0]] = self._block_owner
+            self._block_owner = bo
+            if not self._masks_stale:
+                rt = np.full((new_cap,), -1, np.int32)
+                rt[:self._row_tenant.shape[0]] = self._row_tenant
+                self._row_tenant = rt
+                for name, m in list(self._masks.items()):
+                    nm = np.zeros((new_cap,), bool)
+                    nm[:m.shape[0]] = m
+                    self._masks[name] = nm
+            self._cap = new_cap
+
+    def _rebuild_masks_locked(self) -> None:
+        """ONE pass over the engine registries rebuilds every mask —
+        the namespace-binding slow path (tenant create/delete/bind);
+        the steady alloc/free path never lands here. Caller holds the
+        engine lock AND the registry lock."""
+        cap = int(self.engine._state.capacity)
+        self._cap = cap
+        self._row_tenant = np.full((cap,), -1, np.int32)
+        self._masks = {name: np.zeros((cap,), bool)
+                       for name in self._tenants}
+        for (pod_key, _uid), row in self.engine._rows.items():
+            name = self._ns_map.get(pod_key.partition("/")[0])
+            if name is None or name not in self._masks:
+                continue
+            self._masks[name][row] = True
+            self._row_tenant[row] = self._tenant_ids[name]
+        self._masks_stale = False
+
     # -- per-tenant slicing (counters + telemetry window ring) ---------
 
     def rows_of(self, name: str) -> np.ndarray:
-        """Current SoA rows owned by the tenant's namespaces, derived
-        from the engine registries under the engine lock and cached per
-        registry generation (exact through compact)."""
+        """Current SoA rows owned by the tenant's namespaces — one
+        vectorized `flatnonzero` over the tenant's incrementally-
+        maintained ownership mask (exact through compact: the mask
+        permutes with the engine's own row gather). The historical
+        implementation re-walked every engine row per registry
+        generation."""
         engine = self.engine
         with engine._lock:
-            gen = engine._rows_gen
-            if gen != self._rows_cache_gen:
-                self._rows_cache = {}
-                self._rows_cache_gen = gen
-            hit = self._rows_cache.get(name)
-            if hit is not None:
-                return hit
             with self._lock:
-                t = self._tenants.get(name)
-                spaces = set(t.namespaces) if t is not None else set()
-            rows = [row for (pod_key, _uid), row in engine._rows.items()
-                    if pod_key.partition("/")[0] in spaces]
-            out = np.asarray(sorted(rows), np.int64)
-            self._rows_cache[name] = out
-            return out
+                if self._masks_stale:
+                    self._rebuild_masks_locked()
+                m = self._masks.get(name)
+                if m is None:
+                    return np.asarray([], np.int64)
+                return np.flatnonzero(m).astype(np.int64)
 
     def tenant_counters(self, plane, name: str) -> dict:
         """This tenant's slice of the plane's cumulative per-edge

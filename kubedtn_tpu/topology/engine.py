@@ -43,6 +43,7 @@ from kubedtn_tpu.api.types import (LOCALHOST, PHYSICAL_PREFIX,
 from kubedtn_tpu.ops import edge_state as es
 from kubedtn_tpu.utils.logging import fields as _fields
 from kubedtn_tpu.utils.logging import get_logger
+from kubedtn_tpu.topology.freelist import FreeStack
 from kubedtn_tpu.topology.store import (
     NotFoundError,
     TopologyStore,
@@ -166,8 +167,12 @@ class SimEngine:
         self._shaped_rows: set[int] = set()
         # rows touched by control-plane ops since the data plane's last
         # snapshot — the tick's write-back keeps THEIR current dynamic
-        # state instead of its pre-snapshot copy (see runtime.py)
+        # state instead of its pre-snapshot copy (see runtime.py).
+        # `_touched_all` is the whole-capacity form compact() raises:
+        # the dispatch path treats it as "every row touched" without
+        # anyone materializing an O(capacity) Python set
         self._rows_touched: set[int] = set()
+        self._touched_all: bool = False
         self.stats = EngineStats()
         # per-action structured logs, the role of the reference's
         # WithField("daemon"/"action") context loggers
@@ -175,19 +180,26 @@ class SimEngine:
         self.log = get_logger("engine")
         # host-side registries (the daemon's managers):
         self._pod_ids: dict[str, int] = {}   # endpoint name -> node index
+        # persistent inverse of _pod_ids, maintained incrementally so
+        # barrier bodies (migration fork) never rebuild an O(pods)
+        # inverse map under the lock
+        self._pod_names: dict[int, str] = {}
         self._rows: dict[tuple[str, int], int] = {}  # (pod_key, uid) -> row
         # persistent inverse of _rows, maintained incrementally so the
         # data-plane tick never rebuilds an O(rows) map under the lock
         self._row_owner: dict[int, tuple[str, int]] = {}
         self._peer: dict[tuple[str, int], tuple[str, int]] = {}
-        self._free: list[int] = list(range(capacity - 1, -1, -1))
+        # columnar free list: O(1) pop/push, vectorized growth/rebuild
+        # (the historical Python list was rebuilt O(capacity) on every
+        # grow/compact — the dtnscale layer budgets those walks out)
+        self._free: FreeStack = FreeStack.from_range(0, capacity)
         # row -> stable 64-bit key id (link_key_id of the owning
         # (pod_key, uid)): the per-row fold_in constant the shaping
-        # kernels key their uniforms by (multi-tenant byte-identity)
-        self._row_keyid: dict[int, int] = {}
-        # bumped on every registry mutation (alloc/free/compact): the
-        # tenancy layer caches its per-tenant row sets against it
-        self._rows_gen: int = 0
+        # kernels key their uniforms by (multi-tenant byte-identity).
+        # Columnar (capacity-sized uint64, 0 = unbound) so compact()'s
+        # renumbering is one vectorized gather, not a per-row FNV
+        # re-derive
+        self._row_keyid: np.ndarray = np.zeros((capacity,), np.uint64)
         # optional tenancy.TenantRegistry (set by TenantRegistry.attach):
         # consulted at row allocation so tenant-reserved blocks steer
         # the free list, and at free so block rows return to their pool
@@ -246,6 +258,7 @@ class SimEngine:
         pid = self._pod_ids.get(endpoint)
         if pid is None:
             pid = self._pod_ids[endpoint] = len(self._pod_ids)
+            self._pod_names[pid] = endpoint
         return pid
 
     @_locked
@@ -275,7 +288,11 @@ class SimEngine:
         if self.tenancy is not None:
             # rows reserved inside tenant blocks but not yet realized
             # are unavailable to the global pool: count them or an
-            # all-reserved plane pops from an empty free list
+            # all-reserved plane pops from an empty free list.
+            # reserved_free() reads ONE incrementally-maintained
+            # counter — this runs on barrier/drain paths, where a
+            # per-call walk of every tenant's block pool was a
+            # redundant accounting re-derive (dtnscale scost)
             need += self.tenancy.reserved_free()
         cap = self._state.capacity
         if need <= cap:
@@ -285,7 +302,14 @@ class SimEngine:
         # growth commutes with pending row ops (rows are preserved and all
         # pending targets are < old capacity), so no flush is needed here
         self._state = es.grow_state(self._state, new_cap)
-        self._free = list(range(new_cap - 1, old_cap - 1, -1)) + self._free
+        # vectorized: new rows slide UNDER the existing free entries
+        # (same pop order as the historical list-concat rebuild)
+        self._free.prepend_range(old_cap, new_cap)
+        kid = np.zeros((new_cap,), np.uint64)
+        kid[:old_cap] = self._row_keyid
+        self._row_keyid = kid
+        if self.tenancy is not None:
+            self.tenancy.on_capacity(new_cap)
 
     # -- device op coalescing -----------------------------------------
     #
@@ -782,7 +806,11 @@ class SimEngine:
         self._rows[k] = row
         self._row_owner[row] = k
         self._row_keyid[row] = link_key_id(pod_key, uid)
-        self._rows_gen += 1
+        if self.tenancy is not None:
+            # per-tenant accounting masks are maintained incrementally
+            # at bind/unbind (columnar, O(1) per row) instead of being
+            # re-derived from the registries per generation
+            self.tenancy.note_bind(row, pod_key)
 
     def _alloc(self, pod_key: str, uid: int) -> int:
         k = (pod_key, uid)
@@ -804,11 +832,12 @@ class SimEngine:
         """Return a freed row to its pool: the owning tenant's block
         free list when the row sits in a reserved block, the global
         free list otherwise."""
-        self._row_keyid.pop(row, None)
-        self._rows_gen += 1
-        if self.tenancy is not None and self.tenancy.release_row(row):
-            return
-        self._free.append(row)
+        self._row_keyid[row] = 0
+        if self.tenancy is not None:
+            self.tenancy.note_unbind(row)
+            if self.tenancy.release_row(row):
+                return
+        self._free.push(row)
 
     def _alloc_link_pair(self, k1: str, k2: str, uid: int):
         """Allocate both directed rows of one link, colocated in one
@@ -940,28 +969,47 @@ class SimEngine:
             perm[:n] = old_rows
             self._state = es.compact_state(
                 self._state, jnp.asarray(perm), jnp.int32(n))
-            mapping = {int(o): i for i, o in enumerate(old_rows)}
-            self._rows = {k: mapping[r] for k, r in self._rows.items()}
-            self._row_owner = {r: k for k, r in self._rows.items()}
-            self._shaped_rows = {mapping[r] for r in self._shaped_rows
-                                 if r in mapping}
-            # key ids are identity-derived, so the remap is a re-derive
-            self._row_keyid = {r: link_key_id(k[0], k[1])
-                               for r, k in self._row_owner.items()}
-            self._rows_gen += 1
-            self._free = list(range(cap - 1, n - 1, -1))
+            # ONE pass over the sorted registry rebuilds both row maps
+            # (new row i == position i in sorted-key order); every
+            # other row-keyed column remaps as a vectorized gather
+            # through `new_of_old` — the historical per-row dict
+            # rebuilds and FNV re-derives were each their own
+            # O(active-rows) Python walk under the engine lock
+            rows_new: dict[tuple[str, int], int] = {}
+            owner_new: dict[int, tuple[str, int]] = {}
+            for i, (k, _r) in enumerate(items):
+                rows_new[k] = i
+                owner_new[i] = k
+            self._rows = rows_new
+            self._row_owner = owner_new
+            new_of_old = np.full((cap,), -1, np.int64)
+            new_of_old[old_rows] = np.arange(n)
+            if self._shaped_rows:
+                shaped_old = np.fromiter(self._shaped_rows, np.int64,
+                                         len(self._shaped_rows))
+                self._shaped_rows = set(
+                    new_of_old[shaped_old].tolist())
+                self._shaped_rows.discard(-1)
+            # key ids are identity-derived and identities are
+            # unchanged: the remap is one gather of the column
+            kid = np.zeros((cap,), np.uint64)
+            kid[:n] = self._row_keyid[old_rows]
+            self._row_keyid = kid
+            self._free = FreeStack.from_range(n, cap)
             if self.tenancy is not None:
                 # contiguous tenant blocks do not survive a global
                 # repack: the registry re-carves each tenant's
                 # reservation at its full requested size from the
                 # rebuilt free list (healing on the next compact or
                 # create when it doesn't fit); per-tenant ACCOUNTING
-                # is row-set based via _row_owner and stays exact
-                # through the renumbering
-                self.tenancy.on_compact(mapping)
+                # masks permute with the same old_rows gather the SoA
+                # columns used, staying exact through the renumbering
+                self.tenancy.on_compact(old_rows, n, cap)
             # the data plane's next write-back must not resurrect
-            # pre-compact dynamic state for any row
-            self._rows_touched = set(range(cap))
+            # pre-compact dynamic state for any row — raised as a flag,
+            # never materialized as an O(capacity) Python set
+            self._rows_touched.clear()
+            self._touched_all = True
             moved = int((old_rows != np.arange(n)).sum())
             live = []
             for ref in self._remap_callbacks:

@@ -394,12 +394,16 @@ class UpdateStager:
                 for images in reversed(entries):
                     for im in images:
                         self._restore_image_locked(im)
-                # reclaimed rows leave the free list in ONE pass — a
-                # per-row list.remove() would make a large rollback
-                # O(rows x free-list) inside the barrier (100k-link
-                # engines pause the runner for seconds)
-                owned = set(eng._row_owner)
-                eng._free = [r for r in eng._free if r not in owned]
+                # reclaimed rows leave the free list in ONE vectorized
+                # np.isin pass (FreeStack.remove_rows) — a per-row
+                # list.remove() would make a large rollback
+                # O(rows x free-list) inside the barrier, and even the
+                # one-pass Python comprehension it replaced walked the
+                # whole free list element-by-element (100k-link
+                # engines pause the runner for seconds either way)
+                owned = eng._row_owner
+                eng._free.remove_rows(
+                    np.fromiter(owned.keys(), np.int64, len(owned)))
             return True
 
         self.plane.stage_update_round(body)
@@ -449,8 +453,7 @@ class UpdateStager:
                 # still sitting on _free (the single post-pass filter
                 # removes them); popping one here would map two
                 # endpoints onto one row — drop owned leftovers first
-                while eng._free and eng._free[-1] in eng._row_owner:
-                    eng._free.pop()
+                eng._free.drop_top_while_in(eng._row_owner)
                 if not eng._free:
                     eng._ensure_capacity(1)  # never IndexError
                 row = eng._alloc(im.pod_key, im.uid)

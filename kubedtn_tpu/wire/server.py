@@ -264,6 +264,12 @@ class WireManager:
         self._next_wire_id = 1000
         self._by_id: dict[int, Wire] = {}
         self._by_key: dict[tuple[str, int], Wire] = {}
+        # namespace → wire keys, maintained incrementally: the
+        # federation fork/release paths slice one tenant's wires out
+        # of the registry, and a full `all()` walk inside a staging
+        # barrier is O(all wires) host work the dtnscale layer budgets
+        # out (tenant-scoped steps must be O(tenant rows))
+        self._by_ns: dict[str, set[tuple[str, int]]] = {}
         # called with the wire whenever frames are queued on its ingress
         # (the daemon wires this to its hot set); installed on EVERY
         # registered wire regardless of who constructed it
@@ -292,10 +298,20 @@ class WireManager:
             self._next_index += 1
             return f"{pod_name[:5]}{pod_intf[:5]}-{self._next_index:04d}"
 
+    def _index_ns(self, wire: Wire) -> None:
+        ns = wire.pod_key.partition("/")[0]
+        self._by_ns.setdefault(ns, set()).add((wire.pod_key, wire.uid))
+
+    def _unindex_ns(self, pod_key: str, uid: int) -> None:
+        keys = self._by_ns.get(pod_key.partition("/")[0])
+        if keys is not None:
+            keys.discard((pod_key, uid))
+
     def add(self, wire: Wire) -> None:
         with self._lock:
             self._by_id[wire.wire_id] = wire
             self._by_key[(wire.pod_key, wire.uid)] = wire
+            self._index_ns(wire)
             self._install_notify(wire)
 
     def get_or_create(self, pod_key: str, uid: int, build) -> tuple:
@@ -313,6 +329,7 @@ class WireManager:
             wire = build(self._next_wire_id)
             self._by_id[wire.wire_id] = wire
             self._by_key[(wire.pod_key, wire.uid)] = wire
+            self._index_ns(wire)
             self._install_notify(wire)
             return wire, True
 
@@ -332,6 +349,7 @@ class WireManager:
             if wire is None:
                 return False
             self._by_id.pop(wire.wire_id, None)
+            self._unindex_ns(pod_key, uid)
             return True
 
     def delete_by_pod(self, pod_key: str) -> int:
@@ -341,10 +359,24 @@ class WireManager:
             for w in doomed:
                 self._by_id.pop(w.wire_id, None)
                 self._by_key.pop((w.pod_key, w.uid), None)
+                self._unindex_ns(w.pod_key, w.uid)
             return len(doomed)
 
     def all(self) -> list[Wire]:
         return list(self._by_id.values())
+
+    def in_namespaces(self, spaces) -> list[Wire]:
+        """Wires whose pod lives in one of `spaces`, via the namespace
+        index — O(matching wires), in wire-id (creation) order like a
+        filtered `all()` walk. The federation fork barrier slices one
+        tenant's wires with this instead of filtering `all()` (O(all
+        wires) inside a tick-lock barrier)."""
+        with self._lock:
+            keys = [k for ns in spaces for k in self._by_ns.get(ns, ())]
+            out = [w for w in (self._by_key.get(k) for k in keys)
+                   if w is not None]
+            out.sort(key=lambda w: w.wire_id)
+            return out
 
 
 class Daemon:

@@ -1,0 +1,11 @@
+"""dtnscale fixture: a capacity-classified walk nested inside a
+per-row loop — the rollback-reclaim shape that made large rollbacks
+O(rows × free-list). Superlinear: flagged even under an O(capacity)
+budget. Parsed, never imported."""
+
+
+def rollback(self, entries):
+    for images in entries:
+        doomed = set(images)
+        self._free = [r for r in self._free if r not in doomed]
+    return len(entries)

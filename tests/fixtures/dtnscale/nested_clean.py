@@ -1,0 +1,14 @@
+"""dtnscale fixture: the single-pass reclaim — every journaled row
+leaves the free list in ONE vectorized pass after the per-image
+replay. Silent under an O(capacity) budget. Parsed, never
+imported."""
+
+import numpy as np
+
+
+def rollback(self, entries):
+    doomed = []
+    for images in entries:
+        doomed.extend(images)
+    self._free.remove_rows(np.asarray(doomed, np.int64))
+    return len(entries)

@@ -1,0 +1,11 @@
+"""dtnscale fixture: materializing an O(capacity) Python collection —
+the historical free-list rebuild. Flagged REGARDLESS of budget (even
+an O(capacity)-budget entry must keep linear passes columnar).
+Parsed, never imported."""
+
+
+def compact(self):
+    n = self.num_active
+    cap = self._state.capacity
+    self._free = list(range(cap - 1, n - 1, -1))
+    return n

@@ -1,0 +1,11 @@
+"""dtnscale fixture: the columnar free-list rebuild (one vectorized
+arange) — silent at any budget. Parsed, never imported."""
+
+import numpy as np
+
+
+def compact(self):
+    n = self.num_active
+    cap = self._state.capacity
+    self._free = np.arange(cap - 1, n - 1, -1, dtype=np.int32)
+    return n

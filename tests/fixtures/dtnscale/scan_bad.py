@@ -1,0 +1,11 @@
+"""dtnscale fixture: per-element free-list scans — `row in _free`
+membership and `_free.remove(row)` are O(capacity) per call, and the
+enclosing per-row loop makes the reclaim quadratic. Flagged
+regardless of budget. Parsed, never imported."""
+
+
+def reclaim(self, rows):
+    for row in rows:
+        if row in self._free:
+            self._free.remove(row)
+    return len(rows)

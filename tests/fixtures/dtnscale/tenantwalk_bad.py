@@ -1,0 +1,9 @@
+"""dtnscale fixture: the historical `reserved_free()` shape — an
+O(tenants) registry walk re-derived on a barrier path budgeted
+O(rows_touched). Parsed, never imported."""
+
+
+def ensure_capacity(self, extra):
+    need = self.num_active + extra
+    need += sum(len(t.block_free) for t in self._tenants.values())
+    return need

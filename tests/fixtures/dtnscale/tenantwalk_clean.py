@@ -1,0 +1,9 @@
+"""dtnscale fixture: the incrementally-maintained counter form of the
+reserved-rows accounting — O(1) per read. Silent under an
+O(rows_touched) budget. Parsed, never imported."""
+
+
+def ensure_capacity(self, extra):
+    need = self.num_active + extra
+    need += self._reserved_free_n
+    return need

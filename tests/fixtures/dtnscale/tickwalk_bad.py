@@ -1,0 +1,19 @@
+"""dtnscale fixture: a seeded O(capacity) walk inside a tick-path
+helper — the shape of the historical `set(engine._shaped_rows)`
+per-dispatch copy. The capacity-classified loop must be killed under
+an O(rows_touched) budget. Parsed, never imported."""
+
+
+def dispatch_inner(self, inputs):
+    batches = []
+    for wire, lens in inputs:  # rows_touched: the drained batch
+        row = self._rows.get((wire.pod_key, wire.uid))
+        if row is not None:
+            batches.append((wire, row, lens))
+    shaped = set()
+    # the seeded offender: host work scaling with plane size on the
+    # steady tick
+    for row in range(self._state.capacity):
+        if self.is_shaped(row):
+            shaped.add(row)
+    return batches, shaped

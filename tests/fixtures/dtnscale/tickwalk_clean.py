@@ -1,0 +1,13 @@
+"""dtnscale fixture: the batch-scoped form of the tick-path helper —
+shaped verdicts resolved only for this dispatch's rows. Must stay
+silent under an O(rows_touched) budget. Parsed, never imported."""
+
+
+def dispatch_inner(self, inputs):
+    batches = []
+    for wire, lens in inputs:  # rows_touched: the drained batch
+        row = self._rows.get((wire.pod_key, wire.uid))
+        if row is not None:
+            batches.append((wire, row, lens))
+    shaped = {row for _w, row, _l in batches if self.is_shaped(row)}
+    return batches, shaped

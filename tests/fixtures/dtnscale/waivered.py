@@ -1,0 +1,11 @@
+"""dtnscale fixture: a capacity walk carrying a scost waiver — the
+designated-slow-path escape hatch. Reported AND waived, with the
+reason in the artifact. Parsed, never imported."""
+
+
+# dtnlint: scost-ok(namespace-binding slow path: runs once per tenant create/delete, never on the steady tick)
+def rebuild_masks(self):
+    owners = {}
+    for (pod_key, _uid), row in self._rows.items():
+        owners[row] = pod_key.partition("/")[0]
+    return owners

@@ -1,0 +1,390 @@
+"""Columnar allocator ≡ historical list allocator.
+
+PR 12 replaced the engine's Python-list free stack with the columnar
+``topology.freelist.FreeStack`` (vectorized growth/rebuild/carve).
+The contract is BYTE-IDENTITY with the historical semantics: the same
+op sequence hands out the same rows in the same order, so row
+assignments — and therefore the per-row-keyed delivered streams —
+are unchanged. These tests pin that against a verbatim reimplementation
+of the historical list allocator (`LegacyFree`), over random
+alloc/pair-alloc/free/compact/grow/tenant-block sequences, and then
+pin delivered streams through a churned (delete → compact → re-add →
+tenant-block) plane at pipeline depths 1 and 2, unsharded and on the
+8-device CPU mesh."""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from test_pipeline_determinism import _tagged_frames
+
+from kubedtn_tpu.api.types import (Link, LinkProperties, Topology,
+                                   TopologySpec)
+from kubedtn_tpu.parallel import partition
+from kubedtn_tpu.parallel.mesh import make_mesh
+from kubedtn_tpu.runtime import WireDataPlane
+from kubedtn_tpu.tenancy import TenantRegistry
+from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+from kubedtn_tpu.topology.freelist import FreeStack
+
+
+class LegacyFree:
+    """The pre-PR-12 free-list semantics, op for op — the spec the
+    FreeStack must reproduce byte-for-byte."""
+
+    def __init__(self, cap: int) -> None:
+        self.l = list(range(cap - 1, -1, -1))
+
+    def pop(self) -> int:
+        return self.l.pop()
+
+    def push(self, row: int) -> None:
+        self.l.append(row)
+
+    def extend(self, rows) -> None:
+        self.l.extend(int(r) for r in rows)
+
+    def grow(self, old_cap: int, new_cap: int) -> None:
+        self.l = list(range(new_cap - 1, old_cap - 1, -1)) + self.l
+
+    def compact(self, n_active: int, cap: int) -> None:
+        self.l = list(range(cap - 1, n_active - 1, -1))
+
+    def remove_rows(self, rows) -> None:
+        taken = {int(r) for r in rows}
+        self.l = [r for r in self.l if r not in taken]
+
+    def pick_pair(self, capacity: int, n_shards: int,
+                  scan_limit: int = 64) -> tuple[int, int]:
+        # verbatim historical pick_pair_rows (engine.py PR 5-11 era)
+        free = self.l
+        r1 = free.pop()
+        if n_shards <= 1:
+            return r1, free.pop()
+        loc = capacity // n_shards
+        blk = r1 // loc
+        top = free[-1]
+        if top // loc == blk:
+            free.pop()
+            return r1, top
+        lo = max(0, len(free) - scan_limit)
+        for i in range(len(free) - 2, lo - 1, -1):
+            if free[i] // loc == blk:
+                return r1, free.pop(i)
+        return r1, free.pop()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_freestack_matches_legacy_op_for_op(seed):
+    """Random pop/push/extend/grow/compact/carve/pair sequences: every
+    returned row and the full remaining order stay identical."""
+    rng = random.Random(seed)
+    cap = 64
+    fs, legacy = FreeStack.from_range(0, cap), LegacyFree(cap)
+    allocated: list[int] = []
+    shards = rng.choice([1, 4, 8])
+    for _step in range(400):
+        assert fs.tolist() == legacy.l
+        op = rng.random()
+        if op < 0.35 and len(fs) >= 1:
+            a, b = fs.pop(), legacy.pop()
+            assert a == b
+            allocated.append(a)
+        elif op < 0.50 and len(fs) >= 2 and cap % shards == 0:
+            got = partition.pick_pair_rows(fs, cap, shards)
+            want = legacy.pick_pair(cap, shards)
+            assert got == want
+            allocated.extend(got)
+        elif op < 0.75 and allocated:
+            r = allocated.pop(rng.randrange(len(allocated)))
+            fs.push(r)
+            legacy.push(r)
+        elif op < 0.85 and len(fs) >= 8:
+            # carve a random subset (the tenant-block removal shape)
+            k = rng.randrange(1, min(8, len(fs)))
+            rows = rng.sample(fs.tolist(), k)
+            fs.remove_rows(np.asarray(rows, np.int64))
+            legacy.remove_rows(rows)
+            allocated.extend(rows)
+        elif op < 0.93:
+            new_cap = cap * 2
+            fs.prepend_range(cap, new_cap)
+            legacy.grow(cap, new_cap)
+            cap = new_cap
+            if cap > 1024:  # keep the walk bounded
+                n = len(allocated)
+                allocated = list(range(n))
+                cap = 1024
+                fs = FreeStack.from_range(n, cap)
+                legacy.compact(n, cap)
+        else:
+            # compact: allocated rows renumber to [0, n)
+            n = len(allocated)
+            allocated = list(range(n))
+            fs = FreeStack.from_range(n, cap)
+            legacy.compact(n, cap)
+    assert fs.tolist() == legacy.l
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_tenant_blocks_matches_list_path(seed):
+    """The vectorized FreeStack carve and the historical list-filter
+    path pick the same blocks and leave the same remainder order."""
+    rng = random.Random(seed)
+    cap, shards = 128, 4
+    pool = list(range(cap - 1, -1, -1))
+    # random fragmentation: drop a third of the rows
+    drop = set(rng.sample(range(cap), cap // 3))
+    pool = [r for r in pool if r not in drop]
+    requests = [rng.randrange(1, 24) for _ in range(5)]
+    as_list = list(pool)
+    as_stack = FreeStack(pool)
+    want = partition.tenant_blocks(as_list, cap, shards, requests)
+    got = partition.tenant_blocks(as_stack, cap, shards, requests)
+    assert got == want
+    assert as_stack.tolist() == as_list
+
+
+@pytest.mark.parametrize("seed,shard_count", [(0, 1), (1, 4), (2, 8)])
+def test_engine_rows_match_legacy_prediction(seed, shard_count):
+    """Drive a REAL engine through random pair-alloc/free/compact/grow
+    and predict every row assignment with the legacy model — the
+    engine-level half of the byte-identity contract."""
+    rng = random.Random(seed)
+    cap = 64
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=cap)
+    engine.shard_count = shard_count
+    legacy = LegacyFree(cap)
+    live: list[tuple[str, str, int]] = []
+    uid_next = 1
+    for _step in range(200):
+        assert engine._free.tolist() == legacy.l
+        op = rng.random()
+        with engine._lock:
+            if op < 0.45 and len(legacy.l) >= 2:
+                k1, k2 = f"ns/a{uid_next}", f"ns/b{uid_next}"
+                got = engine._alloc_link_pair(k1, k2, uid_next)
+                if (shard_count > 1
+                        and engine._state.capacity % shard_count == 0):
+                    want = legacy.pick_pair(engine._state.capacity,
+                                            shard_count)
+                else:
+                    want = (legacy.pop(), legacy.pop())
+                assert got == want, (got, want, _step)
+                live.append((k1, k2, uid_next))
+                uid_next += 1
+            elif op < 0.75 and live:
+                k1, k2, uid = live.pop(rng.randrange(len(live)))
+                for k in (k1, k2):
+                    row = engine._rows.pop((k, uid))
+                    engine._peer.pop((k, uid), None)
+                    engine._row_owner.pop(row, None)
+                    engine._free_row(row)
+                    legacy.push(row)
+            elif op < 0.9:
+                old_cap = engine._state.capacity
+                if old_cap >= 512:
+                    continue  # keep the walk bounded
+                engine._ensure_capacity(old_cap + 1)  # force growth
+                legacy.grow(old_cap, engine._state.capacity)
+                continue
+        if op >= 0.9:
+            engine.compact()
+            legacy.compact(engine.num_active, engine._state.capacity)
+            # prediction: sorted-key order re-binds rows 0..n-1
+            items = sorted(engine._rows.items())
+            for i, (_k, r) in enumerate(items):
+                assert r == i
+    assert engine._free.tolist() == legacy.l
+
+
+def test_tenant_block_sequences_keep_pools_and_masks_exact():
+    """Random tenant create-with-block/alloc/free/delete/compact:
+    the three pools (global free, block reserves, active rows) stay a
+    partition of capacity, the O(1) reserved counter matches reality,
+    and the incremental columnar accounting masks equal a brute-force
+    registry re-derive after every step."""
+    rng = random.Random(7)
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=256)
+    reg = TenantRegistry(engine)
+    live: list[tuple[str, int]] = []
+    uid = 1
+    tenants = []
+    for step in range(120):
+        op = rng.random()
+        if op < 0.15 and len(tenants) < 5:
+            name = f"t{len(tenants)}"
+            reg.create(name, block_edges=rng.choice([0, 8, 16]),
+                       namespaces=[name])
+            tenants.append(name)
+        elif op < 0.25 and tenants and rng.random() < 0.3:
+            name = tenants.pop(rng.randrange(len(tenants)))
+            reg.delete(name)
+        elif op < 0.7 and tenants:
+            ns = rng.choice(tenants)
+            k = f"{ns}/p{uid}"
+            with engine._lock:
+                engine._ensure_capacity(1)
+                engine._alloc(k, uid)
+            live.append((k, uid))
+            uid += 1
+        elif op < 0.9 and live:
+            k, u = live.pop(rng.randrange(len(live)))
+            with engine._lock:
+                row = engine._rows.pop((k, u))
+                engine._row_owner.pop(row, None)
+                engine._free_row(row)
+        else:
+            engine.compact()
+
+        # -- invariants -------------------------------------------
+        cap = engine._state.capacity
+        gfree = engine._free.tolist()
+        reserves = {t: list(reg.get(t).block_free) for t in tenants
+                    if reg.get(t) is not None}
+        active = list(engine._row_owner)
+        everything = gfree + sum(reserves.values(), []) + active
+        assert len(everything) == len(set(everything)) == cap, step
+        assert reg.reserved_free() == sum(
+            len(v) for v in reserves.values()), step
+        for t in tenants:
+            tn = reg.get(t)
+            if tn is None:
+                continue
+            want = sorted(
+                row for (pk, _u), row in engine._rows.items()
+                if pk.partition("/")[0] in tn.namespaces)
+            got = reg.rows_of(t).tolist()
+            assert got == want, (step, t, got, want)
+
+
+# ---- delivered streams through a churned plane ------------------------
+
+_PROPS = LinkProperties(latency="1ms", loss="7")
+
+
+def _churned_daemon(pairs: int = 3):
+    """Pods reconciled, one topology deleted, a tenant block carved,
+    the engine compacted, the topology re-added — the allocator paths
+    (pair-alloc, block carve, free fold, compact rebuild) all fire
+    before a single frame flows."""
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=4 * pairs + 8)
+    reg = TenantRegistry(engine)
+    for i in range(pairs):
+        a, b = f"a{i}", f"b{i}"
+        store.create(Topology(name=a, spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=b,
+                 uid=i + 1, properties=_PROPS)])))
+        store.create(Topology(name=b, spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=a,
+                 uid=i + 1, properties=_PROPS)])))
+        engine.setup_pod(a)
+        engine.setup_pod(b)
+    Reconciler(store, engine).drain()
+    # churn: tear one pair down, carve a block, compact, re-add
+    topo0 = store.get("default", "a0")
+    engine.del_links(topo0, topo0.spec.links)
+    reg.create("default", block_edges=4)
+    engine.compact()
+    engine.add_links(topo0, topo0.spec.links)
+    daemon = Daemon(engine)
+    win, wout = [], []
+    for i in range(pairs):
+        win.append(daemon._add_wire(pb.WireDef(
+            local_pod_name=f"a{i}", kube_ns="default", link_uid=i + 1,
+            intf_name_in_pod="eth1")))
+        wout.append(daemon._add_wire(pb.WireDef(
+            local_pod_name=f"b{i}", kube_ns="default", link_uid=i + 1,
+            intf_name_in_pod="eth1")))
+    return daemon, win, wout
+
+
+def _run_churned(depth: int, mesh_n: int | None = None,
+                 n_per_wire: int = 120):
+    daemon, win, wout = _churned_daemon()
+    plane = WireDataPlane(daemon, dt_us=2_000.0, pipeline_depth=depth)
+    plane.pipeline_explicit_clock = True
+    if mesh_n is not None:
+        plane.enable_sharding(make_mesh(mesh_n))
+    t = 100.0
+    for k, wa in enumerate(win):
+        wa.ingress.extend(_tagged_frames(k, n_per_wire))
+    for _ in range(60):
+        t += 0.002
+        plane.tick(now_s=t)
+    plane.flush()
+    plane.tick(now_s=t + 10.0)
+    assert plane.tick_errors == 0
+    return [list(w.egress) for w in wout]
+
+
+def test_churned_stream_depth2_matches_depth1():
+    assert _run_churned(2) == _run_churned(1)
+
+
+@pytest.mark.sharded_plane
+@pytest.mark.parametrize("sharded_mesh", [8], indirect=True)
+def test_churned_stream_sharded_matches_unsharded(sharded_mesh):
+    del sharded_mesh
+    base = _run_churned(1, mesh_n=None)
+    for depth in (1, 2):
+        assert _run_churned(depth, mesh_n=8) == base
+
+
+def test_checkpoint_roundtrip_keeps_freelist_and_keyids(tmp_path):
+    """The FreeStack serializes through the manifest byte-identically,
+    and a restored engine re-derives the columnar per-row key ids (a
+    restored link must keep its identity-keyed PRNG stream)."""
+    from kubedtn_tpu import checkpoint
+    from kubedtn_tpu.topology.engine import link_key_id
+
+    daemon, _win, _wout = _churned_daemon()
+    engine = daemon.engine
+    checkpoint.save(str(tmp_path / "ck"), engine.store, engine)
+    _store2, engine2 = checkpoint.load(str(tmp_path / "ck"))
+    # the manifest folds tenant-block reserve rows back into the saved
+    # free list (a tenancy-less load keeps them in the global pool)
+    want = engine._free.tolist() + sorted(
+        engine.tenancy.reserved_free_rows(), reverse=True)
+    assert engine2._free.tolist() == want
+    assert engine2._pod_names == {v: k
+                                  for k, v in engine2._pod_ids.items()}
+    for (pk, u), r in engine2._rows.items():
+        assert int(engine2._row_keyid[r]) == link_key_id(pk, u)
+
+
+def test_checkpoint_row_out_of_capacity_is_typed_corruption(tmp_path):
+    """A manifest row beyond the stated capacity hits the columnar
+    key-id write: it must surface as CheckpointCorruptError (so
+    load_or_rebuild falls back to reconstruction), never a raw
+    IndexError killing the restore."""
+    import json
+
+    import pytest as _pytest
+
+    from kubedtn_tpu import checkpoint
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    p = str(tmp_path / "ck")
+    checkpoint.save(p, store, engine)
+    mpath = tmp_path / "ck" / "manifest.json"
+    m = json.loads(mpath.read_text())
+    m["engine"]["rows"] = [["ns/x", 1, 999]]
+    mpath.write_text(json.dumps(m))
+    with _pytest.raises(checkpoint.CheckpointCorruptError):
+        checkpoint.load(p)
+    _s, _e, src = checkpoint.load_or_rebuild(p, store=store,
+                                             capacity=16)
+    assert src == "rebuild"

@@ -253,7 +253,7 @@ def real_verify():
 
 def test_real_tree_clean_and_artifact_written(real_verify):
     """Every entry point traces, all four pass families run, zero
-    unwaivered jaxpr findings — and the combined schema-v2 artifact
+    unwaivered jaxpr findings — and the combined schema-v3 artifact
     lands in ANALYSIS.json alongside the AST layer."""
     findings, report = real_verify
     active = [f for f in findings if not f.waived]
@@ -278,7 +278,7 @@ def test_real_tree_clean_and_artifact_written(real_verify):
     out = REPO / "ANALYSIS.json"
     write_json(out, ast_findings, REPO, jaxpr=section)
     doc = json.loads(out.read_text())
-    assert doc["schema_version"] == 2
+    assert doc["schema_version"] == 3
     assert doc["jaxpr"]["summary"]["unwaivered"] == 0
     assert set(doc["jaxpr"]["entry_points"]) == set(eps)
 
@@ -312,7 +312,7 @@ def test_sharded_entry_audited(real_verify):
 
 def test_cli_verify_subset(tmp_path):
     """`--verify --entries ...` runs the jaxpr layer end-to-end in a
-    fresh process and writes the schema-v2 artifact."""
+    fresh process and writes the schema-v3 artifact."""
     out = tmp_path / "a.json"
     r = subprocess.run(
         [sys.executable, "-m", "kubedtn_tpu.analysis", "-q",
@@ -321,7 +321,7 @@ def test_cli_verify_subset(tmp_path):
         capture_output=True, text=True, timeout=560)
     assert r.returncode == 0, r.stdout + r.stderr
     doc = json.loads(out.read_text())
-    assert doc["schema_version"] == 2
+    assert doc["schema_version"] == 3
     assert "twin_sweep" in doc["jaxpr"]["entry_points"]
 
 
