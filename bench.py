@@ -768,6 +768,41 @@ def main() -> None:
                 "step_seconds", "breaker_cycles", "tick_errors",
                 "in_guardrails") if k in r}
 
+    def run_plane_failover():
+        # fleet-supervision evidence: SIGKILL a loaded plane
+        # mid-migration; the supervisor detects death over real gRPC
+        # probes (hysteresis), evacuates with no operator action
+        # (journal-fork rollforward + checkpoint cold-restore), the
+        # restored rows are byte-identical to the capture, and the
+        # failover accounting is EXACT — fed == delivered_src +
+        # delivered_dst + reported_lost with the mismatch gauge 0.
+        r = _isolated_scenario("plane_failover", {"pairs": 2})
+        extras["plane_failover"] = {
+            k: r[k] for k in (
+                "pairs", "fed", "delivered", "delivered_before_kill",
+                "gap_frames", "sweeps_to_dead", "evacuation",
+                "restored_rows_byte_identical", "accounting",
+                "accounting_mismatch_gauge", "reported_lost_gauge",
+                "transitions", "in_guardrails") if k in r}
+
+    def run_fleet_rolling_upgrade():
+        # zero-loss rolling-upgrade evidence: two real gRPC daemons
+        # with live runners; cordon → drain via live migration →
+        # restart on the same port → health-verify over the wire →
+        # refill, under a retrying producer — every accepted frame
+        # delivered (frames_lost == 0), mismatch gauge 0.
+        r = _isolated_scenario("fleet_rolling_upgrade", {
+            "pairs": 1,
+            "steady_s": 1.0 if degraded else 1.5,
+            "offered_frames_per_s": 1_000 if degraded else 2_000})
+        extras["fleet_rolling_upgrade"] = {
+            k: r[k] for k in (
+                "pairs", "frames_fed", "frames_delivered",
+                "frames_lost", "migrations", "reports",
+                "pending_restored", "accounting_mismatch_gauge",
+                "migrations_completed", "in_guardrails")
+            if k in r}
+
     def run_telemetry_overhead():
         # observability cost evidence: the SAME plane-only workload
         # with the link-telemetry window ring + flight recorder off vs
@@ -961,6 +996,8 @@ def main() -> None:
     phase("tenant_soak", run_tenant_soak)
     phase("noisy_neighbor", run_noisy_neighbor)
     phase("migration_under_flap", run_migration_under_flap)
+    phase("plane_failover", run_plane_failover)
+    phase("fleet_rolling_upgrade", run_fleet_rolling_upgrade)
     phase("telemetry_overhead", run_telemetry_overhead)
     phase("whatif_sweep", run_whatif_sweep)
     phase("reconverge_10k", run_reconverge_10k)

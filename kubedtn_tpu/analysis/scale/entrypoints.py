@@ -67,9 +67,13 @@ CAPACITY_CONTAINERS = {
 # `c.pop(i)` is a linear scan per call (set/dict membership is O(1)
 # and exempt)
 CAPACITY_LISTS = {"_free"}
-# tenant-registry-sized containers: one pass = O(tenants)
+# registry-sized containers: one pass = O(tenants). The fleet layer's
+# plane registries (_watch / _handles) and the placement ledger's
+# tenant map classify here too — a fleet sweep is one pass over the
+# registered planes, a ledger commit one pass over the placements.
 TENANT_CONTAINERS = {"_tenants", "_ns_map", "ns_map", "_holds",
-                     "_masks", "tenants"}
+                     "_masks", "tenants", "_watch", "_handles",
+                     "_placements", "placements", "_cordoned"}
 
 # ---- entries ----------------------------------------------------------
 # name -> (budget class, ((path, qualname), ...) call-graph roots).
@@ -85,6 +89,8 @@ _PAR = "kubedtn_tpu/parallel/partition.py"
 _STG = "kubedtn_tpu/updates/stager.py"
 _CKP = "kubedtn_tpu/checkpoint.py"
 _MIG = "kubedtn_tpu/federation/migrate.py"
+_SUP = "kubedtn_tpu/federation/supervisor.py"
+_PLC = "kubedtn_tpu/federation/placement.py"
 _TEL = "kubedtn_tpu/telemetry.py"
 
 SCALE_ENTRIES: dict[str, dict] = {
@@ -171,9 +177,11 @@ SCALE_ENTRIES: dict[str, dict] = {
     "checkpoint_save": {
         "budget": CLASS_CAPACITY,
         "roots": (
-            (_CKP, "_save_traced"),
+            (_CKP, "_capture"),
+            (_CKP, "_write_captured"),
             (_CKP, "store_records"),
             (_CKP, "save_pending"),
+            (_CKP, "save_live"),
         ),
     },
     "checkpoint_load": {
@@ -184,6 +192,11 @@ SCALE_ENTRIES: dict[str, dict] = {
             (_CKP, "load_pending"),
             (_CKP, "load_tenancy"),
             (_CKP, "rebuild_engine"),
+            (_CKP, "read_pending_entries"),
+            (_CKP, "read_ingress_entries"),
+            (_CKP, "load_ingress"),
+            (_CKP, "load_wires"),
+            (_CKP, "restore_plane_counters"),
         ),
     },
     # per-tenant slicing: one vectorized mask read per query, with the
@@ -214,6 +227,57 @@ SCALE_ENTRIES: dict[str, dict] = {
             (_MIG, "MigrationCoordinator._wire_pairs"),
             (_MIG, "MigrationCoordinator._transfer"),
             (_SRV, "WireManager.in_namespaces"),
+        ),
+    },
+    # fleet supervision: one probe + state-machine step per registered
+    # plane per sweep — a registry-sized pass, never capacity work
+    "fleet_sweep": {
+        "budget": CLASS_TENANTS,
+        "roots": (
+            (_SUP, "FleetSupervisor.sweep"),
+            (_SUP, "FleetSupervisor.probe"),
+            (_SUP, "FleetSupervisor._observe"),
+            (_SUP, "FleetSupervisor.status"),
+            (_SUP, "FleetSupervisor._live_candidates"),
+            (_SRV, "Daemon.health_snapshot"),
+        ),
+    },
+    # placement ledger: O(1) in-memory ops plus ONE registry-sized
+    # record serialization per committed mutation
+    "placement_ledger": {
+        "budget": CLASS_TENANTS,
+        "roots": (
+            (_PLC, "PlacementLedger.assign"),
+            (_PLC, "PlacementLedger.remove"),
+            (_PLC, "PlacementLedger.cordon"),
+            (_PLC, "PlacementLedger.uncordon"),
+            (_PLC, "PlacementLedger._commit_locked"),
+            (_PLC, "plane_score"),
+            (_PLC, "pressure_of"),
+            (_PLC, "choose_plane"),
+        ),
+    },
+    # the restore half of an evacuation is tenant-scoped: rows_touched
+    # = the evacuated tenant's rows/wires, like the migration steps
+    "evacuation_restore": {
+        "budget": CLASS_ROWS,
+        "roots": (
+            (_MIG, "restore_tenant_slice"),
+            (_MIG, "_restore_slice_locked"),
+            (_MIG, "discard_partial_restore"),
+        ),
+    },
+    # the slicing half reads a dead plane's checkpoint — a documented
+    # cold linear pass, budgeted like checkpoint_load
+    "evacuation": {
+        "budget": CLASS_CAPACITY,
+        "roots": (
+            (_SUP, "FleetSupervisor.evacuate"),
+            (_SUP, "FleetSupervisor._resolve_migrations"),
+            (_SUP, "FleetSupervisor.resume_orphans"),
+            (_SUP, "FleetSupervisor.check_failover_accounting"),
+            (_SUP, "fork_from_checkpoint"),
+            (_SUP, "_counters_summary"),
         ),
     },
 }

@@ -114,8 +114,13 @@ class ChaosInjector:
         # journal commit — so an injected failure is exactly a daemon
         # dying mid-step)
         self._fail_steps: dict[str, int] = {}
+        # health-probe failure plan: plane name -> remaining failures
+        # (the fleet supervisor's suspicion machine consumes these as
+        # hard probe failures)
+        self._fail_probes: dict[str, int] = {}
         self.injected = {"peer_blackhole": 0, "peer_latency": 0,
-                         "dispatch": 0, "checkpoint": 0, "migration": 0}
+                         "dispatch": 0, "checkpoint": 0, "migration": 0,
+                         "probe": 0, "plane_kill": 0}
 
     # -- peer faults ---------------------------------------------------
 
@@ -231,6 +236,45 @@ class ChaosInjector:
             self.injected["migration"] += 1
         raise ChaosError(f"chaos: forced migration failure at "
                          f"step {step!r}")
+
+    # -- fleet faults --------------------------------------------------
+
+    def fail_probes(self, plane: str, times: int = 1) -> None:
+        """Arm `times` hard failures of the fleet supervisor's health
+        probe of `plane` — the suspicion state machine's hammer (a
+        transiently unreachable daemon that comes back)."""
+        with self._lock:
+            self._fail_probes[plane] = \
+                self._fail_probes.get(plane, 0) + int(times)
+
+    def on_probe(self, plane: str) -> None:
+        """Hook the fleet supervisor calls before every health probe."""
+        with self._lock:
+            left = self._fail_probes.get(plane, 0)
+            if left <= 0:
+                return
+            self._fail_probes[plane] = left - 1
+            self.injected["probe"] += 1
+        raise ChaosError(f"chaos: forced probe failure of {plane!r}")
+
+    def kill_plane(self, handle, server=None) -> None:
+        """`kill -9` stand-in for an IN-PROCESS plane: the runner
+        thread is abandoned mid-flight (its stop flag is set with NO
+        flush and NO checkpoint — whatever lived in queues, delay
+        lines and un-checkpointed counters is gone exactly as a
+        SIGKILL leaves it), the gRPC server (when given) stops taking
+        connections, and every subsequent in-process health probe
+        raises (`daemon.chaos_dead`). The plane object is
+        unrecoverable from here on, like the process it stands for."""
+        plane = handle.plane
+        plane._stop.set()
+        wake = getattr(plane, "_wake", None)
+        if wake is not None:
+            wake.set()  # a sleeping runner sees the stop immediately
+        handle.daemon.chaos_dead = True
+        if server is not None:
+            server.stop(None)
+        self.injected["plane_kill"] += 1
 
     # -- checkpoint faults --------------------------------------------
 

@@ -255,16 +255,11 @@ def _load_npz(dirpath: str, manifest: dict, fname: str):
 
 # -- checkpoint save/load ---------------------------------------------
 
-def _arrays_to_npz(path: str, obj) -> None:
-    # np.asarray GATHERS to host first: a sharded live plane's
-    # edge-state columns (NamedSharding over the edge mesh) serialize
-    # as plain host arrays, so a checkpoint written under an N-way mesh
-    # restores on any device count — and vice versa
-    # (tests/test_sharded_plane.py round-trips 8-way ↔ 1-way bit-exact)
-    fields = {f.name: np.asarray(getattr(obj, f.name))
-              for f in dataclasses.fields(obj)}
-    np.savez_compressed(path, **fields)
-
+# Note on sharded planes: the `_capture` gathers every column with
+# np.asarray, which pulls a NamedSharding-distributed array to host —
+# a checkpoint written under an N-way mesh restores on any device
+# count, and vice versa (tests/test_sharded_plane.py round-trips
+# 8-way ↔ 1-way bit-exact).
 
 # SimState npz codec — the ONE flatten/unflatten for the
 # "<field>.<leaf>" layout, shared by the checkpoint's sim_state.npz
@@ -316,26 +311,144 @@ def save(path: str, store: TopologyStore, engine: SimEngine,
     `path` or `<path>.prev`); a reused directory can never leak stale
     `pending_frames.npz`/`sim_state.npz` from an earlier save because
     the directory is replaced wholesale. With `dataplane`, in-flight
-    delay-line frames are persisted too (save_pending) so a restarted
-    daemon completes their remaining delays."""
+    delay-line frames, wire definitions and the plane's cumulative
+    per-edge counters are persisted too, so a restarted (or evacuated
+    — federation.supervisor) daemon completes the frames' remaining
+    delays and keeps its delivery accounting. For a checkpoint of a
+    plane whose runner is STILL TICKING, use `save_live` (this entry
+    refuses, because an unsynchronized capture could double-deliver or
+    lose frames)."""
     if dataplane is not None and getattr(dataplane, "running", False):
         # a live runner can release exported frames (duplicate on
         # restore) or shape new ones after the export (lost): the
         # checkpoint must be a consistent point-in-time cut
         raise RuntimeError(
             "stop() the data plane before checkpointing its pending "
-            "frames")
+            "frames, or use save_live() for a barrier-consistent "
+            "autosave")
     from kubedtn_tpu.utils import tracing
 
     with tracing.span("checkpoint-save", path=path):
-        return _save_traced(path, store, engine, sim, dataplane)
+        cap = _capture(store, engine, sim, dataplane)
+        return _write_captured(path, cap)
 
 
-def _save_traced(path: str, store: TopologyStore, engine: SimEngine,
-                 sim=None, dataplane=None) -> None:
+def save_live(path: str, store: TopologyStore, engine: SimEngine,
+              dataplane) -> None:
+    """Crash-consistent checkpoint of a RUNNING plane — the periodic
+    autosave entry (`kdt daemon --checkpoint-interval`). The capture
+    happens at one `stage_update_round` flush barrier (every in-flight
+    dispatch's write-back lands first, the runner pauses one barrier —
+    the twin-snapshot consistency contract), then the staging, fsync
+    and atomic swap run OFF the tick path so disk I/O never blocks a
+    tick. This is what bounds the fleet's failover RPO: before it, a
+    SIGKILL lost everything since start, because state was saved only
+    on graceful SIGTERM."""
+    from kubedtn_tpu.utils import tracing
+
+    with tracing.span("checkpoint-save-live", path=path):
+        cap = dataplane.stage_update_round(
+            lambda: _capture(store, engine, None, dataplane))
+        return _write_captured(path, cap)
+
+
+def _capture(store: TopologyStore, engine: SimEngine,
+             sim=None, dataplane=None) -> dict:
+    """Consistent point-in-time cut of everything a checkpoint
+    persists, as host arrays + JSON-ready manifest sections — no disk
+    I/O. Runs either with the plane stopped (`save`) or inside a tick-
+    lock flush barrier (`save_live`); the engine lock is held across
+    the state gather and the registry snapshot so the two can never
+    show different generations."""
+    cap: dict = {"sim": None, "pending": None, "counters": None,
+                 "ingress": None}
+    if dataplane is not None:
+        cap["pending"] = dataplane.export_pending()
+        cap["counters"] = {
+            f.name: np.asarray(getattr(dataplane.counters, f.name))
+            for f in dataclasses.fields(type(dataplane.counters))}
+        # queued-but-undrained INGRESS frames: accepted from producers
+        # but not yet shaped — without these a restart (or failover)
+        # silently loses every frame the plane accepted since its last
+        # drain. Ticks are blocked at the capture barrier, so the
+        # snapshot is exactly the undrained set; a producer appending
+        # DURING a live capture may land after the cut (reported as
+        # loss on crash, normal delivery otherwise).
+        from kubedtn_tpu.wire.server import flatten_frames
+
+        ingress = []
+        for w in dataplane.daemon.wires.all():
+            q = w.ingress
+            entries = (q.snapshot_entries()
+                       if hasattr(q, "snapshot_entries") else list(q))
+            for frame in flatten_frames(entries):
+                ingress.append((w.pod_key, int(w.uid), frame))
+        cap["ingress"] = ingress
+    with engine._lock:
+        engine._flush_device_locked()
+        st = engine._state
+        cap["edge"] = {f.name: np.asarray(getattr(st, f.name))
+                       for f in dataclasses.fields(type(st))}
+        manifest = {
+            "format_version": FORMAT_VERSION,
+            "node_ip": engine.node_ip,
+            "capacity": st.capacity,
+            "engine": {
+                "pod_ids": dict(engine._pod_ids),
+                "rows": [[k[0], k[1], v]
+                         for k, v in engine._rows.items()],
+                "peer": [[k[0], k[1], v[0], v[1]]
+                         for k, v in engine._peer.items()],
+                "free": engine._free.tolist(),
+                "alive": sorted(engine._topology_manager),
+            },
+            "has_sim": sim is not None,
+        }
+    manifest["store"] = store_records(store)
+    if sim is not None:
+        cap["sim"] = flatten_sim_arrays(sim)
+    if dataplane is not None:
+        # wire definitions: the attachment registry is daemon state the
+        # store cannot re-derive — without it an evacuation (or a
+        # restart) would wait for every client to re-register before a
+        # single frame could flow
+        manifest["wires"] = [
+            [w.pod_key, int(w.uid), w.peer_ip, int(w.peer_intf_id),
+             w.node_iface_name]
+            for w in dataplane.daemon.wires.all()]
+        ls = dataplane._last_shaped_s
+        manifest["plane"] = {
+            "last_shaped_s": None if ls is None else float(ls),
+            "has_counters": True,
+        }
+    tenancy = getattr(engine, "tenancy", None)
+    if tenancy is not None:
+        # quotas / QoS / block entitlements / namespace bindings
+        # survive the restart (load_tenancy) — without this section
+        # a restart silently reset every tenant to unenforced,
+        # which the federation RELEASE/rollback paths must never
+        # rely on
+        manifest["tenancy"] = tenancy.export_config()
+        # reservations are registry state re-carved at restore: the
+        # persisted free list must include the blocks' unused rows,
+        # or each restart would leak them (gone from the global
+        # pool AND from the new blocks). A tenancy-less load keeps
+        # them in the global pool — also correct.
+        manifest["engine"]["free"] = (
+            manifest["engine"]["free"]
+            + sorted(tenancy.reserved_free_rows(), reverse=True))
+    cap["manifest"] = manifest
+    return cap
+
+
+def _write_captured(path: str, cap: dict) -> None:
+    """Stage a captured checkpoint beside `path` and swap it into place
+    atomically (the write half of `save`/`save_live` — pure disk work,
+    never touches live state)."""
     path = os.path.abspath(path)
     _CKPT_FILES = {"manifest.json", "edge_state.npz", "sim_state.npz",
-                   "pending_frames.npz"}
+                   "pending_frames.npz", "plane_counters.npz",
+                   "wire_ingress.npz"}
     if (os.path.isdir(path) and os.listdir(path)
             and not os.path.exists(os.path.join(path, "manifest.json"))
             and not set(os.listdir(path)) <= _CKPT_FILES):
@@ -367,48 +480,26 @@ def _save_traced(path: str, store: TopologyStore, engine: SimEngine,
                        f"{_TMP_PREFIX}{os.path.basename(path)}-{os.getpid()}")
     os.makedirs(tmp)
     try:
-        if dataplane is not None:
-            save_pending(tmp, dataplane)
-        _arrays_to_npz(os.path.join(tmp, "edge_state.npz"), engine.state)
-        if sim is not None:
+        if cap["pending"] is not None:
+            _pending_to_npz(os.path.join(tmp, "pending_frames.npz"),
+                            cap["pending"])
+        if cap["ingress"]:
+            _frames_to_npz(os.path.join(tmp, "wire_ingress.npz"),
+                           cap["ingress"])
+        if cap["counters"] is not None:
+            np.savez_compressed(os.path.join(tmp, "plane_counters.npz"),
+                                **cap["counters"])
+        np.savez_compressed(os.path.join(tmp, "edge_state.npz"),
+                            **cap["edge"])
+        if cap["sim"] is not None:
             np.savez_compressed(os.path.join(tmp, "sim_state.npz"),
-                                **flatten_sim_arrays(sim))
+                                **cap["sim"])
         checksums = {
             fname: _sha256_file(os.path.join(tmp, fname))
             for fname in sorted(os.listdir(tmp))
         }
-        manifest = {
-            "format_version": FORMAT_VERSION,
-            "node_ip": engine.node_ip,
-            "capacity": engine.state.capacity,
-            "store": store_records(store),
-            "engine": {
-                "pod_ids": engine._pod_ids,
-                "rows": [[k[0], k[1], v] for k, v in engine._rows.items()],
-                "peer": [[k[0], k[1], v[0], v[1]]
-                         for k, v in engine._peer.items()],
-                "free": engine._free.tolist(),
-                "alive": sorted(engine._topology_manager),
-            },
-            "has_sim": sim is not None,
-            "checksums": checksums,
-        }
-        tenancy = getattr(engine, "tenancy", None)
-        if tenancy is not None:
-            # quotas / QoS / block entitlements / namespace bindings
-            # survive the restart (load_tenancy) — without this section
-            # a restart silently reset every tenant to unenforced,
-            # which the federation RELEASE/rollback paths must never
-            # rely on
-            manifest["tenancy"] = tenancy.export_config()
-            # reservations are registry state re-carved at restore: the
-            # persisted free list must include the blocks' unused rows,
-            # or each restart would leak them (gone from the global
-            # pool AND from the new blocks). A tenancy-less load keeps
-            # them in the global pool — also correct.
-            manifest["engine"]["free"] = (
-                engine._free.tolist()
-                + sorted(tenancy.reserved_free_rows(), reverse=True))
+        manifest = dict(cap["manifest"])
+        manifest["checksums"] = checksums
         mpath = os.path.join(tmp, "manifest.json")
         with open(mpath, "w") as f:
             json.dump(manifest, f)
@@ -514,7 +605,7 @@ def load_or_rebuild(path: str, store: TopologyStore | None = None,
     {"checkpoint", "rebuild"}; re-raises only when no fallback store was
     provided. `mesh` re-shards the restored edge state onto the CURRENT
     device mesh (checkpoints are device-count-agnostic host arrays —
-    `_arrays_to_npz` gathered them at save time)."""
+    the save-side capture gathered them)."""
     try:
         s, e, src = *load(path), "checkpoint"
     except CheckpointError as err:
@@ -541,13 +632,9 @@ def load_or_rebuild(path: str, store: TopologyStore | None = None,
     return s, e, src
 
 
-def save_pending(path: str, dataplane) -> int:
-    """Persist the data plane's in-flight frames (pickle-free npz) —
-    the delay-line analogue of kernel qdisc queues surviving a daemon
-    restart in the reference. Returns the frame count. (Called by
-    `save` against its staging directory; standalone callers lose the
-    atomic-swap guarantee.)"""
-    entries = dataplane.export_pending()
+def _pending_to_npz(fpath: str, entries) -> None:
+    """Serialize exported (pod_key, uid, frame, remaining_us) entries
+    as the pickle-free pending_frames.npz layout."""
     blob = b"".join(frame for _, _, frame, _ in entries)
     offs, lens, pos = [], [], 0
     for _, _, frame, _ in entries:
@@ -555,7 +642,7 @@ def save_pending(path: str, dataplane) -> int:
         lens.append(len(frame))
         pos += len(frame)
     np.savez_compressed(
-        os.path.join(path, "pending_frames.npz"),
+        fpath,
         pod_keys=np.frombuffer(
             "\n".join(pk for pk, _, _, _ in entries).encode(), np.uint8),
         uids=np.array([u for _, u, _, _ in entries], np.int64),
@@ -564,27 +651,102 @@ def save_pending(path: str, dataplane) -> int:
         lengths=np.array(lens, np.int64),
         blob=np.frombuffer(blob, np.uint8),
     )
-    return len(entries)
 
 
-def load_pending(path: str, dataplane, now_s: float | None = None) -> int:
-    """Re-schedule checkpointed in-flight frames with their remaining
-    delays (checksum-verified, same-generation as `load`'s fallback
-    resolution). Returns the restored count — 0 when the checkpoint
-    carried no pending file OR no checkpoint exists at all (a fresh
-    daemon's first start); corruption and unsupported formats raise."""
+def _frames_to_npz(fpath: str, entries) -> None:
+    """Serialize (pod_key, uid, frame) tuples as the pickle-free
+    wire_ingress.npz layout (the pending layout minus delays)."""
+    blob = b"".join(frame for _, _, frame in entries)
+    offs, lens, pos = [], [], 0
+    for _, _, frame in entries:
+        offs.append(pos)
+        lens.append(len(frame))
+        pos += len(frame)
+    np.savez_compressed(
+        fpath,
+        pod_keys=np.frombuffer(
+            "\n".join(pk for pk, _, _ in entries).encode(), np.uint8),
+        uids=np.array([u for _, u, _ in entries], np.int64),
+        offsets=np.array(offs, np.int64),
+        lengths=np.array(lens, np.int64),
+        blob=np.frombuffer(blob, np.uint8),
+    )
+
+
+def read_ingress_entries(path: str) -> list:
+    """The checkpointed queued-ingress frames as (pod_key, uid, frame)
+    tuples, FIFO per wire — checksum-verified, same-generation
+    resolution as `load`. [] when absent; corruption raises."""
     try:
         dirpath, manifest = _resolve_dir(os.path.abspath(path))
     except CheckpointMissingError:
-        return 0  # no checkpoint at all: nothing pending
+        return []
+    if not os.path.exists(os.path.join(dirpath, "wire_ingress.npz")):
+        return []
+    with _load_npz(dirpath, manifest, "wire_ingress.npz") as z:
+        try:
+            keys = bytes(z["pod_keys"]).decode().split("\n") if len(
+                z["pod_keys"]) else []
+            blob = bytes(z["blob"])
+            return [
+                (keys[i], int(z["uids"][i]),
+                 blob[int(z["offsets"][i]):int(z["offsets"][i])
+                      + int(z["lengths"][i])])
+                for i in range(len(z["uids"]))
+            ]
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"damaged wire_ingress.npz in {dirpath}: {e}") from e
+
+
+def load_ingress(path: str, daemon) -> int:
+    """Re-queue the checkpointed ingress frames onto their wires (the
+    wires must already exist — `load_wires` first). The extend fires
+    the wire's notify, so restored frames mark hot and drain on the
+    first tick. Returns frames restored."""
+    entries = read_ingress_entries(path)
+    n = 0
+    by_wire: dict[tuple, list] = {}
+    for pk, uid, frame in entries:
+        by_wire.setdefault((pk, uid), []).append(frame)
+    for (pk, uid), frames in by_wire.items():
+        wire = daemon.wires.get_by_key(pk, uid)
+        if wire is None:
+            continue  # wire vanished from the topology: nothing owed
+        wire.ingress.extend(frames)
+        n += len(frames)
+    return n
+
+
+def save_pending(path: str, dataplane) -> int:
+    """Persist the data plane's in-flight frames (pickle-free npz) —
+    the delay-line analogue of kernel qdisc queues surviving a daemon
+    restart in the reference. Returns the frame count. (Standalone
+    callers lose the atomic-swap guarantee `save` provides.)"""
+    entries = dataplane.export_pending()
+    _pending_to_npz(os.path.join(path, "pending_frames.npz"), entries)
+    return len(entries)
+
+
+def read_pending_entries(path: str) -> list:
+    """The checkpointed in-flight entries as (pod_key, uid, frame,
+    remaining_us) tuples WITHOUT a plane to restore them into —
+    checksum-verified, same-generation resolution as `load`. The
+    federation supervisor slices these per tenant when evacuating a
+    dead plane onto survivors. [] when no checkpoint / no pending
+    file; corruption raises."""
+    try:
+        dirpath, manifest = _resolve_dir(os.path.abspath(path))
+    except CheckpointMissingError:
+        return []  # no checkpoint at all: nothing pending
     if not os.path.exists(os.path.join(dirpath, "pending_frames.npz")):
-        return 0
+        return []
     with _load_npz(dirpath, manifest, "pending_frames.npz") as z:
         try:
             keys = bytes(z["pod_keys"]).decode().split("\n") if len(
                 z["pod_keys"]) else []
             blob = bytes(z["blob"])
-            entries = [
+            return [
                 (keys[i], int(z["uids"][i]),
                  blob[int(z["offsets"][i]):int(z["offsets"][i])
                       + int(z["lengths"][i])],
@@ -594,20 +756,33 @@ def load_pending(path: str, dataplane, now_s: float | None = None) -> int:
         except Exception as e:
             raise CheckpointCorruptError(
                 f"damaged pending_frames.npz in {dirpath}: {e}") from e
+
+
+def load_pending(path: str, dataplane, now_s: float | None = None) -> int:
+    """Re-schedule checkpointed in-flight frames with their remaining
+    delays (checksum-verified, same-generation as `load`'s fallback
+    resolution). Returns the restored count — 0 when the checkpoint
+    carried no pending file OR no checkpoint exists at all (a fresh
+    daemon's first start); corruption and unsupported formats raise."""
+    entries = read_pending_entries(path)
+    if not entries:
+        return 0
     return dataplane.restore_pending(entries, now_s=now_s)
 
 
 def consume_pending(path: str) -> None:
-    """Remove the restored generation's pending_frames.npz (from the
-    SAME directory `load_pending` resolved) so a crash before the next
-    graceful checkpoint cannot re-deliver the same frames twice."""
+    """Remove the restored generation's pending_frames.npz AND
+    wire_ingress.npz (from the SAME directory the loaders resolved) so
+    a crash before the next graceful checkpoint cannot re-deliver the
+    same frames twice."""
     try:
         dirpath, _manifest = _resolve_dir(os.path.abspath(path))
     except CheckpointError:
         return  # nothing restorable: nothing to consume
-    p = os.path.join(dirpath, "pending_frames.npz")
-    if os.path.exists(p):
-        os.remove(p)
+    for fname in ("pending_frames.npz", "wire_ingress.npz"):
+        p = os.path.join(dirpath, fname)
+        if os.path.exists(p):
+            os.remove(p)
 
 
 def load_tenancy(path: str, engine: SimEngine):
@@ -644,6 +819,150 @@ def load_tenancy(path: str, engine: SimEngine):
         raise CheckpointCorruptError(
             f"malformed tenancy section in {path}: {e}") from e
     return registry
+
+
+def load_wires(path: str, daemon) -> int:
+    """Re-register the checkpointed wire definitions on a daemon (the
+    attachment registry is daemon state the store cannot re-derive).
+    Idempotent per (pod, uid) — `get_or_create` keeps whatever a
+    faster client already registered. Returns wires (re)registered; 0
+    when no checkpoint or no wires section exists."""
+    try:
+        _dirpath, manifest = _resolve_dir(os.path.abspath(path))
+    except CheckpointMissingError:
+        return 0
+    from kubedtn_tpu.wire.server import Wire
+
+    n = 0
+    for pod_key, uid, peer_ip, peer_intf_id, ifname in \
+            manifest.get("wires", ()):
+        def build(wire_id: int, _pk=pod_key, _uid=uid, _peer=peer_ip,
+                  _pid=peer_intf_id, _if=ifname):
+            return Wire(wire_id=wire_id, uid=int(_uid), pod_key=_pk,
+                        node_iface_name=_if, peer_intf_id=int(_pid),
+                        peer_ip=_peer)
+
+        daemon.wires.get_or_create(pod_key, int(uid), build)
+        n += 1
+    return n
+
+
+def load_plane_counters(path: str):
+    """The checkpointed plane counter columns as host arrays (field
+    name → np.ndarray[E]), checksum-verified — None when the
+    checkpoint (or its counters file) doesn't exist. The federation
+    supervisor slices these for failover accounting: delivery counted
+    before the last checkpoint is the durable `delivered_src` half of
+    `fed == delivered_src + delivered_dst + reported_lost`."""
+    try:
+        dirpath, manifest = _resolve_dir(os.path.abspath(path))
+    except CheckpointMissingError:
+        return None
+    if not os.path.exists(os.path.join(dirpath, "plane_counters.npz")):
+        return None
+    with _load_npz(dirpath, manifest, "plane_counters.npz") as z:
+        try:
+            return {k: np.asarray(z[k]) for k in z.files}
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"damaged plane_counters.npz in {dirpath}: {e}") from e
+
+
+def restore_plane_counters(path: str, plane) -> bool:
+    """Install the checkpointed counter columns on a (restored) plane,
+    padded/truncated to the plane's current capacity — a restart keeps
+    its cumulative delivery accounting instead of silently zeroing
+    every kubedtn per-interface series. False when nothing to
+    restore."""
+    arrays = load_plane_counters(path)
+    if arrays is None:
+        return False
+    cap = int(plane.engine.state.capacity)
+
+    def fit(a: np.ndarray):
+        out = np.zeros((cap,) + a.shape[1:], a.dtype)
+        n = min(cap, a.shape[0])
+        out[:n] = a[:n]
+        return jnp.asarray(out)
+
+    cnt = plane.counters
+    plane.counters = type(cnt)(**{
+        f.name: fit(arrays[f.name]) if f.name in arrays
+        else getattr(cnt, f.name)
+        for f in dataclasses.fields(type(cnt))})
+    return True
+
+
+def plane_meta(path: str) -> dict:
+    """The checkpoint's `plane` manifest section ({} when absent):
+    `last_shaped_s` anchors the clock rebase when a tenant slice is
+    cold-restored onto a survivor plane (federation.supervisor)."""
+    try:
+        _dirpath, manifest = _resolve_dir(os.path.abspath(path))
+    except CheckpointMissingError:
+        return {}
+    return dict(manifest.get("plane") or {})
+
+
+class Autosaver:
+    """Periodic crash-consistent autosave for a live daemon (`kdt
+    daemon --checkpoint-interval N`): every `interval_s`, `save_live`
+    captures the full checkpoint at one flush barrier and writes it
+    with the usual atomic staged swap. This bounds the fleet's
+    failover RPO — before it, state was saved only on graceful
+    SIGTERM, so a SIGKILL lost everything since start. A failing save
+    (full disk) is logged and counted, never fatal; the previous
+    complete generation stays restorable throughout."""
+
+    def __init__(self, path: str, store: TopologyStore,
+                 engine: SimEngine, dataplane,
+                 interval_s: float = 30.0) -> None:
+        import threading
+
+        self.path = path
+        self.store = store
+        self.engine = engine
+        self.dataplane = dataplane
+        self.interval_s = float(interval_s)
+        self.saves = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    def save_now(self) -> None:
+        """One immediate barrier-consistent save (also the loop body)."""
+        save_live(self.path, self.store, self.engine, self.dataplane)
+        self.saves += 1
+
+    def start(self) -> None:
+        import threading
+
+        if self._thread is not None:
+            return
+        from kubedtn_tpu.utils.logging import fields, get_logger
+
+        log = get_logger("checkpoint")
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.save_now()
+                except Exception:
+                    self.errors += 1
+                    log.exception("autosave failed (continuing) %s",
+                                  fields(path=self.path,
+                                         errors=self.errors))
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="kdt-autosave")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(5.0, self.interval_s))
+        self._thread = None
 
 
 def load_sim(path: str, engine: SimEngine):

@@ -555,6 +555,81 @@ def cmd_migrate(args) -> int:
         client.close()
 
 
+def cmd_fleet(args) -> int:
+    """`kdt fleet status|upgrade` — the fleet supervisor's operator
+    surface (Local.FleetStatus / Local.FleetUpgrade): per-plane health
+    + suspicion state + the placement ledger, and the rolling-upgrade
+    driver (cordon → drain via live migration → restart →
+    health-verify → refill, zero frame loss for every live-migrated
+    tenant)."""
+    import grpc
+
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+
+    client = DaemonClient(args.daemon)
+    try:
+        if args.action == "status":
+            resp = client.FleetStatus(pb.FleetStatusRequest(),
+                                      timeout=args.timeout)
+            if not resp.ok:
+                print(f"fleet status: {resp.error}", file=sys.stderr)
+                return 1
+            none_if = lambda v: None if v < 0 else v  # noqa: E731
+            out = {
+                "planes": [{
+                    "name": p.name, "state": p.state,
+                    "consecutive_failures": p.consecutive_failures,
+                    "last_error": p.last_error or None,
+                    "tenants_placed": p.tenants_placed,
+                    "health": {
+                        "running": p.health.running,
+                        "serving": p.health.serving,
+                        "heartbeat_age_s": none_if(
+                            p.health.heartbeat_age_s),
+                        "degrade_level": p.health.degrade_level,
+                        "tick_errors": p.health.tick_errors,
+                        "backlog": p.health.backlog,
+                        "tenants": p.health.tenants,
+                        "headroom_rows": p.health.headroom_rows,
+                    } if p.health.ok else None,
+                } for p in resp.planes],
+                "placements": {e.tenant: e.plane
+                               for e in resp.placements},
+                "sweeps": resp.sweeps,
+                "evacuations": resp.evacuations,
+            }
+            print(json.dumps(_json_safe(out)))
+            return 0
+        # upgrade
+        resp = client.FleetUpgrade(pb.FleetUpgradeRequest(
+            planes=args.plane or [],
+            verify_probes=args.verify_probes),
+            timeout=args.timeout)
+        if not resp.ok and not resp.reports:
+            print(f"fleet upgrade: {resp.error}", file=sys.stderr)
+            return 1
+        out = {
+            "reports": [{
+                "plane": r.plane,
+                "drained_tenants": list(r.drained_tenants),
+                "refilled_tenants": list(r.refilled_tenants),
+                "restarted": bool(r.restarted),
+                "healthy": bool(r.healthy),
+                "error": r.error or None,
+            } for r in resp.reports],
+            "migrations": resp.migrations,
+        }
+        print(json.dumps(_json_safe(out)))
+        return 0 if resp.ok else 1
+    except grpc.RpcError as e:
+        print(f"fleet: daemon {args.daemon} RPC failed: "
+              f"{_rpc_code(e)}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+
+
 def cmd_scenario(args) -> int:
     from kubedtn_tpu.scenarios import LADDER
 
@@ -698,8 +773,12 @@ def cmd_daemon(args) -> int:
                                         PlaneHandle)
     from kubedtn_tpu.federation import stats_for as migration_stats_for
 
+    # SIBLING of the checkpoint dir, never inside it: checkpoint.save
+    # replaces the directory wholesale (atomic swap), so a journal
+    # nested in it would be deleted by every save — or make the save
+    # refuse outright on a manifest-less mixed directory
     journal_root = (getattr(args, "migration_journal", None)
-                    or (os.path.join(ckpt_dir, "migrations")
+                    or (ckpt_dir.rstrip("/") + "-migrations"
                         if ckpt_dir else
                         os.path.join(os.path.expanduser("~"), ".cache",
                                      "kubedtn-migrations")))
@@ -707,7 +786,20 @@ def cmd_daemon(args) -> int:
     federation = FederationController(journal_root,
                                       stats=migration_stats)
     federation.register(PlaneHandle(name=args.node_ip, daemon=daemon,
-                                    plane=dataplane, registry=tenancy))
+                                    plane=dataplane, registry=tenancy,
+                                    checkpoint_dir=ckpt_dir))
+    # fleet supervision: plane health watching (Local.Health /
+    # FleetStatus), the journaled placement ledger, and — on boot —
+    # auto-resume of any migration journal a crash left `running`
+    # (an interrupted migration no longer waits for an operator
+    # `kdt migrate --resume`)
+    from kubedtn_tpu.federation.supervisor import FleetSupervisor
+
+    fleet_root = (ckpt_dir.rstrip("/") + "-fleet" if ckpt_dir else
+                  os.path.join(os.path.expanduser("~"), ".cache",
+                               "kubedtn-fleet"))
+    fleet = FleetSupervisor(federation, fleet_root).attach()
+    fleet.start(interval_s=2.0)
     if not getattr(args, "no_telemetry", False):
         # link telemetry plane: per-edge window ring + sampled flight
         # recorder, riding the fused tick (no extra device dispatch)
@@ -745,6 +837,20 @@ def cmd_daemon(args) -> int:
             jax_profile = None
     if ckpt_dir:
         try:
+            # the wire registry and cumulative per-edge counters come
+            # back with the rows: clients need not re-register wires,
+            # and the per-interface delivery series keep counting from
+            # where the previous incarnation stopped
+            n_wires = checkpoint.load_wires(ckpt_dir, daemon)
+            n_ingress = checkpoint.load_ingress(ckpt_dir, daemon)
+            if checkpoint.restore_plane_counters(ckpt_dir, dataplane):
+                log.info("plane counters restored %s",
+                         fields(wires=n_wires,
+                                ingress_frames=n_ingress))
+        except checkpoint.CheckpointError:
+            log.exception("wire/counter restore failed; continuing "
+                          "without %s", fields(path=ckpt_dir))
+        try:
             n_pending = checkpoint.load_pending(ckpt_dir, dataplane)
         except checkpoint.CheckpointError:
             # the file stays on disk: a transient read error (or a
@@ -770,7 +876,8 @@ def cmd_daemon(args) -> int:
                                    whatif_stats=stats_for(daemon),
                                    update_stats=update_stats_for(daemon),
                                    tenancy=tenancy,
-                                   migration_stats=migration_stats)
+                                   migration_stats=migration_stats,
+                                   fleet=fleet)
     engine.stats.observer = hist
     daemon.hist = hist
     server, port = make_server(daemon, port=args.port)
@@ -778,6 +885,20 @@ def cmd_daemon(args) -> int:
     metrics.start()
     server.start()
     dataplane.start()
+    autosaver = None
+    interval = getattr(args, "checkpoint_interval", 0.0) or 0.0
+    if ckpt_dir and interval > 0:
+        # periodic crash-consistent autosave: capture at one flush
+        # barrier off the tick path, write with the atomic staged swap.
+        # This bounds the failover RPO — without it a SIGKILL loses
+        # everything since start (state otherwise saves only on the
+        # graceful SIGTERM path below).
+        autosaver = checkpoint.Autosaver(ckpt_dir, store, engine,
+                                         dataplane,
+                                         interval_s=interval)
+        autosaver.start()
+        log.info("autosave on %s", fields(path=ckpt_dir,
+                                          interval_s=interval))
     import signal as _signal
 
     def _on_term(*_):
@@ -799,6 +920,10 @@ def cmd_daemon(args) -> int:
               f"metrics on :{metrics.port}/metrics", flush=True)
         server.wait_for_termination()
     except KeyboardInterrupt:
+        fleet.stop()
+        if autosaver is not None:
+            # a mid-shutdown autosave would race the final save below
+            autosaver.stop()
         server.stop(0)
         dataplane.stop()
         if ckpt_dir:
@@ -1394,6 +1519,12 @@ def main(argv=None) -> int:
                     help="restore state from DIR on boot (if present) and "
                          "checkpoint to it on shutdown, incl. in-flight "
                          "delay-line frames")
+    dp.add_argument("--checkpoint-interval", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="ALSO autosave a crash-consistent checkpoint "
+                         "every N seconds at a flush barrier off the "
+                         "tick path (0 = only on SIGTERM) — bounds the "
+                         "fleet failover RPO")
     dp.add_argument("--no-telemetry", action="store_true",
                     help="disable the link telemetry plane (per-edge "
                          "window ring + sampled flight recorder; on by "
@@ -1418,7 +1549,9 @@ def main(argv=None) -> int:
                          "daemon's lifetime (TensorBoard-loadable)")
     dp.add_argument("--migration-journal", default=None, metavar="DIR",
                     help="journal root for live tenant migrations "
-                         "(default: <checkpoint-dir>/migrations, or "
+                         "(default: <checkpoint-dir>-migrations — a "
+                         "SIBLING, the checkpoint swap replaces its "
+                         "own dir wholesale — or "
                          "~/.cache/kubedtn-migrations)")
     dp.set_defaults(fn=cmd_daemon)
 
@@ -1446,6 +1579,24 @@ def main(argv=None) -> int:
                           "filtered by tenant / --id)")
     mgp.add_argument("--timeout", type=float, default=60.0)
     mgp.set_defaults(fn=cmd_migrate)
+
+    flp = sub.add_parser(
+        "fleet",
+        help="fleet supervision: per-plane health + placement ledger "
+             "(status), rolling upgrades with zero frame loss "
+             "(upgrade) — Local.FleetStatus / Local.FleetUpgrade")
+    flp.add_argument("action", choices=("status", "upgrade"))
+    flp.add_argument("--daemon", default="127.0.0.1:51111",
+                     metavar="HOST:PORT",
+                     help="daemon whose fleet supervisor answers")
+    flp.add_argument("--plane", action="append", default=None,
+                     help="upgrade only these planes (default: every "
+                          "healthy plane, one at a time)")
+    flp.add_argument("--verify-probes", type=int, default=0,
+                     help="consecutive clean health probes required "
+                          "before refill (0 = supervisor default)")
+    flp.add_argument("--timeout", type=float, default=600.0)
+    flp.set_defaults(fn=cmd_fleet)
 
     pcp = sub.add_parser("pcap", help="summarize a capture file")
     pcp.add_argument("file")

@@ -12,8 +12,9 @@ from kubedtn_tpu.federation.migrate import (STEPS, FederationController,
                                             MigrationCoordinator,
                                             MigrationError,
                                             MigrationStats, PlaneHandle,
+                                            restore_tenant_slice,
                                             stats_for)
 
 __all__ = ["STEPS", "FederationController", "MigrationCoordinator",
            "MigrationError", "MigrationStats", "PlaneHandle",
-           "stats_for"]
+           "restore_tenant_slice", "stats_for"]

@@ -139,12 +139,25 @@ class PlaneHandle:
     """One federation member: a daemon with its live plane and tenant
     registry. `addr` is the daemon's wire address (used to turn a
     cross-node wire whose peer IS the destination into a local wire
-    at restore)."""
+    at restore).
+
+    The three optional fields are the fleet supervisor's hooks
+    (federation.supervisor): `checkpoint_dir` names the plane's
+    crash-consistent checkpoint (the cold-restore source when the
+    plane dies), `probe` overrides the health probe (default: the
+    in-process `daemon.health_snapshot()`; a gRPC Local.Health dial
+    for planes in other processes), and `restarter` performs the
+    plane's binary restart for `kdt fleet upgrade` (checkpoint →
+    teardown → rebuild → new server) and returns the REPLACEMENT
+    handle."""
 
     name: str
     daemon: object        # wire.server.Daemon
     plane: object         # runtime.WireDataPlane
     registry: object      # tenancy.TenantRegistry
+    checkpoint_dir: str | None = None
+    probe: object = None       # () -> health dict; raises when dead
+    restarter: object = None   # () -> PlaneHandle (the replacement)
 
     @property
     def engine(self):
@@ -157,6 +170,185 @@ class PlaneHandle:
     @property
     def addr(self) -> str:
         return self.engine.node_ip
+
+
+def restore_tenant_slice(dst: PlaneHandle, tenant: str, fork: dict,
+                         arrays: dict, src_addr: str,
+                         hold: bool = True):
+    """Replay a captured tenant slice onto `dst` at ONE stage barrier:
+    tenant registered with its quotas and block entitlement, topologies
+    recreated with placement moved to dst, rows adopted bit-exact
+    (identity-keyed PRNG streams ride the link identity), dynamic
+    shaping columns scattered with the clock columns rebased by the
+    capture→dst shaped-gap, wires re-created (a cross-node wire whose
+    peer IS dst becomes local). The ONE restore implementation — the
+    migration RESTORE step replays a live fork through it (tenant HELD
+    until cutover commits), and the fleet supervisor's evacuation
+    replays a checkpoint/journal slice through it (hold=False: the dead
+    plane cannot cut over, the survivor serves immediately).
+
+    The slice's cumulative delivery counters do NOT scatter in: the
+    failover accounting freezes them as the src half of the record
+    (federation.supervisor) exactly like RECONCILE freezes the src
+    counter slice — the survivor's live counters stay purely its own,
+    so `frozen + live` explains the feed without double counting.
+    Takes dst's stage barrier itself (re-entrant under the tick lock,
+    so the coordinator's own barrier composes). Returns the adopted
+    row list."""
+    return dst.plane.stage_update_round(
+        lambda: _restore_slice_locked(dst, tenant, fork, arrays,
+                                      src_addr, hold))
+
+
+def _restore_slice_locked(dst: PlaneHandle, tenant: str, fork: dict,
+                          arrays: dict, src_addr: str, hold: bool):
+    cfg = fork["registry"]
+    reg_d = dst.registry
+    reg_d.create(tenant, qos=cfg["qos"],
+                 frame_budget_per_s=cfg["frame_budget_per_s"],
+                 byte_budget_per_s=cfg["byte_budget_per_s"],
+                 block_edges=int(cfg["block_rows"]),
+                 namespaces=cfg["namespaces"])
+    if hold:
+        # held until CUTOVER commits: dst must not shape a single
+        # tenant frame while a pre-cutover rollback is still legal
+        reg_d.hold(tenant)
+    from kubedtn_tpu.api.types import Topology
+    from kubedtn_tpu.topology.store import NotFoundError
+
+    for rec in fork["topologies"]:
+        meta = rec["manifest"]["metadata"]
+        ns = meta.get("namespace", "default")
+        name = meta["name"]
+        try:
+            dst.store.get(ns, name)
+        except NotFoundError:
+            topo = Topology.from_manifest(rec["manifest"])
+            # placement moves with the tenant: the pod now lives on
+            # dst (link ops realized here from now on)
+            if topo.status.src_ip == src_addr:
+                topo.status.src_ip = dst.addr
+            dst.store.create(topo)
+            dst.engine.set_alive(name, ns, dst.addr,
+                                 topo.status.net_ns
+                                 or f"/run/netns/{name}")
+    entries = []
+    props = np.asarray(arrays["props"], np.float32)
+    for i, (pod_key, uid, sname, dname, shaped) in enumerate(
+            fork["identities"]):
+        entries.append((pod_key, int(uid), sname, dname,
+                        props[i], bool(shaped)))
+    peers = [((a, int(b)), (c, int(d)))
+             for a, b, c, d in fork["peers"]]
+    rows = dst.engine.adopt_rows(entries, peers=peers)
+    # dynamic shaping state lands bit-exact; the clock columns are
+    # rebased by the wall gap between the capture barrier and dst's
+    # newest shaped tick (exactly the rolls dst's own dispatches did
+    # NOT apply to these rows — 0, hence verbatim bits, when the
+    # planes tick in lockstep). The floored max composes with
+    # _roll_clocks' sequential maxes: max(max(x-a,f)-b,f) ==
+    # max(x-(a+b),f).
+    import jax.numpy as jnp
+
+    fork_shaped = fork.get("fork_shaped_s")
+    dst_shaped = dst.plane._last_shaped_s
+    delta_us = np.float32(0.0)
+    if fork_shaped is not None and dst_shaped is not None:
+        delta_us = np.float32(
+            max(0.0, (dst_shaped - fork_shaped) * 1e6))
+    floor = np.float32(-1e7)
+    t_last = np.maximum(
+        np.asarray(arrays["t_last"], np.float32) - delta_us, floor)
+    backlog = np.maximum(
+        np.asarray(arrays["backlog_until"], np.float32) - delta_us,
+        floor)
+    engine = dst.engine
+    with engine._lock:
+        engine._flush_device_locked()
+        st = engine._state
+        rj = jnp.asarray(np.asarray(rows, np.int32))
+        engine._state = dataclasses.replace(
+            st,
+            tokens=st.tokens.at[rj].set(
+                jnp.asarray(arrays["tokens"])),
+            t_last=st.t_last.at[rj].set(jnp.asarray(t_last)),
+            corr=st.corr.at[rj].set(jnp.asarray(arrays["corr"])),
+            pkt_count=st.pkt_count.at[rj].set(
+                jnp.asarray(arrays["pkt_count"])),
+            backlog_until=st.backlog_until.at[rj].set(
+                jnp.asarray(backlog)))
+    # the adopted rows' plane counters start from ZERO here: a reused
+    # row must not leak its previous occupant's history into the
+    # tenant's slice (migration RECONCILE and failover accounting both
+    # sum the frozen src slice + this plane's live slice, so residue
+    # would read as phantom delivery)
+    plane = dst.plane
+    cnt = plane.counters
+    cap = int(np.asarray(cnt.tx_packets).shape[0])
+    rz = [r for r in rows if r < cap]
+    if rz:
+        # columns may be np (post-compact permute) or jnp — normalize
+        ri = jnp.asarray(np.asarray(rz, np.int32))
+        plane.counters = type(cnt)(**{
+            f.name: jnp.asarray(getattr(cnt, f.name)).at[ri].set(0.0)
+            for f in dataclasses.fields(type(cnt))})
+    # wires: a cross-node wire whose peer IS dst becomes local (the
+    # frames that used to ride the src→dst gRPC hop now deliver on
+    # dst directly); third-party peers are kept
+    from kubedtn_tpu.wire.server import Wire
+
+    for pod_key, uid, peer_ip, peer_intf_id, ifname in fork["wires"]:
+        peer = "" if peer_ip == dst.addr else peer_ip
+
+        def build(wire_id: int, _pk=pod_key, _uid=uid,
+                  _peer=peer, _pid=peer_intf_id, _if=ifname):
+            return Wire(wire_id=wire_id, uid=int(_uid),
+                        pod_key=_pk, node_iface_name=_if,
+                        peer_intf_id=int(_pid), peer_ip=_peer)
+
+        dst.daemon.wires.get_or_create(pod_key, int(uid), build)
+    return rows
+
+
+def discard_partial_restore(dst: PlaneHandle, tenant: str,
+                            fork: dict) -> None:
+    """Remove everything a RESTORE may have left on `dst` for this
+    fork: exactly the fork-captured rows / wires / store records and
+    the tenant registration — never a neighbor wire that merely shares
+    the namespace. Safe however little actually landed (every sub-step
+    checks). The dst half of the pre-cutover crash contract, shared by
+    the coordinator's `_undo_partial` and the fleet supervisor's
+    resolution of a migration whose SRC died (the partial dst state is
+    discarded before the evacuation re-restores from the journal
+    fork)."""
+    from kubedtn_tpu.topology.store import NotFoundError
+
+    keys = [(pk, int(uid)) for pk, uid, *_rest in fork["identities"]]
+
+    def _drop():
+        return dst.engine.abandon_rows(keys)
+
+    dst.plane.stage_update_round(_drop)
+    # exactly the wires RESTORE creates (the fork capture) — never a
+    # neighbor wire that merely shares the namespace on dst (e.g. the
+    # peer-side wires of the tenant's cross-node links)
+    for pod_key, uid, _peer_ip, _pid, _if in fork["wires"]:
+        dst.daemon.wires.delete_by_key(pod_key, int(uid))
+    for rec in fork["topologies"]:
+        ns = rec["manifest"]["metadata"].get("namespace", "default")
+        name = rec["manifest"]["metadata"]["name"]
+        try:
+            dst.store.get(ns, name)
+        except NotFoundError:
+            continue
+        try:
+            # clears placement + our finalizer so delete() completes
+            dst.engine.set_alive(name, ns, "", "")
+            dst.store.delete(ns, name)
+        except NotFoundError:
+            pass
+    dst.registry.release_hold(tenant)
+    dst.registry.delete(tenant)
 
 
 @guarded_by("_lock", "_record")
@@ -333,7 +525,7 @@ class MigrationCoordinator:
         anyway and rollback() releases it explicitly."""
         with self._lock:
             fork = self._record.get("fork")
-        src_d, dst_d = self.src.daemon, self.dst.daemon
+        src_d = self.src.daemon
         if fork is None:
             return
         pairs = self._wire_pairs(fork, require_dst=False)
@@ -353,24 +545,9 @@ class MigrationCoordinator:
                     break
             if moved:
                 ws.ingress.extendleft(reversed(moved))
-        # 3. dst partial state: rows, wires, store records, tenant
-        keys = [(pk, int(uid)) for pk, uid, *_rest in fork["identities"]]
-
-        def _drop():
-            self.dst.engine.abandon_rows(keys)
-
-        self.dst.plane.stage_update_round(_drop)
-        # exactly the wires RESTORE creates (the fork capture) — never
-        # a neighbor wire that merely shares the namespace on dst
-        # (e.g. the peer-side wires of the tenant's cross-node links)
-        for pod_key, uid, _peer_ip, _pid, _if in fork["wires"]:
-            dst_d.wires.delete_by_key(pod_key, int(uid))
-        for rec in fork["topologies"]:
-            ns = rec["manifest"]["metadata"].get("namespace", "default")
-            name = rec["manifest"]["metadata"]["name"]
-            self._drop_store_record(self.dst, ns, name)
-        self.dst.registry.release_hold(self.tenant)
-        self.dst.registry.delete(self.tenant)
+        # 3. dst partial state: rows, wires, store records, tenant —
+        # the shared dst half of the crash contract
+        discard_partial_restore(self.dst, self.tenant, fork)
 
     # -- steps ---------------------------------------------------------
 
@@ -475,100 +652,11 @@ class MigrationCoordinator:
             _rec, arrays = journal.load_record(self.journal_root,
                                                self.migration_id)
             self._fork_arrays = arrays
-        cfg = fork["registry"]
 
         def _apply():
-            reg_d = dst.registry
-            reg_d.create(self.tenant, qos=cfg["qos"],
-                         frame_budget_per_s=cfg["frame_budget_per_s"],
-                         byte_budget_per_s=cfg["byte_budget_per_s"],
-                         block_edges=int(cfg["block_rows"]),
-                         namespaces=cfg["namespaces"])
-            # held until CUTOVER commits: dst must not shape a single
-            # tenant frame while a pre-cutover rollback is still legal
-            reg_d.hold(self.tenant)
-            from kubedtn_tpu.api.types import Topology
-            from kubedtn_tpu.topology.store import NotFoundError
-
-            for rec in fork["topologies"]:
-                meta = rec["manifest"]["metadata"]
-                ns = meta.get("namespace", "default")
-                name = meta["name"]
-                try:
-                    dst.store.get(ns, name)
-                except NotFoundError:
-                    topo = Topology.from_manifest(rec["manifest"])
-                    # placement moves with the tenant: the pod now
-                    # lives on dst (link ops realized here from now on)
-                    if topo.status.src_ip == self.src.addr:
-                        topo.status.src_ip = dst.addr
-                    dst.store.create(topo)
-                    dst.engine.set_alive(name, ns, dst.addr,
-                                         topo.status.net_ns
-                                         or f"/run/netns/{name}")
-            entries = []
-            props = np.asarray(arrays["props"], np.float32)
-            for i, (pod_key, uid, sname, dname, shaped) in enumerate(
-                    fork["identities"]):
-                entries.append((pod_key, int(uid), sname, dname,
-                                props[i], bool(shaped)))
-            peers = [((a, int(b)), (c, int(d)))
-                     for a, b, c, d in fork["peers"]]
-            rows = dst.engine.adopt_rows(entries, peers=peers)
-            # dynamic shaping state lands bit-exact; the clock columns
-            # are rebased by the wall gap between src's fork barrier
-            # and dst's newest shaped tick (exactly the rolls dst's own
-            # dispatches did NOT apply to these rows — 0, hence
-            # verbatim bits, when the planes tick in lockstep). The
-            # floored max composes with _roll_clocks' sequential maxes:
-            # max(max(x-a,f)-b,f) == max(x-(a+b),f).
-            import jax.numpy as jnp
-
-            fork_shaped = fork.get("fork_shaped_s")
-            dst_shaped = dst.plane._last_shaped_s
-            delta_us = np.float32(0.0)
-            if fork_shaped is not None and dst_shaped is not None:
-                delta_us = np.float32(
-                    max(0.0, (dst_shaped - fork_shaped) * 1e6))
-            floor = np.float32(-1e7)
-            t_last = np.maximum(
-                np.asarray(arrays["t_last"], np.float32) - delta_us,
-                floor)
-            backlog = np.maximum(
-                np.asarray(arrays["backlog_until"], np.float32)
-                - delta_us, floor)
-            engine = dst.engine
-            with engine._lock:
-                engine._flush_device_locked()
-                st = engine._state
-                rj = jnp.asarray(np.asarray(rows, np.int32))
-                engine._state = dataclasses.replace(
-                    st,
-                    tokens=st.tokens.at[rj].set(
-                        jnp.asarray(arrays["tokens"])),
-                    t_last=st.t_last.at[rj].set(jnp.asarray(t_last)),
-                    corr=st.corr.at[rj].set(jnp.asarray(arrays["corr"])),
-                    pkt_count=st.pkt_count.at[rj].set(
-                        jnp.asarray(arrays["pkt_count"])),
-                    backlog_until=st.backlog_until.at[rj].set(
-                        jnp.asarray(backlog)))
-            # wires: a cross-node wire whose peer IS dst becomes local
-            # (the frames that used to ride the src→dst gRPC hop now
-            # deliver on dst directly); third-party peers are kept
-            from kubedtn_tpu.wire.server import Wire
-
-            for pod_key, uid, peer_ip, peer_intf_id, ifname in \
-                    fork["wires"]:
-                peer = "" if peer_ip == dst.addr else peer_ip
-
-                def build(wire_id: int, _pk=pod_key, _uid=uid,
-                          _peer=peer, _pid=peer_intf_id, _if=ifname):
-                    return Wire(wire_id=wire_id, uid=int(_uid),
-                                pod_key=_pk, node_iface_name=_if,
-                                peer_intf_id=int(_pid), peer_ip=_peer)
-
-                dst.daemon.wires.get_or_create(pod_key, int(uid), build)
-            return len(rows)
+            return len(restore_tenant_slice(
+                dst, self.tenant, fork, arrays, self.src.addr,
+                hold=True))
 
         n_rows = dst.plane.stage_update_round(_apply)
         self._chaos_step("restore")
@@ -760,6 +848,33 @@ class MigrationCoordinator:
             return src.engine.abandon_rows(keys)
 
         freed = src.plane.stage_update_round(_free)
+        # delivered-but-unconsumed EGRESS frames ride to dst before the
+        # wires go: egress is the consumer's delivery buffer, and the
+        # consumer re-attaches to the dst wire — deleting a src wire
+        # must never delete deliveries the consumer has not picked up
+        # yet (found by the fleet_rolling_upgrade zero-loss drive: a
+        # consumer that polls slower than the migration completes lost
+        # every frame delivered during the move). Idempotent: a resumed
+        # RELEASE finds the already-moved egress empty.
+        handed_off = 0
+        for ws in src.daemon.wires.in_namespaces(spaces):
+            wd = self.dst.daemon.wires.get_by_key(ws.pod_key, ws.uid)
+            if wd is None:
+                continue
+            moved = []
+            while True:
+                try:
+                    moved.append(ws.egress.popleft())
+                except IndexError:
+                    break
+            if moved:
+                # PREPEND, order preserved: src delivered these before
+                # dst's post-cutover deliveries, and a consumer slower
+                # than the migration must still read the wire FIFO
+                # (same discipline as the rollback path's
+                # ingress.extendleft)
+                wd.egress.extendleft(reversed(moved))
+                handed_off += len(moved)
         pod_keys = {w.pod_key
                     for w in src.daemon.wires.in_namespaces(spaces)}
         for pk in pod_keys:
@@ -772,7 +887,8 @@ class MigrationCoordinator:
         src.registry.release_hold(self.tenant)
         src.registry.delete(self.tenant)
         self._chaos_step("release")
-        self._commit("release", released_rows=int(freed))
+        self._commit("release", released_rows=int(freed),
+                     egress_handed_off=int(handed_off))
 
     # -- accounting ----------------------------------------------------
 
@@ -832,6 +948,11 @@ class FederationController:
         self.journal_root = journal_root
         self.stats = stats if stats is not None else MigrationStats()
         self.chaos = chaos
+        # set by the fleet supervisor (federation.supervisor.attach):
+        # called with (tenant, dst_plane, qos) after every COMPLETED
+        # migration so the placement ledger tracks manual `kdt migrate`
+        # moves too, not only supervisor-driven ones
+        self.placement_hook = None
         self._lock = threading.Lock()
         self._handles: dict[str, PlaneHandle] = {}
         self._coords: dict[str, MigrationCoordinator] = {}
@@ -853,6 +974,26 @@ class FederationController:
         if h is None:
             raise MigrationError(f"unknown federation plane {name!r}")
         return h
+
+    def plane_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._handles)
+
+    def _notify_placement(self, tenant: str, dst: str) -> None:
+        hook = self.placement_hook
+        if hook is None:
+            return
+        try:
+            t = self.handle(dst).registry.get(tenant)
+            hook(tenant, dst, t.qos if t is not None else None)
+        except Exception:
+            from kubedtn_tpu.utils.logging import fields, get_logger
+
+            # the move itself succeeded; a lagging ledger is the
+            # supervisor's to reconcile on its next attach/sweep
+            get_logger("federation").exception(
+                "placement hook failed (ledger may lag) %s",
+                fields(tenant=tenant, dst=dst))
 
     def plane_name_of(self, daemon) -> str:
         """The registered plane name serving `daemon` (the RPC surface
@@ -911,9 +1052,12 @@ class FederationController:
             self._coords[mid] = co
         self._begin(tenant)
         try:
-            return co.migrate()
+            rec = co.migrate()
         finally:
             self._end(tenant)
+        if rec.get("state") == "done":
+            self._notify_placement(tenant, dst)
+        return rec
 
     def coordinator(self, migration_id: str) -> MigrationCoordinator:
         with self._lock:
@@ -934,9 +1078,12 @@ class FederationController:
         co = self.coordinator(migration_id)
         self._begin(co.tenant)
         try:
-            return co.resume()
+            rec = co.resume()
         finally:
             self._end(co.tenant)
+        if rec.get("state") == "done":
+            self._notify_placement(co.tenant, co.dst.name)
+        return rec
 
     def status(self, migration_id: str = "",
                tenant: str = "") -> list[dict]:

@@ -647,6 +647,76 @@ class MigrationStatsCollector:
         return out
 
 
+class FleetStatsCollector:
+    """kubedtn_fleet_* series — observability for the fleet supervisor
+    (kubedtn_tpu.federation.supervisor): probe volume and failures,
+    suspicion-machine transitions by target state, per-state plane
+    gauge, evacuation volume (tenants / rows / restored in-flight
+    frames), orphaned-migration resumes, rolling-upgrade volume, and
+    the honest-loss gauge `kubedtn_fleet_reported_lost` — the
+    checkpoint-to-death gap of the latest failover accounting check
+    (reported, never hidden; the companion
+    kubedtn_migration_accounting_mismatch must stay 0)."""
+
+    COUNTERS = (
+        ("probes", "Plane health probes issued"),
+        ("probe_failures", "Probes that failed hard (plane "
+                           "unreachable)"),
+        ("sweeps", "Supervision sweeps over the fleet"),
+        ("evacuations", "Dead-plane evacuations run"),
+        ("evacuated_tenants", "Tenants cold-restored onto survivors"),
+        ("evacuated_rows", "Edge rows restored by evacuations"),
+        ("pending_restored", "Checkpointed in-flight frames restored "
+                             "by evacuations"),
+        ("orphans_resumed", "Orphaned migration journals auto-resumed"),
+        ("upgrades", "Rolling-upgrade drives completed"),
+        ("upgrade_migrations", "Live migrations run by rolling "
+                               "upgrades (drain + refill)"),
+    )
+
+    def __init__(self, supervisor) -> None:
+        self._sup = supervisor
+
+    def collect(self):
+        snap = self._sup.stats.snapshot()
+        out = []
+        for name, doc in self.COUNTERS:
+            c = CounterMetricFamily(f"kubedtn_fleet_{name}", doc)
+            c.add_metric([], float(snap[name]))
+            out.append(c)
+        tr = CounterMetricFamily(
+            "kubedtn_fleet_transitions",
+            "Suspicion state-machine transitions by target state",
+            labels=["to_state"])
+        for state, n in sorted(snap["transitions"].items()):
+            tr.add_metric([state], float(n))
+        out.append(tr)
+        st = self._sup.status()
+        by_state: dict[str, int] = {}
+        for p in st["planes"]:
+            by_state[p["state"]] = by_state.get(p["state"], 0) + 1
+        g = GaugeMetricFamily(
+            "kubedtn_fleet_planes",
+            "Registered planes by suspicion state", labels=["state"])
+        for state in ("healthy", "suspect", "dead", "cordoned",
+                      "restarting"):
+            g.add_metric([state], float(by_state.get(state, 0)))
+        out.append(g)
+        pl = GaugeMetricFamily(
+            "kubedtn_fleet_placements",
+            "Tenants in the placement ledger")
+        pl.add_metric([], float(len(st["placements"])))
+        out.append(pl)
+        lost = GaugeMetricFamily(
+            "kubedtn_fleet_reported_lost",
+            "Frames reported lost by the latest failover accounting "
+            "check (the checkpoint-to-death RPO gap — reported, "
+            "never hidden)")
+        lost.add_metric([], float(snap["reported_lost"]))
+        out.append(lost)
+        return out
+
+
 class MetricsServer:
     """Serves the registry on an HTTP port — the daemon's :51112/metrics
     endpoint (reference daemon/main.go:57-66)."""
@@ -705,7 +775,8 @@ class MetricsServer:
 def make_registry(engine=None, sim_counters_fn=None,
                   max_interfaces: int = 10_000, dataplane=None,
                   whatif_stats=None, update_stats=None, tenancy=None,
-                  max_tenants: int = 256, migration_stats=None):
+                  max_tenants: int = 256, migration_stats=None,
+                  fleet=None):
     """Registry with the parity collectors installed."""
     registry = CollectorRegistry()
     hist = LatencyHistograms(registry)
@@ -726,4 +797,6 @@ def make_registry(engine=None, sim_counters_fn=None,
             tenancy, dataplane, max_tenants=max_tenants))
     if migration_stats is not None:
         registry.register(MigrationStatsCollector(migration_stats))
+    if fleet is not None:
+        registry.register(FleetStatsCollector(fleet))
     return registry, hist

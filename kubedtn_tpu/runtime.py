@@ -2029,6 +2029,46 @@ class WireDataPlane:
         hb = self._heartbeat_s
         return None if hb is None else time.monotonic() - hb
 
+    @property
+    def watchdog_stalled(self) -> bool:
+        """Is the runner CURRENTLY stalled past the watchdog timeout
+        (armed watchdog only — cold-cache jit compiles don't count)?
+        The live half of the grpc.health.v1 NOT_SERVING verdict."""
+        if not self._watchdog_armed:
+            return False
+        age = self.heartbeat_age_s
+        return age is not None and age > self.watchdog_timeout_s
+
+    def health(self) -> dict:
+        """The plane-local slice of the Local.Health surface: runner
+        liveness, tick supervision, degradation rung, and backlog — the
+        signals the fleet supervisor's suspicion machine consumes
+        (until now only the Prometheus endpoint exported them). Every
+        field is a torn-read-tolerant gauge snapshot: this must answer
+        even while a wedged dispatch holds the tick lock, so nothing
+        here blocks on it."""
+        hb = self.heartbeat_age_s
+        return {
+            "running": self.running,
+            "heartbeat_age_s": hb,
+            "watchdog_stalls": self.watchdog_stalls,
+            "watchdog_stalled": self.watchdog_stalled,
+            "degrade_level": self.degrade_level,
+            "tick_errors": self.tick_errors,
+            "ticks": self.ticks,
+            "backlog": self.last_backlog,
+            # dtnlint: lock-ok(gauge snapshot: len/int reads are torn-read tolerant and must not block behind a wedged dispatch holding the tick lock)
+            "holdback_wires": len(self._holdback),
+            "inflight": len(self._inflight),  # dtnlint: lock-ok(gauge snapshot, see above)
+            "pipeline_depth": self.pipeline_depth,
+            "effective_depth": self.effective_pipeline_depth,
+            # serving = what the generic grpc.health.v1 probe reports:
+            # NOT_SERVING while the degradation ladder sits at its
+            # bottom rung or the runner is stalled past the watchdog
+            "serving": not (self.degrade_level >= 2
+                            or self.watchdog_stalled),
+        }
+
     def attach_chaos(self, injector) -> None:
         """Wire a chaos.ChaosInjector into this plane's fault domains:
         the per-peer egress RPCs and the dispatch hook."""

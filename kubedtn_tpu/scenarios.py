@@ -2465,6 +2465,507 @@ def migration_under_flap(pairs: int = 2, seconds: float = 6.0,
     }
 
 
+def _fleet_node(tenants: dict, addr_port, latency: str, dt_us: float,
+                pairs: int, seed: int = 0, capacity: int = 128):
+    """One fleet member: store/engine/registry/daemon/plane + a real
+    gRPC server, explicit-clock plane (the failover scenarios drive
+    lockstep ticks so the kill/restart instants are exact). `tenants`
+    maps tenant name → base uid offset."""
+    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.tenancy import TenantRegistry
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon, make_server
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=capacity)
+    daemon = Daemon(engine)
+    server, port = make_server(daemon, port=addr_port, host="127.0.0.1",
+                               log_rpcs=False)
+    server.start()
+    addr = f"127.0.0.1:{port}"
+    engine.node_ip = addr
+    registry = TenantRegistry(engine)
+    plane = WireDataPlane(daemon, dt_us=dt_us, seed=seed)
+    plane.pipeline_explicit_clock = True
+    plane.attach_tenancy(registry)
+    props = LinkProperties(latency=latency)
+    for ns, base in tenants.items():
+        registry.create(ns)
+        for i in range(pairs):
+            uid = base + i + 1
+            a, b = f"{ns}-a{i}", f"{ns}-b{i}"
+            for name, peer in ((a, b), (b, a)):
+                t = Topology(name=name, namespace=ns,
+                             spec=TopologySpec(links=[
+                                 Link(local_intf="eth1",
+                                      peer_intf="eth1", peer_pod=peer,
+                                      uid=uid, properties=props)]))
+                store.create(t)
+                engine.setup_pod(name, ns)
+    Reconciler(store, engine).drain()
+    for ns, base in tenants.items():
+        for i in range(pairs):
+            uid = base + i + 1
+            for side in ("a", "b"):
+                daemon._add_wire(pb.WireDef(
+                    local_pod_name=f"{ns}-{side}{i}", kube_ns=ns,
+                    link_uid=uid, intf_name_in_pod="eth1"))
+    return {"store": store, "engine": engine, "daemon": daemon,
+            "plane": plane, "registry": registry, "server": server,
+            "addr": addr}
+
+
+def plane_failover(pairs: int = 2, warm_ticks: int = 30,
+                   gap_frames_per_wire: int = 5,
+                   frames_per_tick: int = 3, latency: str = "2ms",
+                   dt_us: float = 2_000.0, seed: int = 7):
+    """SIGKILL a loaded plane MID-MIGRATION; the fleet supervisor
+    evacuates with NO operator action — the failover acceptance
+    scenario:
+
+    - two real gRPC daemons A (tenant `vic` + `bga`) and B (`bgb`),
+      lockstep explicit-clock ticks, real Local.Health probes over the
+      wire (`grpc_probe`) so the kill is detected as genuine dial
+      failures;
+    - steady load drains to idle, a crash-consistent `save_live`
+      checkpoint lands (the periodic-autosave stand-in), then a
+      migration of `vic` A→B is interrupted by an injected crash at
+      RESTORE (journal holds a `running` record with the FORK
+      committed);
+    - `gap_frames_per_wire` more frames load A's ingress and A is
+      killed (`kill -9` stand-in: no flush, no checkpoint, server
+      down);
+    - supervisor sweeps: healthy → suspect → dead (hysteresis), then
+      evacuates automatically — the journal FORK (newest
+      crash-consistent capture) rolls the tenant forward onto B, the
+      background tenant comes from A's checkpoint;
+    - feed resumes on B; the verdict pins the failover accounting
+      EXACT: fed == delivered_src + delivered_dst + reported_lost
+      with reported_lost exactly the post-checkpoint gap frames,
+      `kubedtn_migration_accounting_mismatch` == 0, and the restored
+      rows byte-identical to the fork capture."""
+    import tempfile
+
+    from kubedtn_tpu.chaos import ChaosError, ChaosInjector
+    from kubedtn_tpu.federation import (FederationController,
+                                        MigrationStats, PlaneHandle)
+    from kubedtn_tpu.federation.supervisor import (FleetSupervisor,
+                                                   grpc_probe)
+
+    t0 = time.perf_counter()
+    A = _fleet_node({"vic": 0, "bga": pairs}, 0, latency, dt_us, pairs,
+                    seed=seed)
+    B = _fleet_node({"bgb": 2 * pairs}, 0, latency, dt_us, pairs,
+                    seed=seed)
+    root = tempfile.mkdtemp(prefix="kdt-failover-")
+    ck_a = f"{root}/ckA"
+    mstats = MigrationStats()
+    chaos = ChaosInjector(seed=seed)
+    fed = FederationController(f"{root}/journal", stats=mstats,
+                               chaos=chaos)
+    fed.register(PlaneHandle("A", A["daemon"], A["plane"],
+                             A["registry"], checkpoint_dir=ck_a,
+                             probe=grpc_probe(A["addr"])))
+    fed.register(PlaneHandle("B", B["daemon"], B["plane"],
+                             B["registry"],
+                             probe=grpc_probe(B["addr"])))
+    sup = FleetSupervisor(fed, f"{root}/ledger", chaos=chaos,
+                          suspect_after=2, dead_after=4,
+                          healthy_after=2).attach()
+
+    k = [0]
+    fed_vic = [0]
+    delivered = [0]
+
+    def wire_of(node, ns, side, i, base):
+        return node["daemon"].wires.get_by_key(f"{ns}/{ns}-{side}{i}",
+                                               base + i + 1)
+
+    def tick(feed_on=None):
+        k[0] += 1
+        t = 100.0 + k[0] * dt_us / 1e6
+        if feed_on is not None:
+            for i in range(pairs):
+                w = wire_of(feed_on, "vic", "a", i, 0)
+                for _ in range(frames_per_tick):
+                    w.ingress.append(b"V" * 64)
+                fed_vic[0] += frames_per_tick
+        # background tenants keep both planes dispatching every tick
+        for node, ns, base in ((A, "bga", pairs), (B, "bgb", 2 * pairs)):
+            if getattr(node["daemon"], "chaos_dead", False):
+                continue
+            for i in range(pairs):
+                w = wire_of(node, ns, "a", i, base)
+                if w is not None:
+                    w.ingress.append(b"G" * 64)
+        for node in (A, B):
+            if not getattr(node["daemon"], "chaos_dead", False):
+                node["plane"].tick(now_s=t)
+
+    def drain():
+        for node in (A, B):
+            for i in range(pairs):
+                w = wire_of(node, "vic", "b", i, 0)
+                if w is None:
+                    continue
+                while True:
+                    try:
+                        w.egress.popleft()
+                        delivered[0] += 1
+                    except IndexError:
+                        break
+
+    def settle_drain(n):
+        for _ in range(n):
+            tick()
+        drain()
+
+    outcome = {}
+    try:
+        # steady load, then drain to idle (the checkpoint is a clean
+        # cut: no in-flight vic frames, counters == delivered)
+        for _ in range(warm_ticks):
+            tick(feed_on=A)
+        settle_drain(warm_ticks)
+        A["plane"].flush()
+        k[0] += 5000
+        settle_drain(1)
+        delivered_before = delivered[0]
+        # the periodic autosave (the RPO anchor)
+        from kubedtn_tpu import checkpoint
+
+        checkpoint.save_live(ck_a, A["store"], A["engine"], A["plane"])
+        # mid-migration: crash injected at RESTORE — the journal keeps
+        # a running record with the FORK committed
+        chaos.fail_migration_step("restore")
+        migration_crashed = False
+        try:
+            fed.migrate("vic", "A", "B", settle=tick)
+        except ChaosError:
+            migration_crashed = True
+        # load the plane (the post-checkpoint gap), then kill -9
+        gap = 0
+        for i in range(pairs):
+            w = wire_of(A, "vic", "a", i, 0)
+            for _ in range(gap_frames_per_wire):
+                w.ingress.append(b"L" * 64)
+                gap += 1
+        fed_vic[0] += gap
+        chaos.kill_plane(fed.handle("A"), server=A["server"])
+        # supervision: probes fail over the REAL wire, hysteresis
+        # steps healthy → suspect → dead, evacuation is automatic
+        sweeps = 0
+        while sweeps < 20:
+            sweeps += 1
+            tr = sup.sweep()
+            if tr.get("A") == "dead":
+                break
+        evac = sup.evacuations()[-1] if sup.evacuations() else {}
+        vic_entry = (evac.get("tenants") or {}).get("vic", {})
+        # fork byte-identity: the restored rows carry the capture's
+        # exact bits (lockstep clocks ⇒ rebase delta 0)
+        rows_identical = True
+        if vic_entry.get("survivor") == "B":
+            from kubedtn_tpu.federation import journal as fjournal
+
+            mid = fed.status(tenant="vic")[-1]["migration_id"]
+            rec_full, arrays = fjournal.load_record(f"{root}/journal",
+                                                    mid)
+            fork = rec_full["fork"]
+            eng_b = B["engine"]
+            for n_i, (pk, uid, *_r) in enumerate(fork["identities"]):
+                row = eng_b._rows.get((pk, int(uid)))
+                if row is None:
+                    rows_identical = False
+                    break
+                for col in ("tokens", "t_last", "corr", "pkt_count",
+                            "backlog_until", "props"):
+                    a = np.asarray(getattr(eng_b.state, col))[row]
+                    b = np.asarray(arrays[col])[n_i]
+                    if not np.array_equal(a, b):
+                        rows_identical = False
+        # feed resumes on the survivor with NO operator action
+        for _ in range(warm_ticks):
+            tick(feed_on=B)
+        settle_drain(warm_ticks)
+        B["plane"].flush()
+        k[0] += 5000
+        settle_drain(1)
+        acct = sup.check_failover_accounting("vic", fed_vic[0])
+        snap = mstats.snapshot()
+        fstats = sup.stats.snapshot()
+        in_guardrails = (
+            vic_entry.get("survivor") == "B"
+            and vic_entry.get("source") == "journal-fork"
+            and migration_crashed
+            and rows_identical
+            and acct["mismatch"] == 0.0
+            and acct["reported_lost"] == gap
+            and fed_vic[0] == acct["delivered_src"]
+            + acct["delivered_dst"] + acct["reported_lost"]
+            and delivered[0] == acct["delivered_src"]
+            + acct["delivered_dst"]
+            and snap["accounting_mismatch"] == 0.0
+            and B["plane"].tick_errors == 0)
+        outcome = {
+            "scenario": "plane_failover",
+            "pairs": pairs,
+            "fed": fed_vic[0],
+            "delivered": delivered[0],
+            "delivered_before_kill": delivered_before,
+            "gap_frames": gap,
+            "sweeps_to_dead": sweeps,
+            "evacuation": {
+                "survivor": vic_entry.get("survivor"),
+                "source": vic_entry.get("source"),
+                "rows": vic_entry.get("rows"),
+                "migrations_resolved": [
+                    m["action"] for m in
+                    evac.get("migrations_resolved", ())],
+            },
+            "restored_rows_byte_identical": rows_identical,
+            "accounting": acct,
+            "accounting_mismatch_gauge": snap["accounting_mismatch"],
+            "reported_lost_gauge": fstats["reported_lost"],
+            "transitions": fstats["transitions"],
+            "in_guardrails": in_guardrails,
+            "wall_s": round(time.perf_counter() - t0, 3),
+        }
+    finally:
+        for node in (A, B):
+            try:
+                node["server"].stop(0)
+            except Exception:
+                pass
+    return outcome
+
+
+def fleet_rolling_upgrade(pairs: int = 1, steady_s: float = 1.5,
+                          offered_frames_per_s: int = 2_000,
+                          latency: str = "1ms", dt_us: float = 2_000.0,
+                          seed: int = 3,
+                          drain_timeout_s: float = 60.0):
+    """`kdt fleet upgrade` end to end across TWO real gRPC daemons
+    with live runners: the supervisor cordons each plane in turn,
+    drains its tenants to the other plane via zero-loss live
+    migrations, restarts the daemon binary (graceful checkpoint →
+    full teardown → rebuild from the checkpoint → new server on the
+    SAME port), health-verifies over the real wire before refilling,
+    then moves to the next plane — while a retrying producer keeps
+    offering load the whole time. Verdict: zero frame loss for every
+    accepted frame (fed == delivered), both planes restarted and
+    health-verified, `kubedtn_migration_accounting_mismatch` == 0."""
+    import tempfile
+    import threading as _threading
+
+    from kubedtn_tpu.federation import (FederationController,
+                                        MigrationStats, PlaneHandle)
+    from kubedtn_tpu.federation.supervisor import (FleetSupervisor,
+                                                   grpc_probe)
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.tenancy import TenantRegistry
+    from kubedtn_tpu.wire.server import Daemon, make_server
+
+    t0 = time.perf_counter()
+    root = tempfile.mkdtemp(prefix="kdt-upgrade-")
+    nodes: dict[str, dict] = {}
+    TEN = {"A": ("ta", 0), "B": ("tb", pairs)}
+
+    def build(name, port=0):
+        node = _fleet_node({TEN[name][0]: TEN[name][1]}, port, latency,
+                           dt_us, pairs, seed=seed)
+        node["plane"].pipeline_explicit_clock = False
+        node["draining"] = False
+        # accept gate: the in-process stand-in for the TCP listener —
+        # a producer's append either lands before the teardown capture
+        # (checkpointed) or is refused (producer retries elsewhere),
+        # never silently dropped in between
+        node["gate"] = _threading.Lock()
+        nodes[name] = node
+        return node
+
+    for name in ("A", "B"):
+        build(name)
+        nodes[name]["plane"].start()
+    mstats = MigrationStats()
+    fed = FederationController(f"{root}/journal", stats=mstats)
+
+    def drain_node(name) -> int:
+        got = 0
+        daemon = nodes[name]["daemon"]
+        for w in daemon.wires.all():
+            while True:
+                try:
+                    w.egress.popleft()
+                    got += 1
+                except IndexError:
+                    break
+        return got
+
+    def make_restarter(name):
+        def restart():
+            from kubedtn_tpu import checkpoint
+
+            node = nodes[name]
+            addr = node["addr"]
+            port = int(addr.rsplit(":", 1)[1])
+            ck = f"{root}/ck{name}"
+            # graceful shutdown: close the accept gate (a retrying
+            # producer sees refusal, exactly like a stopped listener),
+            # drain delivered egress to the consumer, checkpoint —
+            # incl. delay-line frames, QUEUED INGRESS, wires, counters
+            with node["gate"]:
+                node["draining"] = True
+            node["server"].stop(0)
+            node["plane"].stop()
+            delivered[0] += drain_node(name)
+            checkpoint.save(ck, node["store"], node["engine"],
+                            dataplane=node["plane"])
+            # "new binary": full rebuild from the checkpoint
+            store2, engine2 = checkpoint.load(ck)
+            engine2.node_ip = addr
+            tenancy2 = (checkpoint.load_tenancy(ck, engine2)
+                        or TenantRegistry(engine2))
+            daemon2 = Daemon(engine2)
+            plane2 = WireDataPlane(daemon2, dt_us=dt_us, seed=seed)
+            plane2.attach_tenancy(tenancy2)
+            checkpoint.load_wires(ck, daemon2)
+            n_ingress = checkpoint.load_ingress(ck, daemon2)
+            checkpoint.restore_plane_counters(ck, plane2)
+            n_pend = checkpoint.load_pending(ck, plane2)
+            checkpoint.consume_pending(ck)
+            # same port: the fleet's address book must survive the
+            # upgrade (peers and probes keep dialing the same addr)
+            server2 = None
+            for _ in range(50):
+                server2, bound = make_server(daemon2, port=port,
+                                             host="127.0.0.1",
+                                             log_rpcs=False)
+                if bound:
+                    break
+                time.sleep(0.1)
+            assert server2 is not None and bound, "port rebind failed"
+            server2.start()
+            plane2.start()
+            nodes[name] = {"store": store2, "engine": engine2,
+                           "daemon": daemon2, "plane": plane2,
+                           "registry": tenancy2, "server": server2,
+                           "addr": addr, "draining": False,
+                           "gate": node["gate"], "restarted": True,
+                           "pending_restored": n_pend,
+                           "ingress_restored": n_ingress}
+            return PlaneHandle(name, daemon2, plane2, tenancy2,
+                               checkpoint_dir=ck,
+                               probe=grpc_probe(addr),
+                               restarter=restart)
+
+        return restart
+
+    for name in ("A", "B"):
+        node = nodes[name]
+        fed.register(PlaneHandle(name, node["daemon"], node["plane"],
+                                 node["registry"],
+                                 checkpoint_dir=f"{root}/ck{name}",
+                                 probe=grpc_probe(node["addr"]),
+                                 restarter=make_restarter(name)))
+    sup = FleetSupervisor(fed, f"{root}/ledger",
+                          healthy_after=2).attach()
+
+    fed_count = [0]
+    delivered = [0]
+    stop_feed = _threading.Event()
+
+    def feeder():
+        # a RETRYING producer: resolves each tenant wire on whichever
+        # plane currently realizes it; while a plane restarts
+        # (draining) its frames wait — accepted frames are the loss
+        # denominator, exactly like a client retrying a refused dial
+        pace_s = 0.02
+        chunk = max(1, int(offered_frames_per_s * pace_s
+                           / max(1, 2 * pairs)))
+        while not stop_feed.is_set():
+            for ns, base in (("ta", 0), ("tb", pairs)):
+                for i in range(pairs):
+                    for name in ("A", "B"):
+                        node = nodes[name]
+                        with node["gate"]:
+                            if node["draining"]:
+                                continue
+                            w = node["daemon"].wires.get_by_key(
+                                f"{ns}/{ns}-a{i}", base + i + 1)
+                            if w is None:
+                                continue
+                            if node["engine"].row_of(
+                                    f"{ns}/{ns}-a{i}",
+                                    base + i + 1) is None:
+                                continue
+                            for _ in range(chunk):
+                                w.ingress.append(b"U" * 64)
+                            fed_count[0] += chunk
+                        break
+            time.sleep(pace_s)
+
+    feed = _threading.Thread(target=feeder, daemon=True)
+    feed.start()
+    report = None
+    try:
+        time.sleep(steady_s)
+        report = sup.rolling_upgrade(planes=["A", "B"],
+                                     verify_probes=2,
+                                     verify_timeout_s=30.0)
+        time.sleep(steady_s)
+    finally:
+        stop_feed.set()
+        feed.join(timeout=5)
+    # full drain: everything accepted must come out somewhere
+    deadline = time.monotonic() + drain_timeout_s
+    while time.monotonic() < deadline:
+        for name in ("A", "B"):
+            delivered[0] += drain_node(name)
+        if delivered[0] >= fed_count[0]:
+            break
+        time.sleep(0.05)
+    for name in ("A", "B"):
+        nodes[name]["plane"].flush_peers(timeout_s=10.0)
+        delivered[0] += drain_node(name)
+    snap = mstats.snapshot()
+    reports = (report or {}).get("reports", [])
+    frames_lost = fed_count[0] - delivered[0]
+    in_guardrails = (
+        frames_lost == 0
+        and len(reports) == 2
+        and all(r["restarted"] and r["healthy"] and not r["error"]
+                for r in reports)
+        and all(nodes[n].get("restarted") for n in ("A", "B"))
+        and snap["accounting_mismatch"] == 0.0
+        and all(nodes[n]["plane"].tick_errors == 0
+                for n in ("A", "B")))
+    out = {
+        "scenario": "fleet_rolling_upgrade",
+        "pairs": pairs,
+        "frames_fed": fed_count[0],
+        "frames_delivered": delivered[0],
+        "frames_lost": frames_lost,
+        "migrations": (report or {}).get("migrations", 0),
+        "reports": [{k: v for k, v in r.items()} for r in reports],
+        "pending_restored": sum(
+            int(nodes[n].get("pending_restored", 0))
+            for n in ("A", "B")),
+        "accounting_mismatch_gauge": snap["accounting_mismatch"],
+        "migrations_completed": snap["completed"],
+        "in_guardrails": in_guardrails,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+    for name in ("A", "B"):
+        try:
+            nodes[name]["plane"].stop()
+            nodes[name]["server"].stop(0)
+        except Exception:
+            pass
+    return out
+
+
 LADDER = {
     "3node": three_node,
     "fat_tree_64": fat_tree_64,
@@ -2486,4 +2987,6 @@ LADDER = {
     "noisy_neighbor": noisy_neighbor,
     "tenant_soak": tenant_soak,
     "migration_under_flap": migration_under_flap,
+    "plane_failover": plane_failover,
+    "fleet_rolling_upgrade": fleet_rolling_upgrade,
 }

@@ -394,6 +394,82 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
         _field("ok", 1, B), _field("error", 2, S),
         _field("migrations", 3, None, REP, type_name="MigrationInfo"),
     ))
+    # Framework extension (absent from reference kube_dtn.proto): the
+    # fleet-supervision surface (kubedtn_tpu.federation.supervisor) —
+    # rich plane health (the signals that until now only the Prometheus
+    # endpoint exported), the supervisor's per-plane suspicion state +
+    # placement ledger, and the rolling-upgrade driver. Reference
+    # clients never see these types.
+    f.message_type.append(_msg(
+        "HealthRequest",
+        _field("plane", 1, S),          # empty = the serving plane
+    ))
+    f.message_type.append(_msg(
+        "HealthResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("node", 3, S),
+        _field("running", 4, B),
+        _field("serving", 5, B),        # the grpc.health.v1 verdict
+        _field("heartbeat_age_s", 6, D),   # -1 = no runner
+        _field("watchdog_stalls", 7, I64),
+        _field("watchdog_stalled", 8, B),
+        _field("degrade_level", 9, I32),
+        _field("tick_errors", 10, I64),
+        _field("ticks", 11, I64),
+        _field("backlog", 12, I64),
+        _field("holdback_wires", 13, I32),
+        _field("inflight", 14, I32),
+        _field("pipeline_depth", 15, I32),
+        _field("effective_depth", 16, I32),
+        _field("tenants", 17, I32),
+        _field("capacity", 18, I32),
+        _field("active_rows", 19, I32),
+        _field("headroom_rows", 20, I32),
+    ))
+    f.message_type.append(_msg(
+        "PlaneStatus",
+        _field("name", 1, S),
+        _field("state", 2, S),          # healthy|suspect|dead|cordoned
+        _field("consecutive_failures", 3, I32),
+        _field("last_error", 4, S),
+        _field("tenants_placed", 5, I32),
+        _field("health", 6, None, type_name="HealthResponse"),
+    ))
+    f.message_type.append(_msg(
+        "PlacementEntry",
+        _field("tenant", 1, S), _field("plane", 2, S),
+    ))
+    f.message_type.append(_msg("FleetStatusRequest"))
+    f.message_type.append(_msg(
+        "FleetStatusResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("planes", 3, None, REP, type_name="PlaneStatus"),
+        _field("placements", 4, None, REP, type_name="PlacementEntry"),
+        _field("sweeps", 5, I64),
+        _field("evacuations", 6, I64),
+    ))
+    f.message_type.append(_msg(
+        "FleetUpgradeRequest",
+        _field("planes", 1, S, REP),       # empty = every plane
+        _field("verify_probes", 2, I32),   # 0 = supervisor default
+        _field("timeout_s", 3, D),
+    ))
+    f.message_type.append(_msg(
+        "UpgradeReport",
+        _field("plane", 1, S),
+        _field("drained_tenants", 2, S, REP),
+        _field("refilled_tenants", 3, S, REP),
+        _field("restarted", 4, B),
+        _field("healthy", 5, B),
+        _field("error", 6, S),
+    ))
+    f.message_type.append(_msg(
+        "FleetUpgradeResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("reports", 3, None, REP, type_name="UpgradeReport"),
+        _field("migrations", 4, I32),
+        _field("frames_lost_known", 5, B),
+    ))
     return f
 
 
@@ -418,7 +494,11 @@ for _name in ("LinkProperties", "Link", "Pod", "PodQuery",
               "TenantResponse", "TenantListResponse",
               "TenantStatsResponse",
               "MigrateRequest", "MigrationInfo", "MigrateResponse",
-              "MigrationStatusRequest", "MigrationStatusResponse"):
+              "MigrationStatusRequest", "MigrationStatusResponse",
+              "HealthRequest", "HealthResponse", "PlaneStatus",
+              "PlacementEntry", "FleetStatusRequest",
+              "FleetStatusResponse", "FleetUpgradeRequest",
+              "UpgradeReport", "FleetUpgradeResponse"):
     _MESSAGES[_name] = message_factory.GetMessageClass(
         _pool.FindMessageTypeByName(f"{PACKAGE}.{_name}"))
 
@@ -465,6 +545,15 @@ MigrationInfo = _MESSAGES["MigrationInfo"]
 MigrateResponse = _MESSAGES["MigrateResponse"]
 MigrationStatusRequest = _MESSAGES["MigrationStatusRequest"]
 MigrationStatusResponse = _MESSAGES["MigrationStatusResponse"]
+HealthRequest = _MESSAGES["HealthRequest"]
+HealthResponse = _MESSAGES["HealthResponse"]
+PlaneStatus = _MESSAGES["PlaneStatus"]
+PlacementEntry = _MESSAGES["PlacementEntry"]
+FleetStatusRequest = _MESSAGES["FleetStatusRequest"]
+FleetStatusResponse = _MESSAGES["FleetStatusResponse"]
+FleetUpgradeRequest = _MESSAGES["FleetUpgradeRequest"]
+UpgradeReport = _MESSAGES["UpgradeReport"]
+FleetUpgradeResponse = _MESSAGES["FleetUpgradeResponse"]
 
 # Service method tables: name -> (request class, response class, streaming)
 LOCAL_METHODS = {
@@ -507,6 +596,13 @@ LOCAL_METHODS = {
     "MigrateTenant": (MigrateRequest, MigrateResponse, False),
     "MigrationStatus": (MigrationStatusRequest,
                         MigrationStatusResponse, False),
+    # Framework extensions: fleet supervision — rich plane health (the
+    # suspicion machine's probe surface), supervisor status, and the
+    # rolling-upgrade driver (kubedtn_tpu.federation.supervisor; not in
+    # the reference IDL)
+    "Health": (HealthRequest, HealthResponse, False),
+    "FleetStatus": (FleetStatusRequest, FleetStatusResponse, False),
+    "FleetUpgrade": (FleetUpgradeRequest, FleetUpgradeResponse, False),
 }
 REMOTE_METHODS = {
     "Update": (RemotePod, BoolResponse, False),

@@ -179,6 +179,13 @@ class _NotifyingDeque(deque):
         """Underlying entry count (deque length)."""
         return deque.__len__(self)
 
+    def snapshot_entries(self) -> list:
+        """Consistent copy of the queued entries (producers may be
+        appending concurrently — plain iteration can raise). The
+        checkpoint capture reads ingress through this."""
+        with self._flock:
+            return list(self)
+
     def append(self, item) -> None:  # noqa: A003
         with self._flock:
             super().append(item)
@@ -406,6 +413,10 @@ class Daemon:
         # the Local.MigrateTenant / MigrationStatus RPC surface (absent
         # = the RPCs answer ok=False "federation not enabled")
         self.federation = None
+        # federation.supervisor.FleetSupervisor installed by its
+        # attach(): the Local.FleetStatus / FleetUpgrade RPC surface
+        # (absent = the RPCs answer ok=False "fleet not enabled")
+        self.fleet = None
         self.wires = WireManager(on_ingress=self.mark_hot)
         self.hist = latency_histograms
         # deadline on per-frame peer forwards: a blackholed peer must cost
@@ -826,6 +837,138 @@ class Daemon:
         return pb.MigrationStatusResponse(
             ok=True,
             migrations=[self._migration_info(r) for r in recs])
+
+    # -- fleet supervision (framework extension:
+    #    kubedtn_tpu.federation.supervisor) ----------------------------
+
+    def health_snapshot(self) -> dict:
+        """The full Local.Health payload for THIS daemon's plane: the
+        plane-local supervision gauges (runtime.WireDataPlane.health)
+        plus engine capacity headroom and tenant count. Every read is a
+        torn-read-tolerant gauge — the probe must answer even while a
+        wedged dispatch holds the tick lock (that wedge is precisely
+        what the caller is trying to detect)."""
+        plane = self.dataplane
+        if plane is not None:
+            h = plane.health()
+        else:  # control-plane-only daemon: serving, but no runner
+            h = {"running": False, "heartbeat_age_s": None,
+                 "watchdog_stalls": 0, "watchdog_stalled": False,
+                 "degrade_level": 0, "tick_errors": 0, "ticks": 0,
+                 "backlog": 0, "holdback_wires": 0, "inflight": 0,
+                 "pipeline_depth": 0, "effective_depth": 0,
+                 "serving": True}
+        engine = self.engine
+        # int shape read, torn-read tolerant: the probe must not block
+        # behind the engine lock
+        cap = int(engine._state.capacity)
+        active = int(engine.num_active)
+        h["node"] = engine.node_ip
+        h["capacity"] = cap
+        h["active_rows"] = active
+        h["headroom_rows"] = max(0, cap - active)
+        reg = self.tenancy
+        h["tenants"] = len(reg.list()) if reg is not None else 0
+        return h
+
+    @staticmethod
+    def _health_response(h: dict, ok: bool = True,
+                         error: str = "") -> "pb.HealthResponse":
+        hb = h.get("heartbeat_age_s")
+        return pb.HealthResponse(
+            ok=ok, error=error, node=h.get("node", ""),
+            running=bool(h.get("running", False)),
+            serving=bool(h.get("serving", False)),
+            heartbeat_age_s=-1.0 if hb is None else float(hb),
+            watchdog_stalls=int(h.get("watchdog_stalls", 0)),
+            watchdog_stalled=bool(h.get("watchdog_stalled", False)),
+            degrade_level=int(h.get("degrade_level", 0)),
+            tick_errors=int(h.get("tick_errors", 0)),
+            ticks=int(h.get("ticks", 0)),
+            backlog=int(h.get("backlog", 0)),
+            holdback_wires=int(h.get("holdback_wires", 0)),
+            inflight=int(h.get("inflight", 0)),
+            pipeline_depth=int(h.get("pipeline_depth", 0)),
+            effective_depth=int(h.get("effective_depth", 0)),
+            tenants=int(h.get("tenants", 0)),
+            capacity=int(h.get("capacity", 0)),
+            active_rows=int(h.get("active_rows", 0)),
+            headroom_rows=int(h.get("headroom_rows", 0)))
+
+    def Health(self, request, context):
+        """Framework extension: the rich plane-health surface the fleet
+        supervisor's suspicion machine probes — heartbeat age, watchdog
+        stalls, degradation rung, tick errors, backlog, tenant count
+        and capacity headroom in one RPC (until now only the Prometheus
+        endpoint carried these). `plane` names another plane registered
+        with this daemon's federation controller; empty = this one."""
+        name = request.plane
+        if name and self.federation is not None:
+            from kubedtn_tpu.federation import MigrationError
+
+            try:
+                handle = self.federation.handle(name)
+            except MigrationError as e:
+                return pb.HealthResponse(ok=False, error=str(e))
+            return self._health_response(handle.daemon.health_snapshot())
+        return self._health_response(self.health_snapshot())
+
+    def FleetStatus(self, request, context):
+        """Framework extension: the fleet supervisor's view — per-plane
+        suspicion state + health, and the placement ledger."""
+        sup = self.fleet
+        if sup is None:
+            return pb.FleetStatusResponse(
+                ok=False, error="fleet supervision not enabled on this "
+                                "daemon")
+        st = sup.status()
+        return pb.FleetStatusResponse(
+            ok=True,
+            planes=[pb.PlaneStatus(
+                name=p["name"], state=p["state"],
+                consecutive_failures=int(p["consecutive_failures"]),
+                last_error=p.get("last_error") or "",
+                tenants_placed=int(p["tenants_placed"]),
+                health=self._health_response(p["health"])
+                if p.get("health") else pb.HealthResponse(ok=False),
+            ) for p in st["planes"]],
+            placements=[pb.PlacementEntry(tenant=t, plane=pl)
+                        for t, pl in sorted(st["placements"].items())],
+            sweeps=int(st["sweeps"]),
+            evacuations=int(st["evacuations"]))
+
+    def FleetUpgrade(self, request, context):
+        """Framework extension: rolling upgrade across the supervisor's
+        planes — cordon, drain via live migration, restart the daemon,
+        health-verify, refill, next plane. Synchronous; the request
+        timeout bounds it."""
+        sup = self.fleet
+        if sup is None:
+            return pb.FleetUpgradeResponse(
+                ok=False, error="fleet supervision not enabled on this "
+                                "daemon")
+        from kubedtn_tpu.federation import MigrationError
+        from kubedtn_tpu.federation.supervisor import FleetError
+
+        try:
+            out = sup.rolling_upgrade(
+                planes=list(request.planes) or None,
+                verify_probes=int(request.verify_probes) or None)
+        except (FleetError, MigrationError) as e:
+            return pb.FleetUpgradeResponse(
+                ok=False, error=f"{type(e).__name__}: {e}")
+        return pb.FleetUpgradeResponse(
+            ok=all(r.get("error", "") == "" for r in out["reports"]),
+            reports=[pb.UpgradeReport(
+                plane=r["plane"],
+                drained_tenants=list(r["drained_tenants"]),
+                refilled_tenants=list(r["refilled_tenants"]),
+                restarted=bool(r["restarted"]),
+                healthy=bool(r["healthy"]),
+                error=r.get("error", ""),
+            ) for r in out["reports"]],
+            migrations=int(out["migrations"]),
+            frames_lost_known=bool(out["frames_lost_known"]))
 
     # -- Remote --------------------------------------------------------
 
@@ -1311,12 +1454,17 @@ def _handler(fn, req_cls, resp_cls, streaming: bool, raw: bool = False):
     )
 
 
-def _health_handlers():
+def _health_handlers(daemon: Daemon | None = None):
     """Standard grpc.health.v1 service (Check + server-streaming Watch),
     built dynamically like the parity proto — the daemon-side analogue of
     the reference controller's healthz/readyz probes (reference
-    main.go:113-120). Always reports SERVING while the server is up; a
-    stopped server fails the TCP dial, which is the NOT_SERVING signal."""
+    main.go:113-120). With a daemon, the status reflects REAL plane
+    state: NOT_SERVING while the degradation ladder sits at its bottom
+    rung or the watchdog has declared a live stall — so a generic
+    k8s/grpc probe agrees with the rich Local.Health surface instead of
+    reporting SERVING from a plane that is barely alive. Without a
+    daemon (legacy callers), SERVING while the server is up; a stopped
+    server fails the TCP dial either way."""
     from google.protobuf import (descriptor_pb2, descriptor_pool,
                                  message_factory)
 
@@ -1347,10 +1495,18 @@ def _health_handlers():
         filed.message_types_by_name["HealthCheckRequest"])
     resp_cls = message_factory.GetMessageClass(
         filed.message_types_by_name["HealthCheckResponse"])
-    SERVING = 1
+    SERVING, NOT_SERVING = 1, 2
+
+    def current_status() -> int:
+        if daemon is None:
+            return SERVING
+        plane = daemon.dataplane
+        if plane is None:
+            return SERVING  # control plane up, no runner to degrade
+        return SERVING if plane.health()["serving"] else NOT_SERVING
 
     def check(request, context):
-        return resp_cls(status=SERVING)
+        return resp_cls(status=current_status())
 
     # Each parked Watch stream pins one thread-pool worker for its whole
     # lifetime (sync gRPC consumes response generators from the pool), so
@@ -1363,17 +1519,23 @@ def _health_handlers():
     watch_slots = threading.BoundedSemaphore(max_parked_watchers)
 
     def watch(request, context):
-        # per the health protocol, Watch sends the current status and then
-        # keeps the stream open, sending again only on change; this server
-        # is SERVING for its whole lifetime, so: one message, then hold
-        # until the client cancels or the server shuts down
-        yield resp_cls(status=SERVING)
+        # per the health protocol, Watch sends the current status and
+        # then keeps the stream open, sending again only on change —
+        # the parked loop polls the plane's serving verdict so a ladder
+        # collapse (or recovery) reaches generic watchers without them
+        # re-dialing
+        last = current_status()
+        yield resp_cls(status=last)
         if not watch_slots.acquire(blocking=False):
             return  # over the parking cap: close; client re-Watches
         try:
             done = threading.Event()
             context.add_callback(done.set)
-            done.wait()
+            while not done.wait(timeout=0.5):
+                now = current_status()
+                if now != last:
+                    last = now
+                    yield resp_cls(status=now)
         finally:
             watch_slots.release()
 
@@ -1425,7 +1587,7 @@ def make_server(daemon: Daemon, port: int = DEFAULT_PORT,
         ))
     server.add_generic_rpc_handlers((
         grpc.method_handlers_generic_handler(
-            "grpc.health.v1.Health", _health_handlers()),
+            "grpc.health.v1.Health", _health_handlers(daemon)),
     ))
     # all interfaces by default: peer daemons (Remote.Update) and the
     # physical-join CLI dial in from other hosts, like the reference's
