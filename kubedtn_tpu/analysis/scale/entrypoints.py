@@ -92,6 +92,8 @@ _MIG = "kubedtn_tpu/federation/migrate.py"
 _SUP = "kubedtn_tpu/federation/supervisor.py"
 _PLC = "kubedtn_tpu/federation/placement.py"
 _TEL = "kubedtn_tpu/telemetry.py"
+_SLO = "kubedtn_tpu/slo/evaluator.py"
+_SLF = "kubedtn_tpu/slo/fleet.py"
 
 SCALE_ENTRIES: dict[str, dict] = {
     # the steady data path: host work per tick must scale with the
@@ -227,6 +229,40 @@ SCALE_ENTRIES: dict[str, dict] = {
             (_MIG, "MigrationCoordinator._wire_pairs"),
             (_MIG, "MigrationCoordinator._transfer"),
             (_SRV, "WireManager.in_namespaces"),
+        ),
+    },
+    # SLO evaluation: one pass per telemetry window rollover — one
+    # vectorized ring reduction per burn-window span plus O(tenants)
+    # Python arithmetic (mask gather + scalar comparisons per tenant);
+    # the censored-tail fit is bounded by the constant bucket ladder
+    "slo_evaluate": {
+        "budget": CLASS_TENANTS,
+        "roots": (
+            (_SLO, "SloEvaluator.evaluate"),
+            (_SLO, "SloEvaluator.maybe_evaluate"),
+            (_SLO, "SloEvaluator._throttle_pressure"),
+            (_SLO, "SloEvaluator.verdicts"),
+            (_SLO, "SloEvaluator.verdict_payloads"),
+            (_SLO, "evaluate_tenant"),
+            (_SLO, "_burns"),
+        ),
+    },
+    # fleet SLO merge: one pass over the registered planes' verdict
+    # payloads + the journal's frozen slices, one exact histogram sum
+    # per tenant — O(planes·tenants), both registry-sized
+    "fleet_slo_merge": {
+        "budget": CLASS_TENANTS,
+        "roots": (
+            (_SUP, "FleetSupervisor.fleet_slo"),
+            (_SUP, "FleetSupervisor.last_fleet_slo"),
+            (_SLF, "fleet_slo"),
+            (_SLF, "merge_tenant"),
+            (_SLF, "merge_hists"),
+            (_SLF, "from_verdict"),
+            (_SLF, "from_frozen_window"),
+            (_SLF, "contribution"),
+            (_SLF, "_row_of"),
+            (_MIG, "FederationController.frozen_windows"),
         ),
     },
     # fleet supervision: one probe + state-machine step per registered

@@ -372,9 +372,14 @@ def cmd_top(args) -> int:
                         "queue_depth": l.queue_depth,
                         "p50_us": None if l.p50_us < 0 else l.p50_us,
                         "p99_us": None if l.p99_us < 0 else l.p99_us,
+                        "p99_censored": l.p99_censored,
                     } for l in resp.links]})))
                 continue
-            fmt_us = lambda v: "-" if v < 0 else f"{v / 1000:.2f}ms"  # noqa: E731
+            # censored quantile = clamped at the ladder's open top
+            # bucket: the real value is >= it, so render ">5000.00ms"
+            # — never an "=" that silently understates the tail
+            fmt_us = lambda v, c=False: (  # noqa: E731
+                "-" if v < 0 else f"{'>' if c else ''}{v / 1000:.2f}ms")
             print(f"links via {args.daemon} — window "
                   f"{resp.covered_seconds:.1f}s "
                   f"({resp.windows_closed} closed"
@@ -386,11 +391,197 @@ def cmd_top(args) -> int:
             for l in resp.links:
                 name = f"{l.pod}/uid{l.uid}"
                 print(f"{name:<24}{l.delivered_pps:>10.1f}"
-                      f"{fmt_us(l.p50_us):>10}{fmt_us(l.p99_us):>10}"
+                      f"{fmt_us(l.p50_us):>10}"
+                      f"{fmt_us(l.p99_us, l.p99_censored):>10}"
                       f"{l.dropped_loss:>8.0f}{l.dropped_queue:>8.0f}"
                       f"{l.corrupted:>8.0f}{l.queue_depth:>8.0f}")
     finally:
         client.close()
+    return 0
+
+
+def _slo_row_dict(t) -> dict:
+    """One wire SloTenant row as a JSON-safe dict (shared by the
+    single-daemon and fleet-merge paths)."""
+    none_if = lambda v: None if v < 0 else v  # noqa: E731
+    return {
+        "tenant": t.tenant, "qos": t.qos,
+        "spec": {
+            "delivery_ratio_floor": t.delivery_ratio_floor,
+            "p99_bound_us": t.p99_bound_us,
+            "p999_bound_us": t.p999_bound_us,
+            # burn-alerting half — omitted (0) fields fall back to
+            # the SloSpec defaults in the client-side merge
+            **({"fast_windows": t.fast_windows}
+               if t.fast_windows else {}),
+            **({"slow_windows": t.slow_windows}
+               if t.slow_windows else {}),
+            **({"warn_burn": t.warn_burn} if t.warn_burn else {}),
+            **({"page_burn": t.page_burn} if t.page_burn else {}),
+        },
+        "window_seconds": t.window_seconds,
+        "tx": t.tx, "delivered": t.delivered,
+        "delivery_ratio": none_if(t.delivery_ratio),
+        "p50_us": none_if(t.p50_us),
+        "p99_us": none_if(t.p99_us),
+        "p99_censored": t.p99_censored,
+        "p999_us": none_if(t.p999_us),
+        "tail_method": t.tail_method,
+        "fast_burn": t.fast_burn, "slow_burn": t.slow_burn,
+        "budget_remaining": t.budget_remaining,
+        "throttle_backlog": t.throttle_backlog,
+        "attainment_ok": t.attainment_ok,
+        "latency_ok": t.latency_ok,
+        "severity": t.severity,
+        "hist": list(t.hist),
+        "frozen": t.frozen, "plane": t.plane,
+        "planes": list(t.planes),
+        "frozen_planes": list(t.frozen_planes),
+        "frozen_tx": t.frozen_tx,
+        "frozen_delivered": t.frozen_delivered,
+    }
+
+
+def _render_slo_table(rows: list[dict], title: str) -> None:
+    """Fixed-width per-tenant SLO table: attainment vs floor,
+    estimated tails (a censored p99 renders `>Xms`), burn rates,
+    remaining budget, severity."""
+    fmt_ms = lambda v, c=False: (  # noqa: E731
+        "-" if v is None else f"{'>' if c else ''}{v / 1000:.2f}ms")
+    fmt_pct = lambda v: "-" if v is None else f"{100 * v:.3f}%"  # noqa: E731
+    print(title)
+    hdr = (f"{'tenant':<14}{'qos':<8}{'attain':>9}{'floor':>9}"
+           f"{'p99(est)':>11}{'p99.9(est)':>12}{'fast':>7}{'slow':>7}"
+           f"{'budget':>8}  status")
+    print(hdr)
+    for r in rows:
+        status = r["severity"]
+        if r.get("frozen"):
+            status = "frozen"
+        elif not (r["attainment_ok"] and r["latency_ok"]):
+            status += " MISS"
+        extra = ""
+        if r.get("planes") or r.get("frozen_planes"):
+            parts = list(r.get("planes") or ())
+            parts += [f"{p}(frozen)" for p in
+                      r.get("frozen_planes") or ()]
+            extra = "  [" + ", ".join(parts) + "]"
+        elif r.get("plane"):
+            extra = f"  [{r['plane']}]"
+        tail = fmt_ms(r["p999_us"],
+                      r["tail_method"] == "censored-clamp")
+        print(f"{r['tenant']:<14}{r['qos'] or '-':<8}"
+              f"{fmt_pct(r['delivery_ratio']):>9}"
+              f"{fmt_pct(r['spec']['delivery_ratio_floor']):>9}"
+              f"{fmt_ms(r['p99_us'], r['p99_censored']):>11}"
+              f"{tail:>12}"
+              f"{r['fast_burn']:>7.2f}{r['slow_burn']:>7.2f}"
+              f"{100 * r['budget_remaining']:>7.1f}%"
+              f"  {status}{extra}")
+
+
+def cmd_slo(args) -> int:
+    """`kdt slo [--tenant T] [--fleet]` — the SLO observability plane's
+    operator surface (Local.ObserveSLO): per-tenant attainment vs
+    objective, censored-tail-estimated p99/p99.9, multi-window burn
+    rates and remaining error budget. With --fleet and SEVERAL
+    --daemon addresses the answers are merged CLIENT-side on the
+    shared bucket ladder (exact), stitching a migrated tenant's frozen
+    pre-move slice with its live post-move window — the continuous
+    fleet view; a single daemon with a fleet supervisor serves its
+    server-side merge instead."""
+    import grpc
+
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+
+    daemons = args.daemon or ["127.0.0.1:51111"]
+    if len(daemons) > 1 and not args.fleet:
+        # without --fleet only one daemon's answer could be shown —
+        # silently dropping the others would read as "tenant missing"
+        print("slo: several --daemon addresses need --fleet (the "
+              "cross-plane merge)", file=sys.stderr)
+        return 1
+    fleet_many = args.fleet and len(daemons) > 1
+    fleet_local = args.fleet and len(daemons) == 1
+    responses = []
+    for addr in daemons:
+        client = DaemonClient(addr)
+        try:
+            resp = client.ObserveSLO(
+                pb.ObserveSLORequest(tenant=args.tenant or "",
+                                     fleet=fleet_local),
+                timeout=args.timeout)
+        except grpc.RpcError as e:
+            # a multi-daemon fleet merge TOLERATES a dead plane (the
+            # supervisor's server-side merge does the same): the view
+            # must stay available during exactly the outage the
+            # operator is looking into — warn and merge the rest
+            print(f"slo: daemon {addr} RPC failed: {_rpc_code(e)}"
+                  + (" (merging the remaining planes)" if fleet_many
+                     else ""), file=sys.stderr)
+            if not fleet_many:
+                return 1
+            continue
+        finally:
+            client.close()
+        if not resp.ok:
+            print(f"slo: {addr}: {resp.error}", file=sys.stderr)
+            if not fleet_many:
+                return 1
+            continue
+        responses.append((addr, resp))
+    if not responses:
+        print("slo: no daemon answered", file=sys.stderr)
+        return 1
+
+    if args.fleet and len(daemons) > 1:
+        # client-side merge over every daemon's answer: live rows per
+        # plane + frozen journal slices, the same slo.fleet arithmetic
+        # the supervisor runs server-side
+        from kubedtn_tpu.slo.fleet import fleet_slo as _merge
+
+        per_plane: dict = {}
+        frozen = []
+        for addr, resp in responses:
+            plane = resp.plane or addr
+            for t in resp.tenants:
+                d = _slo_row_dict(t)
+                if d["frozen"]:
+                    frozen.append((d["plane"] or plane, d["tenant"],
+                                   {"tx": d["tx"],
+                                    "delivered": d["delivered"],
+                                    "window_seconds":
+                                        d["window_seconds"],
+                                    "hist": d["hist"]}, d["qos"]))
+                else:
+                    per_plane.setdefault(plane, []).append(d)
+        merged = _merge(per_plane, frozen, tenant=args.tenant or "")
+        rows = [merged[k] for k in sorted(merged)]
+        title = (f"fleet SLO via {', '.join(daemons)} "
+                 f"({len(rows)} tenant(s))")
+    else:
+        _addr, resp = responses[0]
+        rows = [_slo_row_dict(t) for t in resp.tenants]
+        if fleet_local and not resp.fleet:
+            # the daemon has no fleet supervisor: it answered with its
+            # own plane only — say so instead of mislabeling the view
+            print("slo: daemon has no fleet supervisor — showing its "
+                  "single-plane view", file=sys.stderr)
+        where = ("fleet view via" if fleet_local and resp.fleet
+                 else "SLO via")
+        title = (f"{where} {daemons[0]} — {resp.windows_closed} "
+                 f"windows closed, {resp.evaluations} evaluations")
+    if args.tenant:
+        rows = [r for r in rows if r["tenant"] == args.tenant]
+    if args.json:
+        print(json.dumps(_json_safe({"tenants": rows})))
+        return 0
+    if not rows:
+        print("slo: no tenants evaluated yet (no tenancy registry, "
+              "or no telemetry windows closed)", file=sys.stderr)
+        return 1
+    _render_slo_table(rows, title)
     return 0
 
 
@@ -800,6 +991,7 @@ def cmd_daemon(args) -> int:
                                "kubedtn-fleet"))
     fleet = FleetSupervisor(federation, fleet_root).attach()
     fleet.start(interval_s=2.0)
+    slo_eval = None
     if not getattr(args, "no_telemetry", False):
         # link telemetry plane: per-edge window ring + sampled flight
         # recorder, riding the fused tick (no extra device dispatch)
@@ -810,6 +1002,15 @@ def cmd_daemon(args) -> int:
         log.info("link telemetry on %s", fields(
             window_s=getattr(args, "telemetry_window", 1.0),
             sample_period=getattr(args, "telemetry_sample", 256)))
+        # SLO plane: per-tenant objectives evaluated at every telemetry
+        # window rollover on a sidecar thread (zero tick-path work; the
+        # Local.ObserveSLO / `kdt slo` / kubedtn_slo_* surface)
+        from kubedtn_tpu.slo import SloEvaluator
+
+        slo_eval = SloEvaluator(tenancy, dataplane).attach(daemon)
+        slo_eval.start()
+        log.info("slo evaluation on %s", fields(
+            window_s=getattr(args, "telemetry_window", 1.0)))
     shard = getattr(args, "shard_mesh", 0)
     if shard:
         # edge-sharded live plane: SoA columns block-shard across the
@@ -877,7 +1078,7 @@ def cmd_daemon(args) -> int:
                                    update_stats=update_stats_for(daemon),
                                    tenancy=tenancy,
                                    migration_stats=migration_stats,
-                                   fleet=fleet)
+                                   fleet=fleet, slo=slo_eval)
     engine.stats.observer = hist
     daemon.hist = hist
     server, port = make_server(daemon, port=args.port)
@@ -921,6 +1122,8 @@ def cmd_daemon(args) -> int:
         server.wait_for_termination()
     except KeyboardInterrupt:
         fleet.stop()
+        if slo_eval is not None:
+            slo_eval.stop()
         if autosaver is not None:
             # a mid-shutdown autosave would race the final save below
             autosaver.stop()
@@ -1277,6 +1480,7 @@ def cmd_whatif(args) -> int:
             "p50_us": none_if(m.p50_us),
             "p90_us": none_if(m.p90_us),
             "p99_us": none_if(m.p99_us),
+            "p99_censored": bool(m.p99_censored),
             "mean_queue_occupancy": m.mean_queue_occupancy,
             "latency_hist": list(m.latency_hist),
         } for m in resp.results]
@@ -1468,6 +1672,28 @@ def main(argv=None) -> int:
                      help="seconds between refreshes")
     top.add_argument("--json", action="store_true")
     top.set_defaults(fn=cmd_top)
+
+    slp = sub.add_parser(
+        "slo",
+        help="per-tenant SLO attainment, censored-tail-estimated "
+             "p99/p99.9, burn rates and error budgets "
+             "(Local.ObserveSLO); --fleet merges across planes")
+    slp.add_argument("--daemon", action="append", default=None,
+                     metavar="HOST:PORT",
+                     help="daemon(s) to query (repeat with --fleet for "
+                          "a client-side cross-plane merge; default "
+                          "127.0.0.1:51111)")
+    slp.add_argument("--tenant", default="",
+                     help="show only this tenant")
+    slp.add_argument("--fleet", action="store_true",
+                     help="fleet-merged view: exact histogram merge on "
+                          "the shared bucket ladder, stitched with "
+                          "frozen migration-journal slices (one daemon "
+                          "= its supervisor's server-side merge; "
+                          "several = client-side)")
+    slp.add_argument("--json", action="store_true")
+    slp.add_argument("--timeout", type=float, default=30.0)
+    slp.set_defaults(fn=cmd_slo)
 
     tnp = sub.add_parser(
         "tenant",

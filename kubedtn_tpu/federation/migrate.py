@@ -1085,6 +1085,51 @@ class FederationController:
             self._notify_placement(co.tenant, co.dst.name)
         return rec
 
+    # how long a completed migration's frozen src window slice keeps
+    # stitching into the fleet SLO view. The slice exists to make the
+    # view CONTINUOUS across the move; burn rates and budgets are
+    # WINDOWED, so a fixed pre-move slice must age out once the dst's
+    # own ring covers the alerting windows — without a bound, a loss
+    # in the pre-move window would depress the tenant's fleet budget
+    # forever while every live plane reads clean. The default is
+    # sized an order of magnitude above the default slow alerting
+    # window (12 × 1s telemetry windows): wide enough that the view
+    # is continuous while the dst ring fills, narrow enough that a
+    # stale pre-move loss ages out promptly.
+    FROZEN_WINDOW_MAX_AGE_S = 120.0
+
+    def frozen_windows(self, tenant: str = "", src: str = "",
+                       max_age_s: float | None = None) -> list[tuple]:
+        """The SLO plane's migration stitch input: every RECENTLY
+        completed record's RECONCILE-frozen src window slice, as
+        (src_plane, tenant, window_src, qos) tuples (slo.fleet merges
+        them with the live planes' verdicts so a migrated tenant's
+        fleet view is continuous across the move). One pass over the
+        journal metas; records predating the window `hist` field are
+        skipped — the merge cannot stitch what was never frozen — and
+        records older than `max_age_s` (default
+        FROZEN_WINDOW_MAX_AGE_S) have aged out of the windowed view."""
+        horizon = (self.FROZEN_WINDOW_MAX_AGE_S
+                   if max_age_s is None else float(max_age_s))
+        now = time.time()
+        out = []
+        for rec in self.status(tenant=tenant):
+            if rec.get("state") != "done":
+                continue
+            if src and rec.get("src") != src:
+                continue
+            done_s = rec.get("finished_s")
+            if done_s is not None and now - float(done_s) > horizon:
+                continue
+            win = (rec.get("reconcile") or {}).get("window_src")
+            if not win or not win.get("hist"):
+                continue
+            qos = ((rec.get("fork") or {}).get("registry")
+                   or {}).get("qos")
+            out.append((rec.get("src", ""), rec.get("tenant", ""),
+                        win, qos))
+        return out
+
     def status(self, migration_id: str = "",
                tenant: str = "") -> list[dict]:
         with self._lock:

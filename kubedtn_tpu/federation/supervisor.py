@@ -337,7 +337,8 @@ def _counters_summary(counters: dict | None, n_rows: int) -> dict:
     }
 
 
-@guarded_by("_lock", "_watch", "_evacuations", "_evac_complete")
+@guarded_by("_lock", "_watch", "_evacuations", "_evac_complete",
+            "_fleet_slo")
 class FleetSupervisor:
     """Health watcher + placement brain + failover/upgrade driver over
     one FederationController's registered planes. One supervisor per
@@ -360,6 +361,8 @@ class FleetSupervisor:
         self.log = get_logger("fleet")
         self._lock = threading.Lock()
         self._watch: dict[str, _PlaneWatch] = {}
+        # newest sweep's fleet-merged SLO view (fleet_slo())
+        self._fleet_slo: dict = {}
         self._evacuations: list[dict] = []
         # dead planes whose evacuation fully resolved (every tenant
         # restored, or unrecoverable for a PERMANENT reason): the
@@ -508,7 +511,58 @@ class FleetSupervisor:
                 transitions[name] = tr
                 if tr == DEAD:
                     self._try_evacuate(name)
+        # refresh the fleet-merged SLO view (kubedtn_tpu.slo.fleet):
+        # per-plane verdicts + the migration journal's frozen window
+        # slices, merged exactly on the shared bucket ladder — a
+        # tenant migrated or evacuated mid-window keeps a CONTINUOUS
+        # fleet-level attainment/budget series. O(planes·tenants);
+        # failures never kill the sweep (a plane without telemetry or
+        # tenancy simply contributes nothing).
+        try:
+            merged = self.fleet_slo()
+            with self._lock:
+                self._fleet_slo = merged
+        except Exception:
+            self.log.exception("fleet slo merge failed (continuing)")
         return transitions
+
+    # -- fleet SLO view ------------------------------------------------
+
+    def fleet_slo(self, tenant: str = "") -> dict:
+        """The fleet-merged SLO verdicts: {tenant: merged verdict
+        dict}. Live halves come from each non-dead plane's SLO
+        evaluator (lazily attached when the plane has tenancy +
+        telemetry); frozen halves from the migration journal's
+        RECONCILE-frozen src window slices, so pre-move and post-move
+        observation stitch into one continuous view. Served by
+        Local.ObserveSLO(fleet=true) and refreshed every sweep."""
+        from kubedtn_tpu.slo import evaluator_for
+        from kubedtn_tpu.slo.fleet import fleet_slo as _merge
+
+        with self._lock:
+            names = [n for n, w in sorted(self._watch.items())
+                     if w.state != DEAD]
+        payloads: dict[str, list] = {}
+        for name in names:
+            try:
+                handle = self.controller.handle(name)
+            except MigrationError:
+                continue
+            ev = evaluator_for(handle.daemon)
+            if ev is None:
+                continue
+            try:
+                payloads[name] = ev.verdict_payloads(tenant=tenant)
+            except Exception:
+                self.log.exception("slo payload failed %s",
+                                   _fields(plane=name))
+        frozen = self.controller.frozen_windows(tenant=tenant)
+        return _merge(payloads, frozen, tenant=tenant)
+
+    def last_fleet_slo(self) -> dict:
+        """The newest sweep's cached merge (empty before the first)."""
+        with self._lock:
+            return dict(self._fleet_slo)
 
     def _try_evacuate(self, name: str) -> None:
         try:

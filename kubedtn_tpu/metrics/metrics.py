@@ -533,6 +533,97 @@ class TenantStatsCollector:
         return out
 
 
+class SloStatsCollector:
+    """kubedtn_slo_* series from the SLO evaluator (kubedtn_tpu.slo) —
+    the observability plane's scrape face: per-tenant attainment vs
+    target, estimated latency tails (past the bucket ladder via the
+    censored-tail fit; the companion `censored` gauge says when even
+    the fit had to clamp), multi-window burn rates, remaining error
+    budget and severity, plus evaluator volume counters.
+
+    Cardinality guard (the InterfaceStatsCollector truncation-guard
+    pattern): per-tenant series for at most `max_tenants` tenants
+    (name-sorted, stable across scrapes), the tail counted by
+    `kubedtn_slo_series_truncated`. Scrapes read the LATEST verdicts;
+    the evaluator re-evaluates first only when a telemetry window
+    rolled over since (one counter read otherwise)."""
+
+    GAUGE_KEYS = (
+        ("attainment", "Delivery ratio over the slow burn window "
+                       "(-1 = no traffic observed)"),
+        ("target", "The tenant's SLO delivery-ratio floor"),
+        ("p99_us", "Estimated p99 shaping latency (µs; censored-tail "
+                   "fit past the bucket ladder)"),
+        ("p999_us", "Estimated p99.9 shaping latency (µs)"),
+        ("p99_censored", "1 = the p99 is clamped at the ladder's open "
+                         "top bucket (real value >= reported)"),
+        ("fast_burn", "Error-budget burn rate over the fast window"),
+        ("slow_burn", "Error-budget burn rate over the slow window"),
+        ("budget_remaining", "Fraction of the slow-window error "
+                             "budget left (0 = exhausted)"),
+        ("throttle_backlog", "Average frames parked behind the "
+                             "tenant's admission throttle"),
+        ("severity", "Verdict severity (0=ok, 1=warn, 2=page)"),
+    )
+    COUNTER_SNAP = (
+        ("evaluations", "SLO evaluation passes run"),
+        ("windows_evaluated", "Telemetry window rollovers evaluated"),
+        ("pages", "Page-severity verdicts emitted"),
+        ("warns", "Warn-severity verdicts emitted"),
+        ("tail_fits", "Verdicts whose p99.9 came from the "
+                      "censored-tail fit (estimated past the ladder)"),
+        ("censored_clamps", "Verdicts whose p99.9 fell back to the "
+                            "censored clamp (tail fit refused)"),
+    )
+
+    def __init__(self, evaluator, max_tenants: int = 256) -> None:
+        self._ev = evaluator
+        self._max_tenants = max_tenants
+
+    def collect(self):
+        from kubedtn_tpu.slo.spec import SEVERITY_LEVELS
+
+        out = []
+        verdicts = self._ev.verdicts()
+        names = sorted(verdicts)
+        truncated = max(0, len(names) - self._max_tenants)
+        fams = {}
+        for key, doc in self.GAUGE_KEYS:
+            fams[key] = GaugeMetricFamily(f"kubedtn_slo_{key}", doc,
+                                          labels=["tenant"])
+        for name in names[:self._max_tenants]:
+            v = verdicts[name]
+            lab = [name]
+            vals = {
+                "attainment": (-1.0 if v.delivery_ratio is None
+                               else v.delivery_ratio),
+                "target": v.spec.delivery_ratio_floor,
+                "p99_us": -1.0 if v.p99_us is None else v.p99_us,
+                "p999_us": -1.0 if v.p999_us is None else v.p999_us,
+                "p99_censored": 1.0 if v.p99_censored else 0.0,
+                "fast_burn": v.fast_burn,
+                "slow_burn": v.slow_burn,
+                "budget_remaining": v.budget_remaining,
+                "throttle_backlog": v.throttle_backlog,
+                "severity": SEVERITY_LEVELS.get(v.severity, -1),
+            }
+            for key, fam in fams.items():
+                fam.add_metric(lab, float(vals[key]))
+        out.extend(fams.values())
+        snap = self._ev.stats.snapshot()
+        for key, doc in self.COUNTER_SNAP:
+            c = CounterMetricFamily(f"kubedtn_slo_{key}", doc)
+            c.add_metric([], float(snap[key]))
+            out.append(c)
+        trunc = GaugeMetricFamily(
+            "kubedtn_slo_series_truncated",
+            "Tenants beyond the per-tenant SLO series cap "
+            "(0 = full coverage)")
+        trunc.add_metric([], float(truncated))
+        out.append(trunc)
+        return out
+
+
 class WhatIfStatsCollector:
     """kubedtn_whatif_* counters — observability for daemon-served
     what-if sweeps (kubedtn_tpu.twin.query): volume served (sweeps,
@@ -776,7 +867,7 @@ def make_registry(engine=None, sim_counters_fn=None,
                   max_interfaces: int = 10_000, dataplane=None,
                   whatif_stats=None, update_stats=None, tenancy=None,
                   max_tenants: int = 256, migration_stats=None,
-                  fleet=None):
+                  fleet=None, slo=None):
     """Registry with the parity collectors installed."""
     registry = CollectorRegistry()
     hist = LatencyHistograms(registry)
@@ -799,4 +890,7 @@ def make_registry(engine=None, sim_counters_fn=None,
         registry.register(MigrationStatsCollector(migration_stats))
     if fleet is not None:
         registry.register(FleetStatsCollector(fleet))
+    if slo is not None:
+        registry.register(SloStatsCollector(slo,
+                                            max_tenants=max_tenants))
     return registry, hist

@@ -187,26 +187,50 @@ def tel_row_host(sizes, valid, delivered, depart_us) -> np.ndarray:
     return out
 
 
+def quantile_label(q: float) -> str:
+    """Stable dict-key stem for a quantile: 0.5 → "p50", 0.99 → "p99",
+    0.999 → "p99_9". The historical `int(q * 100)` naming is preserved
+    for every quantile it could represent; finer quantiles (the SLO
+    plane's p99.9 / p99.99) get an unambiguous suffix instead of
+    silently colliding with p99."""
+    s = f"{q * 100:.10g}"
+    return "p" + s.replace(".", "_")
+
+
 def percentiles_from_hist(hist_row: np.ndarray,
                           qs=(0.5, 0.9, 0.99)) -> dict:
     """histogram_quantile over the reference bucket ladder: linear
-    interpolation inside a bin, the overflow bin capped at the last
-    edge (Prometheus semantics), None when the histogram is empty. The
+    interpolation inside a bin, None when the histogram is empty. The
     ONE percentile implementation shared by the what-if plane's sweep
-    metrics (twin/engine.py) and the link telemetry query surface."""
+    metrics (twin/engine.py) and the link telemetry query surface.
+
+    CENSORING: the top bucket is OPEN (everything slower than the last
+    edge lands there), so a quantile whose target mass falls inside it
+    is unknowable from the histogram alone — Prometheus semantics CLAMP
+    it to the last edge, which silently UNDERSTATES the tail. The clamp
+    is kept (callers compare against historical series), but every
+    quantile now carries a companion `<p>_censored` flag: True means
+    "the real value is ≥ this, render it `>Xms`, never X". The SLO
+    plane's `slo.tail.estimate_quantile` fits the upper buckets'
+    log-survival slope to estimate PAST the edge when the flag would
+    be set (ARCHITECTURE.md "SLO plane")."""
     edges = np.asarray(BUCKET_EDGES_US)
     total = float(np.asarray(hist_row).sum())
     out = {}
     for q in qs:
-        key = f"p{int(q * 100)}_us"
+        stem = quantile_label(q)
+        key = f"{stem}_us"
+        cens = f"{stem}_censored"
         if total <= 0:
             out[key] = None
+            out[cens] = False
             continue
         target = q * total
         cum = np.cumsum(hist_row)
         b = int(np.searchsorted(cum, target, side="left"))
         if b >= len(edges):
             out[key] = float(edges[-1])
+            out[cens] = True
             continue
         lo = 0.0 if b == 0 else float(edges[b - 1])
         hi = float(edges[b])
@@ -214,6 +238,7 @@ def percentiles_from_hist(hist_row: np.ndarray,
         inbin = float(hist_row[b])
         frac = 0.0 if inbin <= 0 else (target - below) / inbin
         out[key] = round(lo + (hi - lo) * frac, 3)
+        out[cens] = False
     return out
 
 
@@ -443,6 +468,9 @@ class LinkTelemetry:
                                 if delivered else None),
                 "p50_us": pcts["p50_us"],
                 "p99_us": pcts["p99_us"],
+                # censored = the quantile clamped at the open top
+                # bucket's edge (render `>Xms`, never X)
+                "p99_censored": pcts["p99_censored"],
             })
         out.sort(key=lambda r: -r["delivered_pps"])
         return out, seconds, truncated

@@ -789,6 +789,13 @@ class TenantRegistry:
             "queue_depth": float(t[tele.T_QDEPTH]),
             "p50_us": pcts["p50_us"],
             "p99_us": pcts["p99_us"],
+            # censored = clamped at the open top bucket (render >X)
+            "p99_censored": pcts["p99_censored"],
+            # the tenant's window histogram on the shared reference
+            # ladder, as plain floats: JSON-safe (the migration
+            # journal FREEZES this dict at reconcile) and exactly
+            # mergeable across planes (slo.fleet)
+            "hist": [float(x) for x in t[tele.T_HIST0:]],
         }
 
     def stats(self, plane, name: str) -> dict:

@@ -310,6 +310,7 @@ def _serve_whatif_traced(daemon, request):
             p50_us=m["p50_us"] if m["p50_us"] is not None else -1.0,
             p90_us=m["p90_us"] if m["p90_us"] is not None else -1.0,
             p99_us=m["p99_us"] if m["p99_us"] is not None else -1.0,
+            p99_censored=bool(m.get("p99_censored", False)),
             mean_queue_occupancy=m["mean_queue_occupancy"],
             latency_hist=m["latency_hist"],
             rank=ranks[name],

@@ -62,7 +62,10 @@ def render_report(result, title: str = "what-if sweep",
         ("delivery", lambda n, m, r: _fmt(m.get("delivery_ratio"),
                                           "ratio")),
         ("p50", lambda n, m, r: _fmt(m.get("p50_us"), "us")),
-        ("p99", lambda n, m, r: _fmt(m.get("p99_us"), "us")),
+        # a censored p99 clamped at the ladder's open top bucket reads
+        # ">5000ms", never "=5000ms" (telemetry.percentiles_from_hist)
+        ("p99", lambda n, m, r: (">" if m.get("p99_censored") else "")
+            + _fmt(m.get("p99_us"), "us")),
         ("throughput", lambda n, m, r: _fmt(m.get("throughput_bps"),
                                             "bps")),
         ("lost", lambda n, m, r: _fmt(

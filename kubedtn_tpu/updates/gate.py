@@ -51,6 +51,11 @@ class Guardrails:
     max_delivery_drop: float = 0.02   # absolute delivery-ratio drop
     max_p99_factor: float = 1.5       # p99 may grow at most this factor
     min_p99_slack_us: float = 500.0   # ...and by at least this much
+    # ABSOLUTE p99 ceiling (0 = off): the SLO plane's hook — a
+    # tenant's latency objective binds regardless of what the baseline
+    # happened to be (a plan that keeps p99 "only 1.2x" a baseline
+    # already past the bound must still be rejected)
+    max_p99_us: float = 0.0
     ticks: int = 400                  # sweep horizon (virtual ticks)
     dt_us: float = 1000.0
     seed: int = 0
@@ -75,7 +80,39 @@ class Guardrails:
             return (False,
                     f"p99 {p99_us:.0f}us > baseline {base_p99:.0f}us "
                     f"x {self.max_p99_factor}")
+        if (self.max_p99_us > 0.0 and p99_us is not None
+                and p99_us > self.max_p99_us):
+            return (False,
+                    f"p99 {p99_us:.0f}us > SLO bound "
+                    f"{self.max_p99_us:.0f}us")
         return True, ""
+
+    @classmethod
+    def from_slo(cls, slo, **overrides) -> "Guardrails":
+        """Guardrails derived from a tenant's SLO — the autopilot
+        input hook: the plan → gate → stage pipeline verifies a change
+        against what the tenant was PROMISED (slo.spec.SloSpec) or,
+        tighter, against what it has LEFT (slo.spec.SloVerdict: the
+        allowed delivery drop scales with the remaining error budget —
+        a tenant already burning hot gets almost no headroom).
+
+        Mapping: `max_delivery_drop` = the SLO's error budget
+        (1 − floor), scaled by `budget_remaining` for a verdict;
+        `max_p99_us` = the p99 bound, absolute. The relative
+        factor/slack checks keep their defaults (still useful against
+        regressions well under the bound). `overrides` pass through to
+        the constructor (ticks, seed, ...)."""
+        spec = getattr(slo, "spec", slo)   # SloVerdict carries .spec
+        budget = 1.0 - float(spec.delivery_ratio_floor)
+        remaining = getattr(slo, "budget_remaining", None)
+        if remaining is not None:
+            budget *= max(0.0, min(1.0, float(remaining)))
+        kw = {
+            "max_delivery_drop": round(budget, 6),
+            "max_p99_us": float(spec.p99_bound_us or 0.0),
+        }
+        kw.update(overrides)
+        return cls(**kw)
 
 
 @dataclasses.dataclass

@@ -494,8 +494,15 @@ class UpdateStager:
             if agg[tele.T_TX] >= 1.0:
                 base["delivery_ratio"] = (float(agg[tele.T_DELIVERED])
                                           / float(agg[tele.T_TX]))
-                base["p99_us"] = tele.percentiles_from_hist(
-                    agg[tele.T_HIST0:], qs=(0.99,)).get("p99_us")
+                pcts = tele.percentiles_from_hist(
+                    agg[tele.T_HIST0:], qs=(0.99,))
+                base["p99_us"] = pcts.get("p99_us")
+                # censored: the baseline p99 clamped at the open top
+                # bucket — the watch comparison still uses the clamp
+                # (conservative: both sides clamp identically) but the
+                # flag rides the record so a ">5000ms" baseline is
+                # never rendered as "=5000ms"
+                base["p99_censored"] = pcts.get("p99_censored", False)
         elif p.shaped >= 1:
             base["delivery_ratio"] = (p.shaped - p.dropped) / p.shaped
         return base
@@ -545,9 +552,11 @@ class UpdateStager:
             if tx >= 1.0:
                 ratio = delivered / tx
                 snap["delivery_ratio"] = ratio
-                p99 = tele.percentiles_from_hist(
-                    delta[tele.T_HIST0:], qs=(0.99,)).get("p99_us")
+                pcts = tele.percentiles_from_hist(
+                    delta[tele.T_HIST0:], qs=(0.99,))
+                p99 = pcts.get("p99_us")
                 snap["p99_us"] = p99
+                snap["p99_censored"] = pcts.get("p99_censored", False)
                 ok, why = g.check(ratio, p99,
                                   base.get("delivery_ratio"),
                                   base.get("p99_us"))

@@ -182,6 +182,8 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
         _field("mean_queue_occupancy", 13, D),
         _field("latency_hist", 14, D, REP),
         _field("rank", 15, I32),
+        # p99 clamped at the ladder's open top bucket (render `>X`)
+        _field("p99_censored", 16, B),
     ))
     f.message_type.append(_msg(
         "WhatIfResponse",
@@ -215,6 +217,9 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
         _field("mean_lat_us", 13, D),
         _field("p50_us", 14, D),        # -1 = unknown/empty
         _field("p99_us", 15, D),
+        # the p99 is CENSORED: clamped at the bucket ladder's open top
+        # bucket — the real value is >= it (`cli top` renders `>Xms`)
+        _field("p99_censored", 16, B),
     ))
     f.message_type.append(_msg(
         "ObserveLinksResponse",
@@ -223,6 +228,72 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
         _field("covered_seconds", 4, D),
         _field("truncated", 5, I32),
         _field("windows_closed", 6, I64),
+    ))
+    # Framework extension (absent from reference kube_dtn.proto): the
+    # SLO observability plane (kubedtn_tpu.slo) — per-tenant SLO
+    # attainment, censored-tail estimates, burn rates and error
+    # budgets, served from the continuously-evaluated verdicts; with
+    # `fleet=true` the fleet supervisor's cross-plane merge (exact on
+    # the shared bucket ladder, stitched with the migration journal's
+    # frozen window slices). Reference clients never see these types.
+    f.message_type.append(_msg(
+        "ObserveSLORequest",
+        _field("tenant", 1, S),         # empty = every tenant
+        _field("fleet", 2, B),          # serve the supervisor's merge
+    ))
+    f.message_type.append(_msg(
+        "SloTenant",
+        _field("tenant", 1, S), _field("qos", 2, S),
+        # the spec evaluated against
+        _field("delivery_ratio_floor", 3, D),
+        _field("p99_bound_us", 4, D),
+        _field("p999_bound_us", 5, D),
+        # observation (slow-window span, closed windows)
+        _field("window_seconds", 6, D),
+        _field("tx", 7, D), _field("delivered", 8, D),
+        _field("delivery_ratio", 9, D),     # -1 = no traffic
+        _field("p50_us", 10, D),            # -1 = unknown/empty
+        _field("p99_us", 11, D),
+        _field("p99_censored", 12, B),
+        _field("p999_us", 13, D),
+        _field("tail_method", 14, S),   # interp|tail-fit|censored-clamp
+        _field("fast_burn", 15, D),
+        _field("slow_burn", 16, D),
+        _field("budget_remaining", 17, D),
+        _field("throttle_backlog", 18, D),
+        _field("attainment_ok", 19, B),
+        _field("latency_ok", 20, B),
+        _field("severity", 21, S),          # ok|warn|page
+        # the slow-window histogram on the shared reference ladder —
+        # what `kdt slo --fleet` merges EXACTLY across daemons
+        _field("hist", 22, D, REP),
+        # fleet-merge provenance (set on merged rows and on frozen
+        # migration-journal slices a src daemon serves for tenants it
+        # no longer hosts)
+        _field("frozen", 23, B),
+        _field("plane", 24, S),
+        _field("planes", 25, S, REP),
+        _field("frozen_planes", 26, S, REP),
+        _field("frozen_tx", 27, D),
+        _field("frozen_delivered", 28, D),
+        # the spec's burn-alerting half: the client-side `--fleet`
+        # merge re-runs the SAME severity arithmetic the server runs,
+        # so a custom page/warn threshold must ride the wire (a
+        # 3-field spec would silently revert merged severities to the
+        # defaults)
+        _field("fast_windows", 29, I32),
+        _field("slow_windows", 30, I32),
+        _field("warn_burn", 31, D),
+        _field("page_burn", 32, D),
+    ))
+    f.message_type.append(_msg(
+        "ObserveSLOResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("tenants", 3, None, REP, type_name="SloTenant"),
+        _field("windows_closed", 4, I64),
+        _field("evaluations", 5, I64),
+        _field("plane", 6, S),          # the serving plane's name
+        _field("fleet", 7, B),          # true = supervisor-merged view
     ))
     f.message_type.append(_msg(
         "ObserveTraceRequest",
@@ -486,6 +557,7 @@ for _name in ("LinkProperties", "Link", "Pod", "PodQuery",
               "WhatIfPerturbation", "WhatIfScenario", "WhatIfRequest",
               "WhatIfMetrics", "WhatIfResponse",
               "ObserveLinksRequest", "LinkStats", "ObserveLinksResponse",
+              "ObserveSLORequest", "SloTenant", "ObserveSLOResponse",
               "ObserveTraceRequest", "TraceEvent",
               "ObserveTraceResponse",
               "PlanUpdateRequest", "PlanRound", "PlanUpdateResponse",
@@ -526,6 +598,9 @@ WhatIfResponse = _MESSAGES["WhatIfResponse"]
 ObserveLinksRequest = _MESSAGES["ObserveLinksRequest"]
 LinkStats = _MESSAGES["LinkStats"]
 ObserveLinksResponse = _MESSAGES["ObserveLinksResponse"]
+ObserveSLORequest = _MESSAGES["ObserveSLORequest"]
+SloTenant = _MESSAGES["SloTenant"]
+ObserveSLOResponse = _MESSAGES["ObserveSLOResponse"]
 ObserveTraceRequest = _MESSAGES["ObserveTraceRequest"]
 TraceEvent = _MESSAGES["TraceEvent"]
 ObserveTraceResponse = _MESSAGES["ObserveTraceResponse"]
@@ -577,6 +652,11 @@ LOCAL_METHODS = {
     # cli trace read these — not in the reference IDL)
     "ObserveLinks": (ObserveLinksRequest, ObserveLinksResponse, False),
     "ObserveTrace": (ObserveTraceRequest, ObserveTraceResponse, False),
+    # Framework extension: the SLO observability plane — per-tenant
+    # attainment / burn rates / estimated tails, and the fleet-merged
+    # view (kubedtn_tpu.slo; `kdt slo` reads this — not in the
+    # reference IDL)
+    "ObserveSLO": (ObserveSLORequest, ObserveSLOResponse, False),
     # Framework extensions: the planned-update change gate — verified
     # multi-round topology updates staged through the live plane with
     # rollback (kubedtn_tpu.updates; not in the reference IDL)

@@ -465,6 +465,10 @@ class Daemon:
         # closing the cross-node half of a sampled trace. None = zero
         # cost on every ingestion path.
         self.recorder = None
+        # slo.SloEvaluator installed by its attach(): the
+        # Local.ObserveSLO surface (absent = the RPC answers ok=False
+        # "slo evaluation not enabled")
+        self.slo = None
         try:
             from kubedtn_tpu import native as _native
             # counts-only form: no per-frame Python on the drain path
@@ -612,7 +616,122 @@ class Daemon:
                 corrupted=r["corrupted"], queue_depth=r["queue_depth"],
                 mean_lat_us=nn(r["mean_lat_us"]),
                 p50_us=nn(r["p50_us"]), p99_us=nn(r["p99_us"]),
+                p99_censored=bool(r.get("p99_censored", False)),
             ) for r in rows[:top]])
+
+    @staticmethod
+    def _slo_tenant_msg(v: dict, plane: str = "") -> "pb.SloTenant":
+        """One verdict dict (SloVerdict.to_dict / a fleet-merged row /
+        a frozen journal slice) as the wire row."""
+        nn = lambda x: -1.0 if x is None else float(x)  # noqa: E731
+        spec = v.get("spec") or {}
+        return pb.SloTenant(
+            tenant=v.get("tenant", ""), qos=v.get("qos") or "",
+            delivery_ratio_floor=float(
+                spec.get("delivery_ratio_floor", 0.0)),
+            p99_bound_us=float(spec.get("p99_bound_us", 0.0)),
+            p999_bound_us=float(spec.get("p999_bound_us", 0.0)),
+            fast_windows=int(spec.get("fast_windows", 0)),
+            slow_windows=int(spec.get("slow_windows", 0)),
+            warn_burn=float(spec.get("warn_burn", 0.0)),
+            page_burn=float(spec.get("page_burn", 0.0)),
+            window_seconds=float(v.get("window_seconds", 0.0)),
+            tx=float(v.get("tx", 0.0)),
+            delivered=float(v.get("delivered", 0.0)),
+            delivery_ratio=nn(v.get("delivery_ratio")),
+            p50_us=nn(v.get("p50_us")), p99_us=nn(v.get("p99_us")),
+            p99_censored=bool(v.get("p99_censored", False)),
+            p999_us=nn(v.get("p999_us")),
+            tail_method=v.get("tail_method", ""),
+            fast_burn=float(v.get("fast_burn", 0.0)),
+            slow_burn=float(v.get("slow_burn", 0.0)),
+            budget_remaining=float(v.get("budget_remaining", 0.0)),
+            throttle_backlog=float(v.get("throttle_backlog", 0.0)),
+            attainment_ok=bool(v.get("attainment_ok", False)),
+            latency_ok=bool(v.get("latency_ok", False)),
+            severity=v.get("severity", ""),
+            hist=[float(x) for x in v.get("hist") or ()],
+            frozen=bool(v.get("frozen", False)),
+            plane=v.get("plane", plane),
+            planes=list(v.get("planes") or ()),
+            frozen_planes=list(v.get("frozen_planes") or ()),
+            frozen_tx=float(v.get("frozen_tx", 0.0)),
+            frozen_delivered=float(v.get("frozen_delivered", 0.0)))
+
+    def ObserveSLO(self, request, context):
+        """Framework extension: the SLO observability plane
+        (kubedtn_tpu.slo) — per-tenant attainment, censored-tail
+        estimates, burn rates and error budgets from the continuously-
+        evaluated verdicts. With `fleet=true` and a fleet supervisor
+        attached, serves the supervisor's cross-plane merge instead.
+
+        A plane that MIGRATED a tenant away also answers with the
+        journal's RECONCILE-frozen window slice for it (`frozen=true`
+        rows): `kdt slo --fleet` merging several daemons' answers
+        stitches pre-move and post-move windows into one continuous
+        view without any daemon seeing the other's ring."""
+        ev = self.slo
+        if ev is None:
+            from kubedtn_tpu.slo import evaluator_for
+
+            ev = evaluator_for(self)
+        if ev is None:
+            return pb.ObserveSLOResponse(
+                ok=False, error="slo evaluation not enabled on this "
+                                "daemon (needs tenancy + telemetry)")
+        plane_name = ""
+        if self.federation is not None:
+            try:
+                plane_name = self.federation.plane_name_of(self)
+            except Exception:
+                plane_name = ""
+        try:
+            if request.fleet and self.fleet is not None:
+                # serve the supervision sweep's cached merge (refreshed
+                # every sweep — that's what the sweep computes it FOR);
+                # recompute only before the first sweep lands
+                merged = self.fleet.last_fleet_slo()
+                if merged:
+                    if request.tenant:
+                        merged = {k: v for k, v in merged.items()
+                                  if k == request.tenant}
+                else:
+                    merged = self.fleet.fleet_slo(
+                        tenant=request.tenant)
+                snap = ev.stats.snapshot()
+                tel = getattr(self.dataplane, "telemetry", None)
+                return pb.ObserveSLOResponse(
+                    ok=True, fleet=True, plane=plane_name,
+                    evaluations=snap["evaluations"],
+                    windows_closed=tel.windows_closed if tel else 0,
+                    tenants=[self._slo_tenant_msg(v)
+                             for _t, v in sorted(merged.items())])
+            payloads = ev.verdict_payloads(tenant=request.tenant)
+            rows = [self._slo_tenant_msg(v, plane=plane_name)
+                    for v in payloads]
+            if self.federation is not None and plane_name:
+                # frozen slices for tenants this plane migrated away
+                served = {p["tenant"] for p in payloads}
+                for src, ten, win, qos in self.federation \
+                        .frozen_windows(tenant=request.tenant,
+                                        src=plane_name):
+                    if ten in served:
+                        continue
+                    from kubedtn_tpu.slo.fleet import from_frozen_window
+
+                    c = from_frozen_window(src, win, qos=qos)
+                    if c is not None:
+                        c["tenant"] = ten
+                        rows.append(self._slo_tenant_msg(c, plane=src))
+        except Exception as e:  # a query must never kill the daemon
+            return pb.ObserveSLOResponse(
+                ok=False, error=f"{type(e).__name__}: {e}")
+        tel = getattr(self.dataplane, "telemetry", None)
+        snap = ev.stats.snapshot()
+        return pb.ObserveSLOResponse(
+            ok=True, plane=plane_name, tenants=rows,
+            windows_closed=tel.windows_closed if tel else 0,
+            evaluations=snap["evaluations"])
 
     def ObserveTrace(self, request, context):
         """Framework extension: flight-recorder event export — one
