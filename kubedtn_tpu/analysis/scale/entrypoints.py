@@ -115,6 +115,18 @@ SCALE_ENTRIES: dict[str, dict] = {
         "budget": CLASS_ROWS,
         "roots": ((_SRV, "Daemon.drain_ingress"),),
     },
+    # shm ring drain: one native batch-dequeue + one columnar regroup
+    # per attached ring — host work scales with the frames dequeued
+    # THIS drain (and the per-drain wire set), never with ring
+    # capacity or plane size; the admission check at the ring head is
+    # O(1) per ring against the tick's policy snapshot
+    "shm_drain": {
+        "budget": CLASS_ROWS,
+        "roots": (
+            ("kubedtn_tpu/shm/ingest.py", "ShmIngest.drain_into"),
+            ("kubedtn_tpu/shm/ingest.py", "ShmIngest._emit"),
+        ),
+    },
     # admission: one registry snapshot per tick, O(1) per wire
     "drain_policy": {
         "budget": CLASS_TENANTS,

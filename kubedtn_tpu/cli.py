@@ -1021,6 +1021,18 @@ def cmd_daemon(args) -> int:
             n_devices=None if shard < 0 else shard)
         log.info("sharded live plane %s", fields(
             mesh_devices=int(mesh.devices.size)))
+    shm_ingest = None
+    shm_dir = getattr(args, "shm_dir", None)
+    if shm_dir:
+        # shared-memory ingest plane: producer rings in this directory
+        # feed drain_ingress directly (admission at the ring head);
+        # gRPC stays up as the compatibility fallback + control surface
+        from kubedtn_tpu.shm import ShmIngest
+
+        os.makedirs(shm_dir, exist_ok=True)
+        shm_ingest = ShmIngest(shm_dir)
+        dataplane.attach_shm(shm_ingest)
+        log.info("shm ingest on %s", fields(dir=shm_dir))
     trace_out = getattr(args, "trace_out", None)
     jax_profile = getattr(args, "jax_profile", None)
     if jax_profile:
@@ -1078,7 +1090,8 @@ def cmd_daemon(args) -> int:
                                    update_stats=update_stats_for(daemon),
                                    tenancy=tenancy,
                                    migration_stats=migration_stats,
-                                   fleet=fleet, slo=slo_eval)
+                                   fleet=fleet, slo=slo_eval,
+                                   shm=shm_ingest)
     engine.stats.observer = hist
     daemon.hist = hist
     server, port = make_server(daemon, port=args.port)
@@ -1129,6 +1142,8 @@ def cmd_daemon(args) -> int:
             autosaver.stop()
         server.stop(0)
         dataplane.stop()
+        if shm_ingest is not None:
+            shm_ingest.close()
         if ckpt_dir:
             try:
                 checkpoint.save(ckpt_dir, store, engine,
@@ -1773,6 +1788,13 @@ def main(argv=None) -> int:
     dp.add_argument("--jax-profile", default=None, metavar="DIR",
                     help="opt-in jax.profiler device capture for the "
                          "daemon's lifetime (TensorBoard-loadable)")
+    dp.add_argument("--shm-dir", default=None, metavar="DIR",
+                    help="serve the shared-memory ingest plane from "
+                         "this directory: every producer ring "
+                         "(*.ring, see kubedtn_tpu.shm.ShmSender) in "
+                         "it feeds the data plane directly — "
+                         "admission enforced at the ring head, gRPC "
+                         "kept as the compatibility fallback")
     dp.add_argument("--migration-journal", default=None, metavar="DIR",
                     help="journal root for live tenant migrations "
                          "(default: <checkpoint-dir>-migrations — a "

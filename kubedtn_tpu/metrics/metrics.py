@@ -808,6 +808,67 @@ class FleetStatsCollector:
         return out
 
 
+class ShmStatsCollector:
+    """kubedtn_shm_* series — the shared-memory ingest plane
+    (kubedtn_tpu.shm): attached/retired ring segments, dequeue volume
+    (frames/bytes/native calls/plane batches), crash-skip accounting
+    (uncommitted reservations crossed after a producer death), the
+    admission face (throttle events at the ring head + frames left
+    parked in-ring by the last drain), producer-side ring-full events
+    summed across segments, and resolution failures (unknown wire ids,
+    frames parked for unrealized links). One stats() snapshot per
+    scrape — a handful of atomics and one lock hold, no ring walks."""
+
+    COUNTERS = (
+        ("frames_total", "frames_in", "Frames dequeued from shm rings "
+                                      "into the data plane"),
+        ("bytes_total", "bytes_in", "Payload bytes dequeued from shm "
+                                    "rings"),
+        ("dequeues_total", "dequeues", "Native batch-dequeue calls"),
+        ("batches_total", "batches", "Plane batches emitted from ring "
+                                     "spans"),
+        ("skipped_uncommitted_total", "skipped_uncommitted",
+         "Uncommitted reservations skipped after a producer death "
+         "(torn frames never surface; committed frames never lost)"),
+        ("throttled_events_total", "throttled_events",
+         "Drains that left a ring parked by per-tenant admission at "
+         "the ring head"),
+        ("unresolved_frames_total", "unresolved_frames",
+         "Ring frames whose wire id resolved to no registered wire"),
+        ("parked_unrealized_total", "parked_unrealized",
+         "Ring frames parked on wire ingress awaiting link "
+         "realization"),
+        ("rings_retired_total", "rings_retired",
+         "Dead producers' rings detached after fully draining"),
+        ("producer_full_failures_total", "full_failures",
+         "Producer-side pushes rejected ring-full (queued in the "
+         "sender's outage buffer, never dropped)"),
+    )
+    GAUGES = (
+        ("rings", "rings", "Ring segments currently attached"),
+        ("pending_frames", "pending",
+         "Entries reserved and unconsumed across attached rings"),
+        ("throttled_parked_frames", "throttled_frames_last",
+         "Frames left parked in-ring by admission on the last drain"),
+    )
+
+    def __init__(self, shm) -> None:
+        self._shm = shm
+
+    def collect(self):
+        snap = self._shm.stats()
+        out = []
+        for name, key, doc in self.COUNTERS:
+            c = CounterMetricFamily(f"kubedtn_shm_{name}", doc)
+            c.add_metric([], float(snap[key]))
+            out.append(c)
+        for name, key, doc in self.GAUGES:
+            g = GaugeMetricFamily(f"kubedtn_shm_{name}", doc)
+            g.add_metric([], float(snap[key]))
+            out.append(g)
+        return out
+
+
 class MetricsServer:
     """Serves the registry on an HTTP port — the daemon's :51112/metrics
     endpoint (reference daemon/main.go:57-66)."""
@@ -867,7 +928,7 @@ def make_registry(engine=None, sim_counters_fn=None,
                   max_interfaces: int = 10_000, dataplane=None,
                   whatif_stats=None, update_stats=None, tenancy=None,
                   max_tenants: int = 256, migration_stats=None,
-                  fleet=None, slo=None):
+                  fleet=None, slo=None, shm=None):
     """Registry with the parity collectors installed."""
     registry = CollectorRegistry()
     hist = LatencyHistograms(registry)
@@ -893,4 +954,6 @@ def make_registry(engine=None, sim_counters_fn=None,
     if slo is not None:
         registry.register(SloStatsCollector(slo,
                                             max_tenants=max_tenants))
+    if shm is not None:
+        registry.register(ShmStatsCollector(shm))
     return registry, hist

@@ -100,6 +100,27 @@ def _declare(lib) -> None:
         "kdt_rb_pop": (c.c_int64, [c.c_void_p, u8p, c.c_uint64]),
         "kdt_rb_count": (c.c_uint64, [c.c_void_p]),
         "kdt_rb_dropped": (c.c_uint64, [c.c_void_p]),
+        "kdt_shm_required": (c.c_int64, [c.c_uint64, c.c_uint32]),
+        "kdt_shm_init": (c.c_int32, [u8p, c.c_uint64, c.c_uint64,
+                                     c.c_uint32, c.c_uint64, c.c_char_p]),
+        "kdt_shm_check": (c.c_int32, [u8p, c.c_uint64]),
+        "kdt_shm_slots": (c.c_uint64, [u8p]),
+        "kdt_shm_slot_size": (c.c_uint32, [u8p]),
+        "kdt_shm_pid": (c.c_uint64, [u8p]),
+        "kdt_shm_set_pid": (None, [u8p, c.c_uint64]),
+        "kdt_shm_ns": (c.c_int32, [u8p, c.c_char_p, c.c_int32]),
+        "kdt_shm_pending": (c.c_uint64, [u8p]),
+        "kdt_shm_full_failures": (c.c_uint64, [u8p]),
+        "kdt_shm_committed": (c.c_uint64, [u8p]),
+        "kdt_shm_push": (c.c_int32, [u8p, u8p, c.c_uint32, c.c_uint32,
+                                     c.c_uint64]),
+        "kdt_shm_push_batch": (c.c_int64, [u8p, u8p, u64p, u64p,
+                                           c.POINTER(c.c_uint32), u64p,
+                                           c.c_int64]),
+        "kdt_shm_push_torn": (c.c_int32, [u8p, c.c_uint32]),
+        "kdt_shm_dequeue": (c.c_int64, [u8p, u8p, c.c_uint64,
+                                        c.POINTER(c.c_uint32), u64p, u64p,
+                                        u64p, c.c_int64, c.c_int32, u64p]),
         "kdt_tw_new": (c.c_void_p, [c.c_uint64, c.c_uint32, c.c_uint32]),
         "kdt_tw_free": (None, [c.c_void_p]),
         "kdt_tw_schedule": (None, [c.c_void_p, c.c_uint64, c.c_uint64]),

@@ -2085,6 +2085,17 @@ class WireDataPlane:
         registry.plane = self
         self.daemon.tenancy = registry
 
+    def attach_shm(self, ingest, watcher: bool = True) -> None:
+        """Wire a shm.ShmIngest into this plane: every drain folds the
+        attached rings' committed frames in (admission at the ring
+        head), and the watcher thread wakes the runner on ring traffic
+        exactly like mark_hot does for gRPC ingress. Pass
+        watcher=False under an explicit clock (tests drive ticks
+        themselves)."""
+        self.daemon.shm = ingest
+        if watcher:
+            ingest.start_watcher(self.daemon)
+
     def force_degrade(self, level: int) -> None:
         """Step the degradation ladder to `level` (0 = full pipeline,
         1 = depth-1, 2 = synchronous un-fused). Crosses the flush()
@@ -2415,6 +2426,23 @@ class WireDataPlane:
                     continue
                 sm = rec.sample_batch(row, len(lens))
                 samp_adv.append((row, len(lens), len(sm)))
+                # carried ids (shm ingest: a producer's sampled trace
+                # rode the slot layout here) join the batch's samples
+                # with the SAME id, so the trace runs producer →
+                # received → ingress → delivery unbroken. They are not
+                # counter-derived, so samp_adv (the requeue rollback)
+                # excludes them.
+                base = 0
+                for p in _fr:
+                    if type(p) is FrameSeg:
+                        tr = p.traces
+                        if tr:
+                            sm.extend((base + (k - p.lo), tid)
+                                      for k, tid in tr
+                                      if p.lo <= k < p.hi)
+                        base += len(p)
+                    else:
+                        base += 1
                 for _off, tid in sm:
                     rec.record(tid, tele.ST_INGRESS, row=row,
                                wire=w.wire_id, batch=len(lens))

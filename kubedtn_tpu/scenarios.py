@@ -17,6 +17,7 @@ update path measured standalone.
 
 from __future__ import annotations
 
+import os
 import time
 
 import jax
@@ -2139,7 +2140,8 @@ def noisy_neighbor(victim_pairs: int = 2, aggressor_pairs: int = 2,
                    victim_rate_fps: int = 2_000,
                    aggressor_rate_fps: int = 20_000,
                    aggressor_budget_fps: int = 2_000,
-                   latency: str = "2ms"):
+                   latency: str = "2ms",
+                   aggressor_via_shm: bool = False):
     """Noisy-neighbor CHAOS scenario: a gold victim and a bronze
     aggressor share one plane; the aggressor offers ~10× its admission
     frame budget while the victim offers a modest steady load. The
@@ -2149,7 +2151,13 @@ def noisy_neighbor(victim_pairs: int = 2, aggressor_pairs: int = 2,
     latency inside guardrails. Deterministic: explicit-clock ticks +
     clock-driven buckets, so a given parameterization replays exactly.
     The tier-1 smoke (tests/test_tenancy.py) runs this with small
-    parameters in <30s; the full LADDER entry is the bench shape."""
+    parameters in <30s; the full LADDER entry is the bench shape.
+
+    `aggressor_via_shm` swaps the aggressor's transport for a
+    shared-memory ingest ring: admission is then evaluated at the RING
+    HEAD, so the over-budget backlog parks in the ring segment (plus
+    the sender's outage buffer) instead of the wire deques — the same
+    throttled-never-dropped contract, enforced one layer earlier."""
     t_wall = time.perf_counter()
     cfg = {
         "victim": {"pairs": victim_pairs, "qos": "gold"},
@@ -2160,6 +2168,23 @@ def noisy_neighbor(victim_pairs: int = 2, aggressor_pairs: int = 2,
         cfg, latency, dt_us, "nn")
     vin, vout = wires["victim"]
     ain, aout = wires["aggressor"]
+    shm_dir = sender = ingest = None
+    if aggressor_via_shm:
+        import tempfile
+
+        from kubedtn_tpu.shm import ShmIngest, ShmRing, ShmSender
+
+        shm_dir = tempfile.mkdtemp(prefix="kdt-nn-shm-")
+        # the outage buffer must hold the whole unadmitted backlog:
+        # feeding and ticking share this thread, so a blocking send
+        # could never be drained by the consumer it is waiting on
+        sender = ShmSender(os.path.join(shm_dir, "aggressor.ring"),
+                           namespace="aggressor",
+                           max_buffered=int(aggressor_rate_fps
+                                            * seconds) + 4096)
+        ingest = ShmIngest(shm_dir)
+        ingest.attach_ring(ShmRing.attach(sender.ring.path))
+        plane.attach_shm(ingest, watcher=False)
     dt = dt_us / 1e6
     t = 100.0
     fed = {"victim": 0, "aggressor": 0}
@@ -2175,7 +2200,10 @@ def noisy_neighbor(victim_pairs: int = 2, aggressor_pairs: int = 2,
             if n:
                 acc[ns] -= n
                 for w in win:
-                    w.ingress.extend([frame] * n)
+                    if ns == "aggressor" and sender is not None:
+                        sender.send(w.wire_id, [frame] * n)
+                    else:
+                        w.ingress.extend([frame] * n)
                 fed[ns] += n * len(win)
         t += dt
         plane.tick(now_s=t)
@@ -2195,6 +2223,10 @@ def noisy_neighbor(victim_pairs: int = 2, aggressor_pairs: int = 2,
     a_stats = registry.stats(plane, "aggressor")
     v_stats = registry.stats(plane, "victim")
     queued = sum(len(w.ingress) for w in ain)
+    if sender is not None:
+        # shm transport: the unadmitted backlog parks in the ring
+        # segment + the sender's outage buffer, not the wire deques
+        queued += ingest.pending_total() + sender.buffered()
     # budget guardrail: admitted ≤ burst (1s worth) + rate × seconds,
     # with one batch of slack (admission is batch-granular)
     budget_cap = (aggressor_budget_fps * (seconds + 1.0)
@@ -2219,6 +2251,8 @@ def noisy_neighbor(victim_pairs: int = 2, aggressor_pairs: int = 2,
         "aggressor_budget_fps": aggressor_budget_fps,
         "aggressor_budget_cap": int(budget_cap),
         "aggressor_queued_not_dropped": int(queued),
+        "aggressor_transport": "shm" if sender is not None
+        else "ingress",
         "throttle_events": int(a_stats["throttle_events"]),
         "victim_throttle_events": int(v_stats["throttle_events"]),
         "dropped": plane.dropped,
@@ -2270,7 +2304,366 @@ def noisy_neighbor(victim_pairs: int = 2, aggressor_pairs: int = 2,
                                 and out["victim_unharmed"]
                                 and out.get("victim_slo_met", True)
                                 and out.get("aggressor_burning", True))
+    if sender is not None:
+        st = ingest.stats()
+        out["shm"] = {
+            "ring_pending": ingest.pending_total(),
+            "sender_buffered": sender.buffered(),
+            "ring_full_failures": st["full_failures"],
+            "throttled_events": st["throttled_events"],
+            "frames_in": st["frames_in"],
+        }
+        sender.close()
+        ingest.close()
+        import shutil
+
+        shutil.rmtree(shm_dir, ignore_errors=True)
     plane.stop()
+    return out
+
+
+def shm_producer_crash(frames: int = 4_000, kill_after: int = 1_500,
+                       frame_size: int = 128, dt_us: float = 2_000.0,
+                       latency: str = "2ms", sample_period: int = 16,
+                       torn_tail: int = 3,
+                       drain_timeout_s: float = 30.0):
+    """Producer-crash CHAOS scenario for the shared-memory ingest
+    plane: a REAL producer subprocess (`python -m
+    kubedtn_tpu.shm.producer`) streams deterministic indexed frames
+    into its ring while the daemon drains; once its progress reports
+    cross `kill_after`, it is SIGKILLed mid-burst. The contract under
+    attack — the seqlock commit protocol's crash-safety half:
+
+    - ZERO committed-frame loss: the delivered indices form an exact
+      contiguous prefix 0..K-1 (commits are sequential, so the
+      committed set IS a prefix) with K >= the last progress report —
+      everything the producer published before dying arrives, exactly
+      once, in order;
+    - uncommitted reservations are NEVER surfaced as frames: the torn
+      tail (a deterministic reserve-without-commit image stamped onto
+      the dead ring, plus whatever the SIGKILL itself tore) is skipped
+      and counted only AFTER the producer pid provably died;
+    - the drained ring of a dead producer is RETIRED;
+    - a producer-minted sampled trace id spans the ring:
+      received -> ingress -> delivered under the SAME id.
+
+    The <30s tier-1 smoke (tests/test_chaos_smoke.py) runs this small;
+    the LADDER/bench entry uses the defaults. The kill lands on the
+    wall clock (real chaos), but every acceptance check is exact —
+    none depends on WHERE the kill lands."""
+    import shutil
+    import struct
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+
+    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+    from kubedtn_tpu.runtime import WireDataPlane
+    from kubedtn_tpu.shm import ShmIngest, ShmRing
+    from kubedtn_tpu import telemetry as tele
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    t_wall = time.perf_counter()
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    props = LinkProperties(latency=latency)
+    store.create(Topology(name="shm-a", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="shm-b",
+             uid=1, properties=props)])))
+    store.create(Topology(name="shm-b", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="shm-a",
+             uid=1, properties=props)])))
+    engine.setup_pod("shm-a")
+    engine.setup_pod("shm-b")
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    win = daemon._add_wire(pb.WireDef(
+        local_pod_name="shm-a", kube_ns="default", link_uid=1,
+        intf_name_in_pod="eth1"))
+    wout = daemon._add_wire(pb.WireDef(
+        local_pod_name="shm-b", kube_ns="default", link_uid=1,
+        intf_name_in_pod="eth1"))
+    plane = WireDataPlane(daemon, dt_us=dt_us)
+    plane.pipeline_explicit_clock = True
+    plane.enable_telemetry(window_s=0.5, sample_period=256)
+    shm_dir = tempfile.mkdtemp(prefix="kdt-shm-crash-")
+    ingest = ShmIngest(shm_dir, scan_interval_s=0.02)
+    plane.attach_shm(ingest, watcher=False)
+    ring_path = os.path.join(shm_dir, "crash.ring")
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubedtn_tpu.shm.producer", ring_path,
+         str(win.wire_id), str(frames), "--frame-size",
+         str(frame_size), "--batch", "64", "--pace-s", "0.002",
+         "--sample-period", str(sample_period), "--hold-s", "60"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    reported = [0]  # last `pushed=N` progress line seen
+
+    def read_progress():
+        for line in proc.stdout:
+            line = line.strip()
+            if line.startswith(("pushed=", "done pushed=")):
+                reported[0] = int(line.rsplit("=", 1)[1])
+
+    rd = threading.Thread(target=read_progress, daemon=True)
+    rd.start()
+
+    dt = dt_us / 1e6
+    t = 100.0
+    killed_at_report = -1
+    deadline = time.monotonic() + drain_timeout_s
+    while time.monotonic() < deadline:
+        t += dt
+        plane.tick(now_s=t)
+        if killed_at_report < 0 and reported[0] >= kill_after:
+            killed_at_report = reported[0]
+            proc.kill()
+            proc.wait()  # reaped: producer_dead() now has its proof
+            rd.join(timeout=5.0)
+        if killed_at_report >= 0 and torn_tail > 0:
+            # stamp a deterministic crash-frozen image (reserved,
+            # never committed) onto the DEAD ring, so the gap-skip
+            # path runs on every seed — on top of whatever the
+            # SIGKILL itself tore mid-batch. The tail word lives in
+            # the shared segment, so a scratch mapping can write it.
+            tr = ShmRing.attach(ring_path)
+            tr.push_torn(torn_tail)
+            tr.close()
+            torn_tail = 0  # once
+        if killed_at_report >= 0:
+            st = ingest.stats()
+            if st["pending"] == 0 and st["rings"] == 0:
+                break
+        time.sleep(0.001)
+    plane.flush()
+    plane.tick(now_s=t + 10.0)
+    if proc.poll() is None:  # kill_after never reached: clean up
+        proc.kill()
+        proc.wait()
+
+    delivered = list(wout.egress)
+    idx = sorted(struct.unpack("<Q", f[:8])[0] for f in delivered)
+    prefix_ok = idx == list(range(len(idx)))
+    stats = ingest.stats()
+    # trace audit: every `received` event on this plane came through
+    # the ring (no gRPC feeder here); at least one producer-minted id
+    # must span received -> ingress -> delivered
+    stages_by_tid: dict = {}
+    for e in list(plane.recorder.events):
+        stages_by_tid.setdefault(e[0], set()).add(e[3])
+    ring_tids = [tid for tid, st in stages_by_tid.items()
+                 if tele.ST_RECEIVED in st]
+    spanned = [tid for tid in ring_tids
+               if {tele.ST_INGRESS, tele.ST_DELIVERED}
+               <= stages_by_tid[tid]]
+    out = {
+        "scenario": "shm_producer_crash",
+        "frames_target": frames,
+        "reported_at_kill": killed_at_report,
+        "delivered": len(delivered),
+        "delivered_prefix_ok": prefix_ok,
+        "committed_lost": max(0, killed_at_report - len(delivered)),
+        "torn_skipped": int(stats["skipped_uncommitted"]),
+        "ring_pending_final": int(stats["pending"]),
+        "rings_retired": int(stats["rings_retired"]),
+        "ring_traces_seen": len(ring_tids),
+        "ring_traces_spanning": len(spanned),
+        "trace_ok": len(spanned) > 0,
+        "dropped": plane.dropped,
+        "tick_errors": plane.tick_errors,
+        "wall_s": round(time.perf_counter() - t_wall, 3),
+    }
+    out["in_guardrails"] = bool(
+        killed_at_report >= 0
+        and prefix_ok
+        and out["committed_lost"] == 0
+        and len(delivered) >= killed_at_report
+        and out["torn_skipped"] > 0
+        and out["ring_pending_final"] == 0
+        and out["rings_retired"] == 1
+        and out["trace_ok"]
+        and out["tick_errors"] == 0
+        and out["dropped"] == 0)
+    ingest.close()
+    plane.stop()
+    shutil.rmtree(shm_dir, ignore_errors=True)
+    return out
+
+
+def shm_soak(frames: int = 200_000, frame_size: int = 200,
+             slots: int = 16_384, slot_size: int = 2_048,
+             batch: int = 1_024, grpc_unary_n: int = 2_000,
+             grpc_stream_n: int = 20_000, grpc_bulk_n: int = 50_000,
+             timeout_s: float = 300.0):
+    """Shared-memory ingest TRANSPORT soak: a REAL producer subprocess
+    (`python -m kubedtn_tpu.shm.producer`) streams `frames` indexed
+    frames through its ring while this process drains them via
+    `Daemon.drain_ingress` — the measured number is the daemon-side
+    ingestion rate (one native dequeue + one columnar regroup per
+    drain), with an exact zero-loss audit on the embedded indices.
+
+    For the honest comparison the gRPC ladder (unary SendToOnce /
+    client-streaming SendToStream / coalesced SendToBulk — the
+    compat-fallback transports) is RE-MEASURED in this same session
+    over a real loopback server, so both sides see the same host, the
+    same interpreter state, and the same moment of machine load;
+    speedups quote that re-run, never a number recorded on another
+    day. Caveats recorded with the result: this is a transport
+    microbench (no shaping — the plane-only soak's sustained rate is
+    the end-to-end ceiling, see live_plane_soak/BENCH), single
+    producer, and the producer side is ITSELF Python building frames —
+    the ring's native push/dequeue pair probes far above what one
+    Python producer can feed, so the recorded rate is a floor."""
+    import shutil
+    import struct
+    import subprocess
+    import sys
+    import tempfile
+
+    from kubedtn_tpu.api.types import Link, Topology, TopologySpec
+    from kubedtn_tpu.shm import ShmIngest
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+    from kubedtn_tpu.wire.server import Daemon, make_server
+
+    t_wall = time.perf_counter()
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=16)
+    props = LinkProperties(latency="1ms")
+    store.create(Topology(name="soak-a", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="soak-b",
+             uid=1, properties=props)])))
+    store.create(Topology(name="soak-b", spec=TopologySpec(links=[
+        Link(local_intf="eth1", peer_intf="eth1", peer_pod="soak-a",
+             uid=1, properties=props)])))
+    engine.setup_pod("soak-a")
+    engine.setup_pod("soak-b")
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    wire = daemon._add_wire(pb.WireDef(
+        local_pod_name="soak-a", kube_ns="default", link_uid=1,
+        intf_name_in_pod="eth1"))
+
+    shm_dir = tempfile.mkdtemp(prefix="kdt-shm-soak-")
+    ingest = ShmIngest(shm_dir, scan_interval_s=0.01)
+    daemon.shm = ingest
+    ring_path = os.path.join(shm_dir, "soak.ring")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubedtn_tpu.shm.producer", ring_path,
+         str(wire.wire_id), str(frames),
+         "--frame-size", str(frame_size), "--batch", str(batch),
+         "--slots", str(slots), "--slot-size", str(slot_size)],
+        env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE, text=True)
+
+    batches: list = []
+    total = 0
+    t_first = None
+    deadline = time.monotonic() + timeout_s
+    while total < frames and time.monotonic() < deadline:
+        out = daemon.drain_ingress(max_per_wire=16_384)
+        n = sum(len(lens) for _w, _r, lens, _p in out)
+        if n:
+            if t_first is None:
+                t_first = time.perf_counter()
+            total += n
+            batches.extend(out)
+        t_last = time.perf_counter()
+    proc.wait(timeout=60.0)
+    shm_s = (t_last - t_first) if t_first is not None else 0.0
+    st = ingest.stats()
+
+    # exact zero-loss audit: every index 0..frames-1 exactly once
+    seen = np.zeros(frames, np.int32)
+    for _w, _r, _lens, parts in batches:
+        for seg in parts:
+            for k in range(seg.lo, seg.hi):
+                i = struct.unpack_from("<Q", seg.blob,
+                                       int(seg.offs[k]))[0]
+                seen[i] += 1
+    audit_exact = bool((seen == 1).all())
+
+    # same-session gRPC ladder re-run (the compat fallback transports)
+    server, port = make_server(daemon, port=0, host="127.0.0.1",
+                               log_rpcs=False)
+    server.start()
+    client = DaemonClient(f"127.0.0.1:{port}")
+    peer = daemon._add_wire(pb.WireDef(
+        local_pod_name="soak-peer", kube_ns="default", link_uid=2,
+        intf_name_in_pod="eth0", peer_ip="10.0.0.2"))
+    pkt = pb.Packet(remot_intf_id=peer.wire_id,
+                    frame=b"f" * frame_size)
+    client.SendToOnce(pkt)  # warm channel + path
+    t0 = time.perf_counter()
+    for _ in range(grpc_unary_n):
+        client.SendToOnce(pkt)
+    unary_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    client.SendToStream(iter([pkt] * grpc_stream_n))
+    stream_s = time.perf_counter() - t0
+    chunk = 256
+    bulk_batches = [pb.PacketBatch(packets=[pkt] * chunk)
+                    for _ in range(grpc_bulk_n // chunk)]
+    client.SendToBulk(iter(bulk_batches[:4]))  # warm
+    peer.egress.clear()
+    t0 = time.perf_counter()
+    client.SendToBulk(iter(bulk_batches))
+    bulk_s = time.perf_counter() - t0
+    bulk_done = (grpc_bulk_n // chunk) * chunk
+    client.close()
+    server.stop(0)
+
+    shm_fps = total / shm_s if shm_s > 0 else 0.0
+    stream_fps = grpc_stream_n / stream_s
+    bulk_fps = bulk_done / bulk_s
+    out = {
+        "scenario": "shm_soak",
+        "frames": frames,
+        "frame_size": frame_size,
+        "slots": slots,
+        "shm_frames_ingested": total,
+        "shm_seconds": round(shm_s, 4),
+        "shm_frames_per_s": round(shm_fps, 1),
+        "shm_bytes_per_s": round(shm_fps * frame_size, 1),
+        "shm_dequeues": int(st["dequeues"]),
+        "shm_frames_per_dequeue": round(total / max(1, st["dequeues"]),
+                                        1),
+        "shm_ring_full_failures": int(st["full_failures"]),
+        "shm_audit_exact_once": audit_exact,
+        "grpc_unary_frames_per_s": round(grpc_unary_n / unary_s, 1),
+        "grpc_stream_frames_per_s": round(stream_fps, 1),
+        "grpc_bulk_frames_per_s": round(bulk_fps, 1),
+        "shm_over_grpc_unary": round(shm_fps * unary_s / grpc_unary_n,
+                                     1),
+        "shm_over_grpc_stream": round(shm_fps / stream_fps, 1),
+        "shm_over_grpc_bulk": round(shm_fps / bulk_fps, 2),
+        "same_session_grpc_rerun": True,
+        "caveats": (
+            "transport microbench on a shared host: gRPC ladder "
+            "re-measured in this same session (same machine-load "
+            "moment); single Python producer subprocess building "
+            "frames is the feed-side floor, native ring push/dequeue "
+            "probes higher; no shaping — the plane-only soak "
+            "(live_plane_soak) bounds end-to-end"),
+        "producer_rc": proc.returncode,
+        "wall_s": round(time.perf_counter() - t_wall, 3),
+    }
+    out["in_guardrails"] = bool(
+        total == frames and audit_exact and proc.returncode == 0
+        and out["shm_over_grpc_stream"] >= 10.0)
+    ingest.close()
+    shutil.rmtree(shm_dir, ignore_errors=True)
     return out
 
 
@@ -3132,6 +3525,8 @@ LADDER = {
     "staged_update_soak": staged_update_soak,
     "update_under_flap": update_under_flap,
     "noisy_neighbor": noisy_neighbor,
+    "shm_producer_crash": shm_producer_crash,
+    "shm_soak": shm_soak,
     "tenant_soak": tenant_soak,
     "migration_under_flap": migration_under_flap,
     "plane_failover": plane_failover,

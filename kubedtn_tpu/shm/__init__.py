@@ -1,0 +1,37 @@
+"""Shared-memory ingest plane: native SPSC ring transport between
+producer processes and the daemon (the software analogue of Beehive's
+move-the-stack-off-the-host argument — the compiled kernels probe at
+~3.0M frames/s while the Python gRPC wire tops out near 17.5k/s
+streamed, so the transport is the ceiling this package removes).
+
+- `ring`: mmap'd segment handle over the `kdt_shm_*` C implementation
+  (seqlock-style commit words; a crashed producer can never publish a
+  torn frame).
+- `ingest`: daemon-side driver feeding `drain_ingress` columnar spans
+  — admission evaluated at the ring head, backlog folded into the
+  adaptive-budget signal, trace ids riding the slot layout.
+- `sender`: producer-side handle with the `_PeerSender` outage-buffer
+  discipline (ring-full queues, never drops).
+- `producer`: `python -m kubedtn_tpu.shm.producer` — the real
+  subprocess used by bench soaks and the producer-crash chaos
+  scenario.
+
+gRPC (unary/stream/bulk) remains the compatibility fallback and the
+control-RPC surface; everything downstream of the drain is
+transport-blind.
+"""
+
+from kubedtn_tpu.shm.ingest import ShmIngest
+from kubedtn_tpu.shm.ring import (DEFAULT_SLOT_SIZE, DEFAULT_SLOTS,
+                                  RING_SUFFIX, ShmRing, ShmRingError)
+from kubedtn_tpu.shm.sender import ShmSender
+
+__all__ = [
+    "DEFAULT_SLOTS",
+    "DEFAULT_SLOT_SIZE",
+    "RING_SUFFIX",
+    "ShmIngest",
+    "ShmRing",
+    "ShmRingError",
+    "ShmSender",
+]

@@ -73,7 +73,7 @@ class FrameSeg:
     parallel uint64 arrays and need not be contiguous or sorted (a
     multi-wire batch's per-wire groups share the arrays re-ordered)."""
 
-    __slots__ = ("blob", "offs", "lens", "lo", "hi", "_base")
+    __slots__ = ("blob", "offs", "lens", "lo", "hi", "_base", "traces")
 
     def __init__(self, blob, offs, lens, lo: int = 0,
                  hi: int | None = None) -> None:
@@ -83,6 +83,10 @@ class FrameSeg:
         self.lo = lo
         self.hi = len(offs) if hi is None else hi
         self._base = None
+        # carried trace ids (shm ingest: sampled producer ids ride the
+        # slot layout into the plane): [(index into offs/lens, tid)],
+        # indices absolute like lo/hi. None = nothing carried.
+        self.traces = None
 
     def __len__(self) -> int:
         return self.hi - self.lo
@@ -110,6 +114,10 @@ class FrameSeg:
         self advances past them."""
         head = FrameSeg(self.blob, self.offs, self.lens, self.lo,
                         self.lo + k)
+        if self.traces is not None:
+            cut = self.lo + k
+            head.traces = [e for e in self.traces if e[0] < cut] or None
+            self.traces = [e for e in self.traces if e[0] >= cut] or None
         self.lo += k
         return head
 
@@ -469,6 +477,11 @@ class Daemon:
         # Local.ObserveSLO surface (absent = the RPC answers ok=False
         # "slo evaluation not enabled")
         self.slo = None
+        # optional shm.ShmIngest — the shared-memory ingest plane:
+        # drain_ingress folds each attached ring's committed frames
+        # into its batches (admission at the ring head, backlog into
+        # the adaptive signal). None = gRPC-only ingest, zero cost.
+        self.shm = None
         try:
             from kubedtn_tpu import native as _native
             # counts-only form: no per-frame Python on the drain path
@@ -1507,6 +1520,14 @@ class Daemon:
                     # list, the shape tests and embedders rely on
                     lens = lens_parts
                 out.append((wire, row, lens, parts))
+        if self.shm is not None:
+            # shared-memory ingest: committed ring spans join the same
+            # batch list (admission evaluated at the ring head BEFORE
+            # dequeue — an over-budget tenant's frames stay parked in
+            # its ring), and ring residue folds into the same
+            # entry-denominated backlog signal
+            backlog += self.shm.drain_into(out, max_per_wire, admit,
+                                           self)
         self.last_drain_backlog = backlog
         return out
 

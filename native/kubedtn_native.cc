@@ -973,3 +973,323 @@ uint64_t kdt_tw_next_due_us(void* h) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Section 5: shared-memory SPSC ingest ring (kdt_shm_*)
+//
+// One memory-mapped segment per producer process. Layout (all offsets
+// fixed, little-endian, 64-bit):
+//
+//   0    u64  magic "KDTSHMR1"
+//   8    u32  version (1)
+//   12   u32  slot_size            (bytes per slot, header included)
+//   16   u64  slots
+//   24   u64  producer_pid         (liveness probe for gap-skip)
+//   32   char namespace[64]        (tenant namespace, NUL padded)
+//   128  u64  tail                 (producer reserve cursor; own line)
+//   192  u64  head                 (consumer cursor; own line)
+//   256  u64  full_failures        (producer-side ring-full count)
+//   320  u64  commit[slots]        (seqlock-style commit words)
+//   ...  slot data, 64-byte aligned, slots * slot_size bytes
+//
+// Slot: u32 frame_len | u32 wire_id | u64 trace_id | payload.
+//
+// Commit protocol: position p maps to slot p % slots with generation
+// p / slots + 1. A producer RESERVES by advancing tail (release),
+// writes the slot body, then stores commit[slot] = generation
+// (release). The consumer only consumes a position once its commit
+// word equals the expected generation — a producer that dies between
+// reserve and commit leaves a visible-but-uncommitted gap that can
+// never be read as a torn frame. The consumer stalls at such a gap
+// (the producer may still be mid-write) unless the caller passes
+// skip_uncommitted, which the Python driver only does after proving
+// the producer pid dead; skipped reservations are counted out-param.
+// SPSC: exactly one producer writes tail/slots, exactly one consumer
+// writes head. All cross-process handoff is via the three atomics.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr uint64_t SHM_MAGIC = 0x31524D4853544B44ull;  // "KDTSHR1" tag
+constexpr uint32_t SHM_VERSION = 1;
+constexpr uint64_t SHM_OFF_MAGIC = 0;
+constexpr uint64_t SHM_OFF_VERSION = 8;
+constexpr uint64_t SHM_OFF_SLOT_SIZE = 12;
+constexpr uint64_t SHM_OFF_SLOTS = 16;
+constexpr uint64_t SHM_OFF_PID = 24;
+constexpr uint64_t SHM_OFF_NS = 32;
+constexpr uint64_t SHM_NS_CAP = 64;
+constexpr uint64_t SHM_OFF_TAIL = 128;
+constexpr uint64_t SHM_OFF_HEAD = 192;
+constexpr uint64_t SHM_OFF_FULL = 256;
+constexpr uint64_t SHM_OFF_COMMIT = 320;
+constexpr uint32_t SHM_SLOT_HDR = 16;  // frame_len + wire_id + trace_id
+
+inline uint64_t shm_align64(uint64_t v) { return (v + 63ull) & ~63ull; }
+
+inline uint64_t* shm_u64(uint8_t* mem, uint64_t off) {
+  return reinterpret_cast<uint64_t*>(mem + off);
+}
+inline const uint64_t* shm_u64c(const uint8_t* mem, uint64_t off) {
+  return reinterpret_cast<const uint64_t*>(mem + off);
+}
+inline uint32_t shm_load_u32(const uint8_t* mem, uint64_t off) {
+  uint32_t v;
+  std::memcpy(&v, mem + off, sizeof(v));
+  return v;
+}
+inline uint64_t shm_data_off(uint64_t slots) {
+  return shm_align64(SHM_OFF_COMMIT + slots * 8ull);
+}
+inline uint8_t* shm_slot_ptr(uint8_t* mem, uint64_t slots,
+                             uint32_t slot_size, uint64_t idx) {
+  return mem + shm_data_off(slots) + idx * static_cast<uint64_t>(slot_size);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Total segment size for a ring with this geometry (for ftruncate).
+int64_t kdt_shm_required(uint64_t slots, uint32_t slot_size) {
+  if (slots == 0 || slot_size <= SHM_SLOT_HDR) return -1;
+  return static_cast<int64_t>(shm_data_off(slots) +
+                              slots * static_cast<uint64_t>(slot_size));
+}
+
+// Initialize a fresh segment in place. Returns 1 on success, 0 when
+// the mapping is too small or the geometry is invalid.
+int32_t kdt_shm_init(uint8_t* mem, uint64_t mem_len, uint64_t slots,
+                     uint32_t slot_size, uint64_t pid, const char* ns) {
+  const int64_t need = kdt_shm_required(slots, slot_size);
+  if (need < 0 || mem_len < static_cast<uint64_t>(need)) return 0;
+  std::memset(mem, 0, shm_data_off(slots));
+  std::memcpy(mem + SHM_OFF_VERSION, &SHM_VERSION, 4);
+  std::memcpy(mem + SHM_OFF_SLOT_SIZE, &slot_size, 4);
+  *shm_u64(mem, SHM_OFF_SLOTS) = slots;
+  *shm_u64(mem, SHM_OFF_PID) = pid;
+  if (ns != nullptr) {
+    const size_t n = std::min(std::strlen(ns), size_t(SHM_NS_CAP - 1));
+    std::memcpy(mem + SHM_OFF_NS, ns, n);
+  }
+  // magic last, release: a concurrent attach never sees a half-built
+  // header as valid
+  __atomic_store_n(shm_u64(mem, SHM_OFF_MAGIC), SHM_MAGIC,
+                   __ATOMIC_RELEASE);
+  return 1;
+}
+
+// Validate an attached segment: magic, version, geometry vs mapping
+// length. Returns 1 valid / 0 invalid.
+int32_t kdt_shm_check(const uint8_t* mem, uint64_t mem_len) {
+  if (mem_len < SHM_OFF_COMMIT) return 0;
+  if (__atomic_load_n(shm_u64c(mem, SHM_OFF_MAGIC), __ATOMIC_ACQUIRE) !=
+      SHM_MAGIC)
+    return 0;
+  if (shm_load_u32(mem, SHM_OFF_VERSION) != SHM_VERSION) return 0;
+  const uint64_t slots = *shm_u64c(mem, SHM_OFF_SLOTS);
+  const uint32_t slot_size = shm_load_u32(mem, SHM_OFF_SLOT_SIZE);
+  const int64_t need = kdt_shm_required(slots, slot_size);
+  return (need > 0 && mem_len >= static_cast<uint64_t>(need)) ? 1 : 0;
+}
+
+uint64_t kdt_shm_slots(const uint8_t* mem) {
+  return *shm_u64c(mem, SHM_OFF_SLOTS);
+}
+uint32_t kdt_shm_slot_size(const uint8_t* mem) {
+  return shm_load_u32(mem, SHM_OFF_SLOT_SIZE);
+}
+uint64_t kdt_shm_pid(const uint8_t* mem) {
+  return __atomic_load_n(shm_u64c(mem, SHM_OFF_PID), __ATOMIC_ACQUIRE);
+}
+void kdt_shm_set_pid(uint8_t* mem, uint64_t pid) {
+  __atomic_store_n(shm_u64(mem, SHM_OFF_PID), pid, __ATOMIC_RELEASE);
+}
+int32_t kdt_shm_ns(const uint8_t* mem, char* out, int32_t cap) {
+  if (cap <= 0) return 0;
+  int32_t n = 0;
+  while (n < cap - 1 && n < int32_t(SHM_NS_CAP) &&
+         mem[SHM_OFF_NS + n] != 0) {
+    out[n] = static_cast<char>(mem[SHM_OFF_NS + n]);
+    ++n;
+  }
+  out[n] = 0;
+  return n;
+}
+
+// Entries reserved and not yet consumed (committed or not).
+uint64_t kdt_shm_pending(const uint8_t* mem) {
+  const uint64_t tail =
+      __atomic_load_n(shm_u64c(mem, SHM_OFF_TAIL), __ATOMIC_ACQUIRE);
+  const uint64_t head =
+      __atomic_load_n(shm_u64c(mem, SHM_OFF_HEAD), __ATOMIC_ACQUIRE);
+  return tail - head;
+}
+
+uint64_t kdt_shm_full_failures(const uint8_t* mem) {
+  return __atomic_load_n(shm_u64c(mem, SHM_OFF_FULL), __ATOMIC_ACQUIRE);
+}
+
+// Committed-and-unconsumed count: walks [head, tail) checking commit
+// words. O(pending) — accounting/verification surface (the chaos
+// scenario's zero-committed-loss audit), not the hot path.
+uint64_t kdt_shm_committed(const uint8_t* mem) {
+  const uint64_t slots = *shm_u64c(mem, SHM_OFF_SLOTS);
+  const uint64_t head =
+      __atomic_load_n(shm_u64c(mem, SHM_OFF_HEAD), __ATOMIC_ACQUIRE);
+  const uint64_t tail =
+      __atomic_load_n(shm_u64c(mem, SHM_OFF_TAIL), __ATOMIC_ACQUIRE);
+  const uint64_t* commit = shm_u64c(mem, SHM_OFF_COMMIT);
+  uint64_t n = 0;
+  for (uint64_t p = head; p < tail; ++p) {
+    if (__atomic_load_n(commit + p % slots, __ATOMIC_ACQUIRE) ==
+        p / slots + 1)
+      ++n;
+  }
+  return n;
+}
+
+// Producer: push one frame. 1 = pushed, 0 = ring full (counted in
+// full_failures), -1 = frame larger than a slot payload.
+int32_t kdt_shm_push(uint8_t* mem, const uint8_t* frame, uint32_t len,
+                     uint32_t wire_id, uint64_t trace_id) {
+  const uint64_t slots = *shm_u64c(mem, SHM_OFF_SLOTS);
+  const uint32_t slot_size = shm_load_u32(mem, SHM_OFF_SLOT_SIZE);
+  if (len > slot_size - SHM_SLOT_HDR) return -1;
+  const uint64_t tail =
+      __atomic_load_n(shm_u64c(mem, SHM_OFF_TAIL), __ATOMIC_RELAXED);
+  const uint64_t head =
+      __atomic_load_n(shm_u64c(mem, SHM_OFF_HEAD), __ATOMIC_ACQUIRE);
+  if (tail - head >= slots) {
+    __atomic_add_fetch(shm_u64(mem, SHM_OFF_FULL), 1, __ATOMIC_RELEASE);
+    return 0;
+  }
+  // reserve, write, commit — same order as the batch path so a crash
+  // at any point leaves at worst an uncommitted reservation
+  __atomic_store_n(shm_u64(mem, SHM_OFF_TAIL), tail + 1, __ATOMIC_RELEASE);
+  const uint64_t idx = tail % slots;
+  uint8_t* slot = shm_slot_ptr(mem, slots, slot_size, idx);
+  std::memcpy(slot, &len, 4);
+  std::memcpy(slot + 4, &wire_id, 4);
+  std::memcpy(slot + 8, &trace_id, 8);
+  if (len) std::memcpy(slot + SHM_SLOT_HDR, frame, len);
+  __atomic_store_n(shm_u64(mem, SHM_OFF_COMMIT) + idx, tail / slots + 1,
+                   __ATOMIC_RELEASE);
+  return 1;
+}
+
+// Producer: push a columnar batch (blob + offs/lens, one slot per
+// frame). Reserves the whole publishable span up front, then writes
+// and commits slot by slot. Returns frames pushed; stops early at
+// ring-full (counted once in full_failures) or at the first frame
+// that exceeds the slot payload (caller distinguishes by comparing
+// lens[returned] against the payload capacity).
+int64_t kdt_shm_push_batch(uint8_t* mem, const uint8_t* blob,
+                           const uint64_t* offs, const uint64_t* lens,
+                           const uint32_t* wire_ids,
+                           const uint64_t* trace_ids, int64_t n) {
+  if (n <= 0) return 0;
+  const uint64_t slots = *shm_u64c(mem, SHM_OFF_SLOTS);
+  const uint32_t slot_size = shm_load_u32(mem, SHM_OFF_SLOT_SIZE);
+  const uint64_t payload_cap = slot_size - SHM_SLOT_HDR;
+  const uint64_t tail =
+      __atomic_load_n(shm_u64c(mem, SHM_OFF_TAIL), __ATOMIC_RELAXED);
+  const uint64_t head =
+      __atomic_load_n(shm_u64c(mem, SHM_OFF_HEAD), __ATOMIC_ACQUIRE);
+  const uint64_t avail = slots - (tail - head);
+  int64_t k = std::min<int64_t>(n, static_cast<int64_t>(avail));
+  int64_t fit = 0;
+  while (fit < k && lens[fit] <= payload_cap) ++fit;
+  if (fit < n && fit == k && static_cast<uint64_t>(k) == avail) {
+    // stopped because the ring is full, not because a frame was too big
+    __atomic_add_fetch(shm_u64(mem, SHM_OFF_FULL), 1, __ATOMIC_RELEASE);
+  }
+  if (fit == 0) return 0;
+  __atomic_store_n(shm_u64(mem, SHM_OFF_TAIL),
+                   tail + static_cast<uint64_t>(fit), __ATOMIC_RELEASE);
+  uint64_t* commit = shm_u64(mem, SHM_OFF_COMMIT);
+  for (int64_t i = 0; i < fit; ++i) {
+    const uint64_t pos = tail + static_cast<uint64_t>(i);
+    const uint64_t idx = pos % slots;
+    const uint32_t len = static_cast<uint32_t>(lens[i]);
+    uint8_t* slot = shm_slot_ptr(mem, slots, slot_size, idx);
+    std::memcpy(slot, &len, 4);
+    std::memcpy(slot + 4, &wire_ids[i], 4);
+    const uint64_t tid = trace_ids ? trace_ids[i] : 0;
+    std::memcpy(slot + 8, &tid, 8);
+    if (len) std::memcpy(slot + SHM_SLOT_HDR, blob + offs[i], len);
+    __atomic_store_n(commit + idx, pos / slots + 1, __ATOMIC_RELEASE);
+  }
+  return fit;
+}
+
+// Test hook: reserve n slots and never commit them — the frozen image
+// of a producer killed between reserve and publish.
+int32_t kdt_shm_push_torn(uint8_t* mem, uint32_t n) {
+  const uint64_t slots = *shm_u64c(mem, SHM_OFF_SLOTS);
+  const uint64_t tail =
+      __atomic_load_n(shm_u64c(mem, SHM_OFF_TAIL), __ATOMIC_RELAXED);
+  const uint64_t head =
+      __atomic_load_n(shm_u64c(mem, SHM_OFF_HEAD), __ATOMIC_ACQUIRE);
+  if (slots - (tail - head) < n) return 0;
+  __atomic_store_n(shm_u64(mem, SHM_OFF_TAIL), tail + n, __ATOMIC_RELEASE);
+  return 1;
+}
+
+// Consumer: batch-dequeue committed frames into a contiguous blob +
+// columnar arrays (wire_id, byte offset, byte length, trace_id per
+// frame). Stops at max_frames, at blob_cap, or at the first
+// uncommitted reservation — unless skip_uncommitted (the caller has
+// proven the producer dead), in which case gaps are skipped and
+// counted in *out_skipped. Returns frames dequeued.
+int64_t kdt_shm_dequeue(uint8_t* mem, uint8_t* out_blob, uint64_t blob_cap,
+                        uint32_t* out_wire, uint64_t* out_off,
+                        uint64_t* out_len, uint64_t* out_trace,
+                        int64_t max_frames, int32_t skip_uncommitted,
+                        uint64_t* out_skipped) {
+  const uint64_t slots = *shm_u64c(mem, SHM_OFF_SLOTS);
+  const uint32_t slot_size = shm_load_u32(mem, SHM_OFF_SLOT_SIZE);
+  const uint64_t payload_cap = slot_size - SHM_SLOT_HDR;
+  uint64_t head =
+      __atomic_load_n(shm_u64c(mem, SHM_OFF_HEAD), __ATOMIC_RELAXED);
+  const uint64_t tail =
+      __atomic_load_n(shm_u64c(mem, SHM_OFF_TAIL), __ATOMIC_ACQUIRE);
+  uint64_t* commit = shm_u64(mem, SHM_OFF_COMMIT);
+  int64_t n = 0;
+  uint64_t used = 0;
+  uint64_t skipped = 0;
+  while (head < tail && n < max_frames) {
+    const uint64_t idx = head % slots;
+    const uint64_t gen = head / slots + 1;
+    if (__atomic_load_n(commit + idx, __ATOMIC_ACQUIRE) != gen) {
+      if (!skip_uncommitted) break;
+      ++head;
+      ++skipped;
+      continue;
+    }
+    const uint8_t* slot = shm_slot_ptr(mem, slots, slot_size, idx);
+    uint32_t len;
+    std::memcpy(&len, slot, 4);
+    if (len > payload_cap) {  // corrupt slot: never hand it upstream
+      ++head;
+      ++skipped;
+      continue;
+    }
+    if (used + len > blob_cap) break;
+    std::memcpy(&out_wire[n], slot + 4, 4);
+    std::memcpy(&out_trace[n], slot + 8, 8);
+    if (len) std::memcpy(out_blob + used, slot + SHM_SLOT_HDR, len);
+    out_off[n] = used;
+    out_len[n] = len;
+    used += len;
+    ++n;
+    ++head;
+  }
+  // release: the producer's availability check (acquire load of head)
+  // must observe our slot reads as complete before reusing them
+  __atomic_store_n(shm_u64(mem, SHM_OFF_HEAD), head, __ATOMIC_RELEASE);
+  if (out_skipped) *out_skipped = skipped;
+  return n;
+}
+
+}  // extern "C"

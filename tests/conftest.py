@@ -68,7 +68,30 @@ def _multihost_supported() -> bool:
         return False
 
 
+def _native_shm_supported() -> bool:
+    """Can this host run the shared-memory ingest ring? Needs the
+    native library (prebuilt .so, or a C++ toolchain for `make -C
+    native`) with the kdt_shm_* entry points — an ENVIRONMENT
+    dependency, same policy as the reference checkout above: marked
+    tests skip with an honest reason instead of failing."""
+    try:
+        from kubedtn_tpu import native
+
+        return native.have_native()
+    except Exception:
+        return False
+
+
 def pytest_collection_modifyitems(config, items):
+    if any("requires_native_shm" in item.keywords for item in items) \
+            and not _native_shm_supported():
+        skip_shm = pytest.mark.skip(
+            reason="requires_native_shm: libkubedtn_native.so with the "
+                   "kdt_shm_* ring entry points is not available (no "
+                   "prebuilt .so and no C++ toolchain to build one)")
+        for item in items:
+            if "requires_native_shm" in item.keywords:
+                item.add_marker(skip_shm)
     if not _multihost_supported():
         skip_mh = pytest.mark.skip(
             reason="requires_multihost: this jaxlib lacks the gloo CPU "

@@ -67,3 +67,30 @@ def test_update_under_flap_smoke():
     # acceptance: zero loss, zero tick errors, either way
     assert r["frames_lost"] == 0, r
     assert r["tick_errors"] == 0, r
+
+
+@pytest.mark.chaos
+@pytest.mark.shm
+@pytest.mark.requires_native_shm
+def test_shm_producer_crash_smoke():
+    """The shm ingest plane's crash gate (<30s tier-1 smoke of the
+    LADDER's shm_producer_crash): a real producer subprocess is
+    SIGKILLed mid-burst — zero committed-frame loss (delivered indices
+    are an exact contiguous prefix covering every progress report),
+    the torn tail is skipped only after the pid provably died, the
+    dead ring retires, and a producer-minted trace id spans
+    received -> ingress -> delivered across the ring."""
+    from kubedtn_tpu.scenarios import shm_producer_crash
+
+    r = shm_producer_crash(frames=1_200, kill_after=400,
+                           drain_timeout_s=20.0)
+    assert r["reported_at_kill"] >= 400, r
+    assert r["delivered_prefix_ok"], r
+    assert r["committed_lost"] == 0, r
+    assert r["delivered"] >= r["reported_at_kill"], r
+    assert r["torn_skipped"] > 0, r          # the gap-skip path ran
+    assert r["ring_pending_final"] == 0, r
+    assert r["rings_retired"] == 1, r        # dead ring retired
+    assert r["trace_ok"], r                  # trace spans the ring
+    assert r["tick_errors"] == 0 and r["dropped"] == 0, r
+    assert r["in_guardrails"], r

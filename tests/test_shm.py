@@ -1,0 +1,645 @@
+"""Shared-memory ingest plane (kubedtn_tpu/shm + native section 5).
+
+Pins the contracts ARCHITECTURE.md's "Shared-memory ingest plane"
+section states:
+
+- ring protocol: FIFO roundtrip, exact pending/committed accounting,
+  ring-full returns (never drops), oversized frames rejected;
+- crash safety: an uncommitted reservation (the frozen image of a
+  producer killed between reserve and publish) is NEVER surfaced as a
+  frame, and is only crossed after the producer pid provably died —
+  committed frames beyond the tear still deliver;
+- transport equivalence: the same frame sequence fed via the shm ring
+  vs the gRPC stream RPC yields byte-identical delivered payload
+  streams AND identical link-telemetry ring totals, at pipeline
+  depths 1 and 2;
+- admission at the ring head: an over-budget tenant's frames stay
+  parked IN its ring (typed verdicts still metered), and ring residue
+  folds into the adaptive-budget backlog signal;
+- producer-side `ShmSender` backpressure: ring-full queues in the
+  outage buffer with exact accounting — every frame is pushed exactly
+  once, in order, or still counted in buffered().
+
+Everything here needs the native library; the module auto-skips with
+an honest reason when the host has neither a C toolchain nor the
+prebuilt .so (tests/conftest.py, `requires_native_shm`).
+"""
+
+import os
+import random
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kubedtn_tpu.api.types import Link, LinkProperties, Topology, \
+    TopologySpec
+from kubedtn_tpu.runtime import WireDataPlane
+from kubedtn_tpu.topology import Reconciler, SimEngine, TopologyStore
+
+pytestmark = [pytest.mark.shm, pytest.mark.requires_native_shm]
+
+
+# -- harness ------------------------------------------------------------
+
+def _daemon_with_pairs(pairs, props, namespaces=None):
+    """test_pipeline_determinism's pair builder, with optional per-pair
+    namespaces (tenancy tests)."""
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.server import Daemon
+
+    store = TopologyStore()
+    engine = SimEngine(store, capacity=4 * pairs + 8)
+    nss = namespaces or ["default"] * pairs
+    for i in range(pairs):
+        ns = nss[i]
+        a, b = f"a{i}", f"b{i}"
+        store.create(Topology(name=a, namespace=ns,
+                              spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=b,
+                 uid=i + 1, properties=props)])))
+        store.create(Topology(name=b, namespace=ns,
+                              spec=TopologySpec(links=[
+            Link(local_intf="eth1", peer_intf="eth1", peer_pod=a,
+                 uid=i + 1, properties=props)])))
+        engine.setup_pod(a, ns)
+        engine.setup_pod(b, ns)
+    Reconciler(store, engine).drain()
+    daemon = Daemon(engine)
+    win, wout = [], []
+    for i in range(pairs):
+        win.append(daemon._add_wire(pb.WireDef(
+            local_pod_name=f"a{i}", kube_ns=nss[i], link_uid=i + 1,
+            intf_name_in_pod="eth1")))
+        wout.append(daemon._add_wire(pb.WireDef(
+            local_pod_name=f"b{i}", kube_ns=nss[i], link_uid=i + 1,
+            intf_name_in_pod="eth1")))
+    return daemon, engine, win, wout
+
+
+def _tagged_frames(wire_i: int, n: int, size: int = 64):
+    return [bytes([wire_i]) + i.to_bytes(4, "big")
+            + b"\x00" * (size - 5) for i in range(n)]
+
+
+def _make_ring(tmp_path, name="p1.ring", slots=8192, slot_size=2048,
+               namespace=""):
+    from kubedtn_tpu.shm import ShmRing
+
+    return ShmRing.create(str(tmp_path / name), slots=slots,
+                          slot_size=slot_size, namespace=namespace)
+
+
+# -- ring protocol ------------------------------------------------------
+
+def test_ring_roundtrip_columns():
+    """Push (single + batch) then batch-dequeue: FIFO bytes, correct
+    wire/len/trace columns, exact pending accounting."""
+    from kubedtn_tpu.shm import ShmRing
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        ring = ShmRing.create(os.path.join(d, "r.ring"), slots=64,
+                              slot_size=256, namespace="teamx")
+        assert ring.namespace == "teamx"
+        assert ring.producer_pid() == os.getpid()
+        assert ring.push(b"hello", 7, trace_id=0xABC) == 1
+        frames = [bytes([i]) * (i + 1) for i in range(10)]
+        assert ring.push_batch(frames, 9) == 10
+        assert ring.pending() == 11 == len(ring)
+        assert ring.committed() == 11
+
+        blob, wires, offs, lens, traces, skipped = ring.dequeue(100)
+        assert skipped == 0
+        assert len(wires) == 11 and ring.pending() == 0
+        got = [blob[int(o):int(o + ln)] for o, ln in zip(offs, lens)]
+        assert got == [b"hello"] + frames
+        assert wires.tolist() == [7] + [9] * 10
+        assert traces.tolist() == [0xABC] + [0] * 10
+        # empty dequeue: the no-frames shape
+        blob, wires, *_rest, skipped = ring.dequeue(10)
+        assert blob == b"" and wires is None and skipped == 0
+        ring.close()
+
+
+def test_ring_full_never_drops_and_oversize_rejected(tmp_path):
+    from kubedtn_tpu.shm import ShmRingError
+
+    ring = _make_ring(tmp_path, slots=8, slot_size=128)
+    for i in range(8):
+        assert ring.push(bytes([i]) * 16, 1) == 1
+    assert ring.push(b"x", 1) == 0          # full: refused, not dropped
+    assert ring.push_batch([b"a", b"b"], 1) == 0
+    assert ring.full_failures() >= 2
+    assert ring.pending() == 8              # nothing torn or lost
+    assert ring.push(b"z" * 1000, 1) == -1  # > payload cap
+    with pytest.raises(ShmRingError):
+        ring.push_batch([b"z" * 1000], 1)
+    # drain one slot -> exactly one more push fits
+    _, wires, *_ = ring.dequeue(1)
+    assert len(wires) == 1
+    assert ring.push(b"y", 1) == 1
+    assert ring.push(b"y", 1) == 0
+    ring.close()
+
+
+def test_ring_wraparound_property():
+    """Seeded random push/push_batch/dequeue sequence against a python
+    FIFO model: byte-exact order, column-exact metadata, pending
+    accounting — across many wrap generations of a small ring."""
+    import tempfile
+
+    rng = random.Random(0x5157)
+    with tempfile.TemporaryDirectory() as d:
+        from kubedtn_tpu.shm import ShmRing
+
+        ring = ShmRing.create(os.path.join(d, "r.ring"), slots=32,
+                              slot_size=96)
+        model = []  # (frame, wire, trace)
+        seq = 0
+        delivered = 0
+        for _step in range(1500):
+            op = rng.random()
+            if op < 0.45 and len(model) < 32:
+                k = rng.randint(1, 6)
+                wid = rng.randint(1, 3)
+                batch = []
+                for _ in range(k):
+                    f = struct.pack("<I", seq) + bytes(
+                        [seq & 0xFF] * rng.randint(0, 60))
+                    batch.append(f)
+                    seq += 1
+                pushed = ring.push_batch(
+                    batch, wid, [s & 0xFFFF for s in range(seq - k, seq)])
+                for j in range(pushed):
+                    model.append((batch[j], wid,
+                                  (seq - k + j) & 0xFFFF))
+            elif op < 0.6 and len(model) < 32:
+                f = struct.pack("<I", seq)
+                if ring.push(f, 5, trace_id=seq) == 1:
+                    model.append((f, 5, seq))
+                    seq += 1
+            else:
+                want = rng.randint(1, 10)
+                blob, wires, offs, lens, traces, skipped = \
+                    ring.dequeue(want)
+                assert skipped == 0
+                n = 0 if wires is None else len(wires)
+                assert n <= want and n <= len(model)
+                for j in range(n):
+                    ef, ew, et = model.pop(0)
+                    o, ln = int(offs[j]), int(lens[j])
+                    assert blob[o:o + ln] == ef
+                    assert int(wires[j]) == ew
+                    assert int(traces[j]) == et
+                delivered += n
+            assert ring.pending() == len(model)
+        assert delivered > 300  # the schedule actually exercised wraps
+        ring.close()
+
+
+# -- crash safety: torn frames ------------------------------------------
+
+def test_torn_reservation_blocks_while_producer_lives(tmp_path):
+    """A reserve-without-commit gap (crash image) stalls the consumer
+    at the gap — frames behind it deliver, frames beyond it wait, and
+    the torn slot is NEVER surfaced."""
+    ring = _make_ring(tmp_path, slots=64, slot_size=128)
+    ring.push_batch([b"a", b"b"], 1)
+    assert ring.push_torn(1)
+    ring.push_batch([b"c", b"d"], 1)
+    assert ring.pending() == 5
+    assert ring.committed() == 4
+
+    blob, wires, offs, lens, traces, skipped = ring.dequeue(100)
+    assert skipped == 0
+    assert [blob[int(o):int(o + ln)] for o, ln in zip(offs, lens)] \
+        == [b"a", b"b"]
+    # stalled at the gap: nothing more without skip_uncommitted
+    blob, wires, *_rest, skipped = ring.dequeue(100)
+    assert wires is None and skipped == 0
+    assert ring.pending() == 3
+
+    # the producer (us) is alive; only after a PROVEN death may the
+    # consumer cross — simulate by passing skip explicitly (the driver
+    # only does so after producer_dead())
+    blob, wires, offs, lens, traces, skipped = ring.dequeue(
+        100, skip_uncommitted=True)
+    assert skipped == 1  # the torn slot: counted, never surfaced
+    assert [blob[int(o):int(o + ln)] for o, ln in zip(offs, lens)] \
+        == [b"c", b"d"]
+    assert ring.pending() == 0
+    ring.close()
+
+
+def test_producer_death_detection(tmp_path):
+    """producer_dead() needs a PROOF: a reaped child pid is dead, our
+    own pid is not."""
+    ring = _make_ring(tmp_path)
+    assert not ring.producer_dead()  # it's us
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    from kubedtn_tpu import native
+
+    native._load().kdt_shm_set_pid(ring._buf, proc.pid)
+    assert ring.producer_pid() == proc.pid
+    assert ring.producer_dead()
+    ring.close()
+
+
+# -- transport equivalence: shm vs gRPC stream --------------------------
+
+DET_PROPS = [
+    LinkProperties(latency="3ms"),
+    LinkProperties(rate="2Gbit"),
+]
+
+
+def _run_plane_transport(depth, props, n_per_wire, transport,
+                         tmp_path, pairs=2, ticks=30, dt=0.002,
+                         feed_every=5):
+    """Identical deterministic schedule over either transport. Frames
+    feed in per-tick bursts below the explicit-clock drain budget
+    (max_slots=4096), so arrival ticks — hence shaping and telemetry —
+    are transport-independent, not just delivery order."""
+    from kubedtn_tpu.shm import ShmIngest, ShmRing, ShmSender
+    from kubedtn_tpu.wire import proto as pb
+
+    daemon, _engine, win, wout = _daemon_with_pairs(pairs, props)
+    plane = WireDataPlane(daemon, dt_us=dt * 1e6, pipeline_depth=depth)
+    plane.pipeline_explicit_clock = True
+    plane.enable_telemetry(window_s=0.01, sample_period=4)
+
+    sender = ingest = None
+    if transport == "shm":
+        shm_dir = tmp_path / f"shm-d{depth}-{id(props) & 0xFFFF}"
+        shm_dir.mkdir()
+        sender = ShmSender(str(shm_dir / "prod.ring"),
+                           namespace="default")
+        ingest = ShmIngest(str(shm_dir))
+        ingest.attach_ring(ShmRing.attach(sender.ring.path))
+        plane.attach_shm(ingest, watcher=False)
+
+    def feed(burst):
+        for k, wa in enumerate(win):
+            frames = _tagged_frames(k, burst)
+            if transport == "shm":
+                sender.send(wa.wire_id, frames)
+            else:
+                daemon.SendToStream(
+                    iter([pb.Packet(remot_intf_id=wa.wire_id, frame=f)
+                          for f in frames]), None)
+
+    t = 100.0
+    feeds = 0
+    per_feed = -(-n_per_wire // (1 + (ticks - 1) // feed_every))
+    fed = 0
+    for j in range(ticks):
+        if j % feed_every == 0 and fed < n_per_wire:
+            burst = min(per_feed, n_per_wire - fed)
+            feed(burst)
+            fed += burst
+            feeds += 1
+        t += dt
+        plane.tick(now_s=t)
+    assert fed == n_per_wire
+    plane.flush()
+    plane.tick(now_s=t + 10.0)
+    assert plane.tick_errors == 0
+    if transport == "shm":
+        assert sender.buffered() == 0
+        assert ingest.pending_total() == 0
+        st = ingest.stats()
+        assert st["frames_in"] == pairs * n_per_wire
+        sender.close()
+        ingest.close()
+    totals, _secs = plane.telemetry.window_sum()
+    return [list(w.egress) for w in wout], totals, plane
+
+
+@pytest.mark.parametrize("depth", [1, 2])
+@pytest.mark.parametrize("props", DET_PROPS, ids=["latency", "tbf"])
+def test_shm_matches_grpc_stream_byte_identical(depth, props, tmp_path):
+    """The satellite contract: same frames via ring vs stream RPC →
+    byte-identical per-wire delivered sequences AND identical
+    link-telemetry ring totals, at depths 1 and 2."""
+    got_g, tot_g, pg = _run_plane_transport(
+        depth, props, 120, "grpc", tmp_path)
+    got_s, tot_s, ps = _run_plane_transport(
+        depth, props, 120, "shm", tmp_path)
+    assert pg.shaped == ps.shaped
+    assert pg.dropped == ps.dropped == 0
+    for wg, ws in zip(got_g, got_s):
+        assert wg == ws  # byte-identical, in order
+    assert sum(len(w) for w in got_g) == 2 * 120
+    assert np.array_equal(tot_g, tot_s)  # telemetry ring totals
+
+
+def test_shm_depth2_matches_depth1(tmp_path):
+    """Pipeline overlap must not reorder ring traffic either."""
+    got1, tot1, _p1 = _run_plane_transport(
+        1, DET_PROPS[0], 120, "shm", tmp_path)
+    got2, tot2, _p2 = _run_plane_transport(
+        2, DET_PROPS[0], 120, "shm", tmp_path)
+    for w1, w2 in zip(got1, got2):
+        assert w1 == w2
+    assert np.array_equal(tot1, tot2)
+
+
+def test_trace_id_survives_ring_to_delivery(tmp_path):
+    """A producer-minted sampled trace id rides the slot layout and
+    comes out the far side: received -> ingress -> ... -> delivered,
+    all under the SAME id (`kdt trace` spans shm ingest like gRPC)."""
+    from kubedtn_tpu import telemetry as tele
+    from kubedtn_tpu.shm import ShmIngest, ShmRing, ShmSender
+
+    daemon, _engine, win, wout = _daemon_with_pairs(1, DET_PROPS[0])
+    plane = WireDataPlane(daemon, dt_us=2000.0, pipeline_depth=1)
+    plane.pipeline_explicit_clock = True
+    plane.enable_telemetry(window_s=0.01, sample_period=4)
+    shm_dir = tmp_path / "rings"
+    shm_dir.mkdir()
+    sender = ShmSender(str(shm_dir / "p.ring"), namespace="default",
+                       sample_period=4)
+    ingest = ShmIngest(str(shm_dir))
+    ingest.attach_ring(ShmRing.attach(sender.ring.path))
+    plane.attach_shm(ingest, watcher=False)
+
+    sender.send(win[0].wire_id, _tagged_frames(0, 20))
+    assert len(sender.minted) == 5  # every 4th frame stamped
+    t = 100.0
+    for _ in range(10):
+        t += 0.002
+        plane.tick(now_s=t)
+    plane.flush()
+    plane.tick(now_s=t + 10.0)
+    assert len(wout[0].egress) == 20
+
+    rec = plane.recorder
+    spanning = 0
+    for tid in sender.minted:
+        stages = [e[3] for e in rec.events_for(tid)]
+        if stages:
+            assert tele.ST_RECEIVED in stages
+            assert tele.ST_INGRESS in stages
+            assert tele.ST_DELIVERED in stages
+            spanning += 1
+    assert spanning == 5, "all minted ids must span ingest->delivery"
+    sender.close()
+    ingest.close()
+    plane.stop()
+
+
+# -- admission at the ring head -----------------------------------------
+
+def test_admission_parks_frames_in_ring(tmp_path):
+    """An over-budget tenant's frames NEVER leave its ring: typed
+    verdicts are recorded and metered, nothing is dropped, and once
+    the budget refills everything delivers."""
+    from kubedtn_tpu.shm import ShmIngest, ShmRing, ShmSender
+    from kubedtn_tpu.tenancy import TenantRegistry
+
+    daemon, engine, win, wout = _daemon_with_pairs(
+        1, DET_PROPS[0], namespaces=["busy"])
+    reg = TenantRegistry(engine)
+    reg.create("busy", frame_budget_per_s=50.0)  # burst = 50 frames
+    plane = WireDataPlane(daemon, dt_us=2000.0, pipeline_depth=1)
+    plane.pipeline_explicit_clock = True
+    plane.attach_tenancy(reg)
+    shm_dir = tmp_path / "rings"
+    shm_dir.mkdir()
+    sender = ShmSender(str(shm_dir / "busy.ring"), namespace="busy")
+    ingest = ShmIngest(str(shm_dir))
+    ingest.attach_ring(ShmRing.attach(sender.ring.path))
+    plane.attach_shm(ingest, watcher=False)
+
+    fed = 200
+    t = 50.0
+    throttled_seen = 0
+    pushed = 0
+    for j in range(30):
+        if j < 10:  # 20 frames/tick overruns the 50-frame burst fast
+            sender.send(win[0].wire_id,
+                        _tagged_frames(0, fed)[pushed:pushed + 20])
+            pushed += 20
+        t += 0.002
+        plane.tick(now_s=t)
+        st = ingest.stats()
+        throttled_seen = max(throttled_seen,
+                             st["throttled_frames_last"])
+        # parked frames stay IN the ring: accounting closes every tick
+        assert st["frames_in"] + st["pending"] == pushed
+    assert pushed == fed
+    assert throttled_seen > 0, "budget never throttled the ring"
+    st = ingest.stats()
+    assert st["throttled_events"] > 0
+    assert st["pending"] > 0  # still parked at this point
+
+    verds = [v for v in reg.admission.recent() if v.tenant == "busy"]
+    assert verds and verds[-1].reason == "frame-budget"
+    assert verds[-1].queued_frames > 0  # ring depth rode the verdict
+    assert reg.admission.stats_for("busy")["throttle_events"] \
+        == len(verds)
+
+    # budget refills with sim time: everything parked must deliver
+    for _ in range(80):
+        t += 0.05
+        plane.tick(now_s=t)
+        if ingest.pending_total() == 0:
+            break
+    plane.flush()
+    plane.tick(now_s=t + 10.0)
+    assert ingest.pending_total() == 0
+    assert len(wout[0].egress) == fed  # throttled, never dropped
+    assert list(wout[0].egress) == _tagged_frames(0, fed)
+    sender.close()
+    ingest.close()
+    plane.stop()
+
+
+def test_ring_residue_folds_into_backlog_signal(tmp_path):
+    """Budget residue left in the ring surfaces in
+    daemon.last_drain_backlog (entry-denominated) — throttled rings are
+    excluded (ticking harder cannot drain them)."""
+    from kubedtn_tpu.shm import ShmIngest, ShmRing, ShmSender
+
+    daemon, _engine, win, _wout = _daemon_with_pairs(1, DET_PROPS[0])
+    shm_dir = tmp_path / "rings"
+    shm_dir.mkdir()
+    sender = ShmSender(str(shm_dir / "p.ring"), namespace="default")
+    ingest = ShmIngest(str(shm_dir))
+    ingest.attach_ring(ShmRing.attach(sender.ring.path))
+    daemon.shm = ingest
+
+    sender.send(win[0].wire_id, _tagged_frames(0, 600, size=32))
+    out = daemon.drain_ingress(max_per_wire=8)
+    assert sum(len(p) for _w, _r, _l, parts in out for p in parts) == 8
+    # 592 frames left / 256 per entry -> 2 entries of backlog
+    assert daemon.last_drain_backlog == 2
+
+    # a throttled ring contributes NOTHING to the signal
+    out = daemon.drain_ingress(max_per_wire=8, admit=lambda w: 0)
+    assert out == []
+    assert daemon.last_drain_backlog == 0
+    assert ingest.stats()["throttled_events"] == 1
+    sender.close()
+    ingest.close()
+
+
+def test_unknown_wire_and_unrealized_row(tmp_path):
+    """Ring frames for a wire id the daemon never added count as bulk
+    unresolved (dropped with accounting, like the gRPC bulk path)."""
+    from kubedtn_tpu.shm import ShmIngest, ShmRing, ShmSender
+
+    daemon, _engine, win, _wout = _daemon_with_pairs(1, DET_PROPS[0])
+    shm_dir = tmp_path / "rings"
+    shm_dir.mkdir()
+    sender = ShmSender(str(shm_dir / "p.ring"))
+    ingest = ShmIngest(str(shm_dir))
+    ingest.attach_ring(ShmRing.attach(sender.ring.path))
+    daemon.shm = ingest
+
+    sender.send(0x5FFFFF, [b"lost"] * 3)        # no such wire
+    sender.send(win[0].wire_id, [b"kept"] * 2)  # real wire
+    out = daemon.drain_ingress(max_per_wire=64)
+    st = ingest.stats()
+    assert st["unresolved_frames"] == 3
+    assert daemon.bulk_unresolved == 3
+    assert sum(len(p) for _w, _r, _l, parts in out for p in parts) == 2
+    sender.close()
+    ingest.close()
+
+
+# -- sender backpressure ------------------------------------------------
+
+def test_sender_outage_buffer_exact_accounting(tmp_path):
+    """Ring-full parks frames in the outage buffer (never drops);
+    accepted == pushed + buffered at every step; final delivery is
+    every frame exactly once, in order."""
+    from kubedtn_tpu.shm import ShmRing, ShmSender
+
+    sender = ShmSender(str(tmp_path / "p.ring"), slots=16,
+                       slot_size=96, max_buffered=1 << 16)
+    consumer = ShmRing.attach(sender.ring.path)
+    frames = [struct.pack("<I", i) for i in range(400)]
+    got = []
+    for i in range(0, 400, 40):
+        sender.send(3, frames[i:i + 40])
+        st = sender.stats()
+        assert st["accepted"] == st["pushed"] + st["buffered"]
+        # consumer drains a little, slower than the producer feeds
+        blob, wires, offs, lens, *_ = consumer.dequeue(16)
+        if wires is not None:
+            got.extend(blob[int(o):int(o + ln)]
+                       for o, ln in zip(offs, lens))
+    assert sender.stats()["ring_full_failures"] > 0
+    assert sender.buffered_peak > 0
+    # drain the rest end to end
+    while True:
+        ok = sender.flush(timeout_s=0.0)
+        blob, wires, offs, lens, *_ = consumer.dequeue(64)
+        if wires is not None:
+            got.extend(blob[int(o):int(o + ln)]
+                       for o, ln in zip(offs, lens))
+        elif ok:
+            break
+    assert got == frames  # exactly once, in order, zero drops
+    st = sender.stats()
+    assert st["accepted"] == st["pushed"] == 400
+    assert st["buffered"] == 0
+    consumer.close()
+    sender.close()
+
+
+def test_sender_block_timeout_keeps_accounting(tmp_path):
+    """A full buffer with a dead consumer blocks then raises — with
+    every frame still accounted for (pushed or buffered)."""
+    from kubedtn_tpu.shm import ShmSender
+
+    sender = ShmSender(str(tmp_path / "p.ring"), slots=8, slot_size=96,
+                       max_buffered=8)
+    with pytest.raises(TimeoutError):
+        sender.send(1, [b"f"] * 64, block_timeout_s=0.05)
+    st = sender.stats()
+    assert st["pushed"] == 8          # the ring took its 8 slots
+    assert st["buffered"] == 8        # the buffer its 8
+    assert st["blocked_s"] > 0.0
+    sender.close()
+
+
+# -- dead-producer drain via a real subprocess --------------------------
+
+def test_dead_producer_ring_drains_and_retires(tmp_path):
+    """A real producer subprocess pushes frames + torn reservations and
+    exits. The driver delivers every committed frame, skips the torn
+    tail only after the pid provably died, then retires the ring."""
+    from kubedtn_tpu.shm import ShmIngest
+
+    ring_path = str(tmp_path / "dead.ring")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubedtn_tpu.shm.producer", ring_path,
+         "77", "50", "--frame-size", "64", "--torn", "3"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "done pushed=50" in proc.stdout
+
+    daemon, _engine, win, wout = _daemon_with_pairs(1, DET_PROPS[0])
+    ingest = ShmIngest(str(tmp_path), scan_interval_s=0.0)
+    daemon.shm = ingest
+    # remap the producer's wire id onto our real wire
+    ingest.scan(force=True)
+    [st] = list(ingest._rings.values())
+    assert st.ring.producer_dead()
+    assert st.ring.pending() == 53  # 50 committed + 3 torn
+
+    out = daemon.drain_ingress(max_per_wire=4096)
+    stats = ingest.stats()
+    assert stats["skipped_uncommitted"] == 3
+    assert stats["unresolved_frames"] == 50  # wire 77 does not exist
+    assert stats["pending"] == 0
+
+    # empty + dead -> linger one (zero-length) interval, then retire
+    daemon.drain_ingress(max_per_wire=64)
+    daemon.drain_ingress(max_per_wire=64)
+    stats = ingest.stats()
+    assert stats["rings_retired"] == 1 and stats["rings"] == 0
+    assert out == []  # nothing resolvable was emitted
+    ingest.close()
+
+
+def test_producer_frames_deliver_end_to_end(tmp_path):
+    """The subprocess producer's deterministic frames (index in the
+    first 8 bytes) arrive complete and in order on a real wire."""
+    from kubedtn_tpu.shm import ShmIngest
+
+    daemon, _engine, win, wout = _daemon_with_pairs(1, DET_PROPS[0])
+    plane = WireDataPlane(daemon, dt_us=2000.0, pipeline_depth=1)
+    plane.pipeline_explicit_clock = True
+    ring_path = str(tmp_path / "live.ring")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubedtn_tpu.shm.producer", ring_path,
+         str(win[0].wire_id), "80", "--frame-size", "64"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+    ingest = ShmIngest(str(tmp_path), scan_interval_s=0.0)
+    plane.attach_shm(ingest, watcher=False)
+    t = 10.0
+    for _ in range(10):
+        t += 0.002
+        plane.tick(now_s=t)
+    plane.flush()
+    plane.tick(now_s=t + 10.0)
+    assert len(wout[0].egress) == 80
+    idx = [struct.unpack("<Q", f[:8])[0] for f in wout[0].egress]
+    assert idx == list(range(80))  # complete, in order
+    ingest.close()
+    plane.stop()

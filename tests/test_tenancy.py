@@ -319,6 +319,31 @@ def test_noisy_neighbor_smoke():
     assert out["dropped"] == 0
 
 
+@pytest.mark.requires_native_shm
+def test_noisy_neighbor_shm_aggressor_smoke():
+    """Same contract, shm transport: the aggressor feeds through a
+    shared-memory ring, so admission lands at the RING HEAD and the
+    over-budget backlog parks in the segment — throttled, never
+    dropped, victim untouched."""
+    from kubedtn_tpu.scenarios import noisy_neighbor
+
+    out = noisy_neighbor(victim_pairs=1, aggressor_pairs=1,
+                         seconds=1.0, victim_rate_fps=800,
+                         aggressor_rate_fps=8_000,
+                         aggressor_budget_fps=800,
+                         aggressor_via_shm=True)
+    assert out["in_guardrails"], out
+    assert out["aggressor_transport"] == "shm"
+    assert out["victim_lost"] == 0
+    assert out["throttle_events"] > 0
+    assert out["shm"]["throttled_events"] > 0  # verdicts at ring head
+    # exact accounting: every unadmitted frame is parked in the ring
+    # (or the sender's outage buffer), none dropped
+    assert (out["aggressor_admitted"] + out["aggressor_queued_not_dropped"]
+            == out["aggressor_fed"])
+    assert out["dropped"] == 0
+
+
 def test_throttle_verdicts_are_typed_and_metered():
     spec = {"busy": [(1, 0)]}
     plane, reg, wires = _tenant_plane(spec, budgets={"busy": 10.0})
