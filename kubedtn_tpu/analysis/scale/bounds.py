@@ -11,6 +11,7 @@ vocabulary in `entrypoints.py`:
 - a ``range()`` whose argument mentions a capacity bound name, or an
   iteration over a capacity-classified container → ``O(capacity)``;
 - iteration over the tenant registry → ``O(tenants)``;
+- iteration over the autopilot's candidate lattice → ``O(grid)``;
 - everything else (batch parameters, local collections, unresolvable
   names) → ``O(rows_touched)`` — the conservative default that keeps
   the pass quiet on the batch-shaped hot loops;
@@ -43,12 +44,14 @@ from kubedtn_tpu.analysis.scale.entrypoints import (
     CAPACITY_CONTAINERS,
     CAPACITY_LISTS,
     CLASS_CAPACITY,
+    CLASS_GRID,
     CLASS_O1,
     CLASS_ORDER,
     CLASS_RANK,
     CLASS_ROWS,
     CLASS_SUPER,
     CLASS_TENANTS,
+    GRID_CONTAINERS,
     SCALE_ENTRIES,
     TENANT_CONTAINERS,
 )
@@ -78,6 +81,8 @@ def _name_class(name: str) -> str | None:
         return CLASS_CAPACITY
     if name in TENANT_CONTAINERS:
         return CLASS_TENANTS
+    if name in GRID_CONTAINERS:
+        return CLASS_GRID
     return None
 
 

@@ -13,6 +13,10 @@ class                   meaning (Python-level work per invocation)
 ``O(rows_touched)``     bounded by the operation's own batch — frames
                         drained this tick, links in this call, rows in
                         this journal — never by how big the plane is
+``O(grid)``             bounded by a fixed search lattice — the
+                        autopilot's candidate grid (fixed rungs + a
+                        seeded exploration block of configured width)
+                        times the tenant's own edges, never plane size
 ``O(tenants)``          one pass over the tenant registry (drain-policy
                         snapshot) is allowed on top of rows_touched
 ``O(capacity)``         linear in the SoA — legal only for the paths
@@ -37,12 +41,13 @@ from __future__ import annotations
 
 CLASS_O1 = "O(1)"
 CLASS_ROWS = "O(rows_touched)"
+CLASS_GRID = "O(grid)"
 CLASS_TENANTS = "O(tenants)"
 CLASS_CAPACITY = "O(capacity)"
 CLASS_SUPER = "O(capacity x N)"   # nested/superlinear — never budgeted
 
-CLASS_ORDER = (CLASS_O1, CLASS_ROWS, CLASS_TENANTS, CLASS_CAPACITY,
-               CLASS_SUPER)
+CLASS_ORDER = (CLASS_O1, CLASS_ROWS, CLASS_GRID, CLASS_TENANTS,
+               CLASS_CAPACITY, CLASS_SUPER)
 CLASS_RANK = {c: i for i, c in enumerate(CLASS_ORDER)}
 
 # ---- bound-classification vocabulary ----------------------------------
@@ -74,6 +79,10 @@ CAPACITY_LISTS = {"_free"}
 TENANT_CONTAINERS = {"_tenants", "_ns_map", "ns_map", "_holds",
                      "_masks", "tenants", "_watch", "_handles",
                      "_placements", "placements", "_cordoned"}
+# search-lattice containers: the autopilot's candidate grid and its
+# exploration lattice — sized by (fixed rungs + configured width),
+# never by the plane. One pass = O(grid).
+GRID_CONTAINERS = {"grid", "lattice", "candidates", "ranked"}
 
 # ---- entries ----------------------------------------------------------
 # name -> (budget class, ((path, qualname), ...) call-graph roots).
@@ -94,6 +103,10 @@ _PLC = "kubedtn_tpu/federation/placement.py"
 _TEL = "kubedtn_tpu/telemetry.py"
 _SLO = "kubedtn_tpu/slo/evaluator.py"
 _SLF = "kubedtn_tpu/slo/fleet.py"
+_APC = "kubedtn_tpu/autopilot/candidates.py"
+_APS = "kubedtn_tpu/autopilot/search.py"
+_APA = "kubedtn_tpu/autopilot/actuator.py"
+_APK = "kubedtn_tpu/autopilot/controller.py"
 
 SCALE_ENTRIES: dict[str, dict] = {
     # the steady data path: host work per tick must scale with the
@@ -303,6 +316,41 @@ SCALE_ENTRIES: dict[str, dict] = {
             (_PLC, "plane_score"),
             (_PLC, "pressure_of"),
             (_PLC, "choose_plane"),
+        ),
+    },
+    # autopilot search: grid generation and scoring walk the candidate
+    # lattice (fixed rungs + seeded width) times the tenant's OWN
+    # edges — O(grid), never O(capacity); the heavy per-replica work
+    # is the one batched twin sweep, which is device-side
+    "autopilot_candidates": {
+        "budget": CLASS_GRID,
+        "roots": (
+            (_APC, "candidate_grid"),
+            (_APC, "_shape"),
+            (_APC, "_scaled_props"),
+            (_APC, "_loss_of"),
+            (_APS, "score_candidates"),
+            (_APS, "_telemetry_row"),
+            (_APS, "_projected"),
+        ),
+    },
+    # autopilot control loop: one verdict read per poll (O(tenants),
+    # the SloEvaluator surface) plus per-tenant state-machine steps;
+    # actuation is per-plan work over the tenant's own topologies
+    "autopilot_poll": {
+        "budget": CLASS_TENANTS,
+        "roots": (
+            (_APK, "Autopilot.poll"),
+            (_APK, "Autopilot._verify_step"),
+            (_APK, "Autopilot._maybe_escalate"),
+            (_APK, "Autopilot._remediate"),
+            (_APK, "Autopilot._edge_props"),
+            (_APK, "Autopilot.status"),
+            (_APA, "actuate"),
+            (_APA, "_actuate_admission"),
+            (_APA, "_shape_plans"),
+            (_APA, "_tenant_topologies"),
+            (_APA, "_copy_back_status"),
         ),
     },
     # the restore half of an evacuation is tenant-scoped: rows_touched

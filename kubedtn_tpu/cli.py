@@ -480,6 +480,165 @@ def _render_slo_table(rows: list[dict], title: str) -> None:
               f"  {status}{extra}")
 
 
+def _autopilot_action_dict(a) -> dict:
+    """One wire AutopilotAction as a JSON-safe dict."""
+    return {
+        "id": a.id, "t": a.t, "tenant": a.tenant, "kind": a.kind,
+        "candidate": a.candidate, "verdict": a.verdict,
+        "reason": a.reason, "staged": a.staged,
+        "rejected": a.rejected, "rolled_back": a.rolled_back,
+        "dry_run": a.dry_run, "candidates": a.candidates,
+        "plans": a.plans, "baseline_burn": a.baseline_burn,
+        "projected_burn": a.projected_burn, "compile_s": a.compile_s,
+        "run_s": a.run_s, "gate_s": a.gate_s, "stage_s": a.stage_s,
+        "time_to_green_s": a.time_to_green_s,
+    }
+
+
+def _autopilot_last_actions(addr: str, tenant: str,
+                            timeout: float) -> list[dict] | None:
+    """Each tenant's last autopilot action from one daemon, or None
+    when the daemon has no autopilot attached / the RPC fails — the
+    `kdt slo` audit column must never break the burn view."""
+    import grpc
+
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+
+    client = DaemonClient(addr)
+    try:
+        resp = client.AutopilotStatus(
+            pb.AutopilotStatusRequest(tenant=tenant or ""),
+            timeout=timeout)
+    except grpc.RpcError:
+        return None
+    finally:
+        client.close()
+    if not resp.ok:
+        return None
+    out = []
+    for s in resp.states:
+        if not s.HasField("last_action"):
+            continue
+        d = _autopilot_action_dict(s.last_action)
+        d["tenant"] = d["tenant"] or s.tenant
+        d["state"] = s.state
+        out.append(d)
+    return out
+
+
+def _render_autopilot_actions(acts: list[dict],
+                              title: str = "") -> None:
+    if title:
+        print(title)
+    print(f"{'tenant':<14}{'id':>5}  {'candidate':<24}"
+          f"{'verdict':<12}{'proj.burn':>10}{'ttg':>8}  reason")
+    for a in acts:
+        ttg = (f"{a['time_to_green_s']:.1f}s"
+               if a.get("time_to_green_s") else "-")
+        print(f"{a['tenant'] or '(fleet)':<14}{a['id']:>5}  "
+              f"{a['candidate'] or '-':<24}{a['verdict'] or '-':<12}"
+              f"{a['projected_burn']:>10.3f}{ttg:>8}"
+              f"  {a['reason'][:60]}")
+
+
+def cmd_autopilot(args) -> int:
+    """`kdt autopilot status|enable|disable|dry-run|history` — the
+    SLO autopilot's operator surface (Local.Autopilot* framework
+    extensions): switch the remediation loop, audit the per-tenant
+    state machine and every action it took (delta id, gate verdict,
+    time-to-green)."""
+    import grpc
+
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+
+    client = DaemonClient(args.daemon)
+    try:
+        if args.action in ("enable", "disable", "dry-run"):
+            wire_action = args.action
+            if args.action == "dry-run":
+                wire_action = ("dry-run-on" if args.value != "off"
+                               else "dry-run-off")
+            resp = client.AutopilotCtl(
+                pb.AutopilotCtlRequest(action=wire_action),
+                timeout=args.timeout)
+            if not resp.ok:
+                print(f"autopilot: {resp.error}", file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps({"enabled": resp.enabled,
+                                  "dry_run": resp.dry_run}))
+            else:
+                print(f"autopilot enabled={resp.enabled} "
+                      f"dry_run={resp.dry_run}")
+            return 0
+        history = (int(args.limit) if args.action == "history"
+                   else 0)
+        resp = client.AutopilotStatus(
+            pb.AutopilotStatusRequest(tenant=args.tenant or "",
+                                      history=history),
+            timeout=args.timeout)
+    except grpc.RpcError as e:
+        print(f"autopilot: daemon RPC failed: {_rpc_code(e)}",
+              file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+    if not resp.ok:
+        print(f"autopilot: {resp.error}", file=sys.stderr)
+        return 1
+    states = [{
+        "tenant": s.tenant, "state": s.state, "pages": s.pages,
+        "fails": s.fails, "hold_remaining_s": s.hold_remaining_s,
+        **({"last_action": _autopilot_action_dict(s.last_action)}
+           if s.HasField("last_action") else {}),
+    } for s in resp.states]
+    actions = [_autopilot_action_dict(a) for a in resp.actions]
+    if args.json:
+        print(json.dumps(_json_safe({
+            "enabled": resp.enabled, "dry_run": resp.dry_run,
+            "running": resp.running, "states": states,
+            "actions": actions,
+            "pages_seen": resp.pages_seen,
+            "searches_run": resp.searches_run,
+            "deltas_staged": resp.deltas_staged,
+            "deltas_rejected": resp.deltas_rejected,
+            "deltas_rolled_back": resp.deltas_rolled_back,
+            "escalations": resp.escalations})))
+        return 0
+    if args.action == "history":
+        if not actions:
+            print("autopilot: no actions recorded yet")
+            return 0
+        _render_autopilot_actions(
+            actions, title=f"autopilot history via {args.daemon} "
+                           f"({len(actions)} action(s))")
+        return 0
+    print(f"autopilot via {args.daemon} — "
+          f"enabled={resp.enabled} dry_run={resp.dry_run} "
+          f"running={resp.running}")
+    print(f"pages={resp.pages_seen} searches={resp.searches_run} "
+          f"staged={resp.deltas_staged} "
+          f"rejected={resp.deltas_rejected} "
+          f"rolled_back={resp.deltas_rolled_back} "
+          f"escalations={resp.escalations}")
+    if not states:
+        print("no tenants observed yet")
+        return 0
+    print(f"{'tenant':<14}{'state':<10}{'pages':>6}{'fails':>6}"
+          f"{'hold':>8}  last action")
+    for s in states:
+        hold = (f"{s['hold_remaining_s']:.1f}s"
+                if s["hold_remaining_s"] else "-")
+        la = s.get("last_action")
+        last = (f"#{la['id']} {la['candidate'] or la['kind']} "
+                f"-> {la['verdict']}" if la else "-")
+        print(f"{s['tenant']:<14}{s['state']:<10}{s['pages']:>6}"
+              f"{s['fails']:>6}{hold:>8}  {last}")
+    return 0
+
+
 def cmd_slo(args) -> int:
     """`kdt slo [--tenant T] [--fleet]` — the SLO observability plane's
     operator surface (Local.ObserveSLO): per-tenant attainment vs
@@ -574,14 +733,27 @@ def cmd_slo(args) -> int:
                  f"windows closed, {resp.evaluations} evaluations")
     if args.tenant:
         rows = [r for r in rows if r["tenant"] == args.tenant]
+    # the autopilot's audit trail rides the same command the operator
+    # uses to see the burn: each tenant's last action (single-daemon
+    # views only — the fleet merge has no one autopilot to ask)
+    autopilot = None
+    if len(daemons) == 1:
+        autopilot = _autopilot_last_actions(
+            daemons[0], args.tenant or "", args.timeout)
     if args.json:
-        print(json.dumps(_json_safe({"tenants": rows})))
+        out = {"tenants": rows}
+        if autopilot is not None:
+            out["autopilot"] = autopilot
+        print(json.dumps(_json_safe(out)))
         return 0
     if not rows:
         print("slo: no tenants evaluated yet (no tenancy registry, "
               "or no telemetry windows closed)", file=sys.stderr)
         return 1
     _render_slo_table(rows, title)
+    if autopilot:
+        _render_autopilot_actions(
+            autopilot, title="autopilot last actions:")
     return 0
 
 
@@ -991,7 +1163,7 @@ def cmd_daemon(args) -> int:
                                "kubedtn-fleet"))
     fleet = FleetSupervisor(federation, fleet_root).attach()
     fleet.start(interval_s=2.0)
-    slo_eval = None
+    slo_eval = autopilot = None
     if not getattr(args, "no_telemetry", False):
         # link telemetry plane: per-edge window ring + sampled flight
         # recorder, riding the fused tick (no extra device dispatch)
@@ -1011,6 +1183,22 @@ def cmd_daemon(args) -> int:
         slo_eval.start()
         log.info("slo evaluation on %s", fields(
             window_s=getattr(args, "telemetry_window", 1.0)))
+        # SLO autopilot: the closed loop from a paging burn verdict to
+        # a twin-gated staged remediation (Local.Autopilot* / `kdt
+        # autopilot` / kubedtn_autopilot_*). The sidecar always runs;
+        # remediation stays OFF until `kdt autopilot enable` (or
+        # --autopilot) flips it — observing is free, acting is opt-in.
+        from kubedtn_tpu.autopilot import Autopilot
+
+        autopilot = Autopilot(tenancy, dataplane, slo_eval,
+                              fleet=fleet).attach(daemon)
+        if getattr(args, "autopilot", False):
+            autopilot.enable()
+        if getattr(args, "autopilot_dry_run", False):
+            autopilot.set_dry_run(True)
+        autopilot.start(poll_s=getattr(args, "autopilot_poll", 1.0))
+        log.info("slo autopilot on %s", fields(
+            enabled=autopilot.enabled, dry_run=autopilot.dry_run))
     shard = getattr(args, "shard_mesh", 0)
     if shard:
         # edge-sharded live plane: SoA columns block-shard across the
@@ -1091,7 +1279,7 @@ def cmd_daemon(args) -> int:
                                    tenancy=tenancy,
                                    migration_stats=migration_stats,
                                    fleet=fleet, slo=slo_eval,
-                                   shm=shm_ingest)
+                                   shm=shm_ingest, autopilot=autopilot)
     engine.stats.observer = hist
     daemon.hist = hist
     server, port = make_server(daemon, port=args.port)
@@ -1135,6 +1323,8 @@ def cmd_daemon(args) -> int:
         server.wait_for_termination()
     except KeyboardInterrupt:
         fleet.stop()
+        if autopilot is not None:
+            autopilot.stop()
         if slo_eval is not None:
             slo_eval.stop()
         if autosaver is not None:
@@ -1710,6 +1900,27 @@ def main(argv=None) -> int:
     slp.add_argument("--timeout", type=float, default=30.0)
     slp.set_defaults(fn=cmd_slo)
 
+    app = sub.add_parser(
+        "autopilot",
+        help="SLO autopilot: the burn-page → twin-gated staged "
+             "remediation loop (Local.AutopilotCtl / AutopilotStatus)")
+    app.add_argument("action",
+                     choices=("status", "enable", "disable", "dry-run",
+                              "history"))
+    app.add_argument("value", nargs="?", default="on",
+                     choices=("on", "off"),
+                     help="dry-run only: on (gate + rank, stage "
+                          "nothing) or off")
+    app.add_argument("--daemon", default="127.0.0.1:51111",
+                     metavar="HOST:PORT")
+    app.add_argument("--tenant", default="",
+                     help="restrict status/history to this tenant")
+    app.add_argument("--limit", type=int, default=50,
+                     help="history entries to show (newest first)")
+    app.add_argument("--json", action="store_true")
+    app.add_argument("--timeout", type=float, default=30.0)
+    app.set_defaults(fn=cmd_autopilot)
+
     tnp = sub.add_parser(
         "tenant",
         help="multi-tenant plane: create/list/quota/stats against a "
@@ -1795,6 +2006,19 @@ def main(argv=None) -> int:
                          "it feeds the data plane directly — "
                          "admission enforced at the ring head, gRPC "
                          "kept as the compatibility fallback")
+    dp.add_argument("--autopilot", action="store_true",
+                    help="enable the SLO autopilot sidecar at boot "
+                         "(burn page → candidate sweep → twin-gated "
+                         "staged remediation; attached but disabled "
+                         "otherwise — flip live with `kdt autopilot "
+                         "enable`)")
+    dp.add_argument("--autopilot-dry-run", action="store_true",
+                    help="autopilot evaluates and gates but stages "
+                         "nothing (audit mode)")
+    dp.add_argument("--autopilot-poll", type=float, default=1.0,
+                    metavar="SECONDS",
+                    help="autopilot control-loop poll period "
+                         "(default 1s)")
     dp.add_argument("--migration-journal", default=None, metavar="DIR",
                     help="journal root for live tenant migrations "
                          "(default: <checkpoint-dir>-migrations — a "

@@ -624,6 +624,104 @@ class SloStatsCollector:
         return out
 
 
+class AutopilotStatsCollector:
+    """kubedtn_autopilot_* series from the SLO autopilot
+    (kubedtn_tpu.autopilot) — the remediation loop's scrape face: the
+    loop switches (enabled / dry-run / sidecar running), each tenant's
+    state-machine position and hysteresis counters, and the cumulative
+    action ledger (pages seen, searches run, deltas staged / rejected
+    / rolled back, escalations) plus where the wall time went (sweep
+    compile vs run, time-to-green).
+
+    Cardinality guard (the SloStatsCollector truncation-guard
+    pattern): per-tenant series for at most `max_tenants` tenants,
+    name-sorted so the kept set is stable across scrapes, the tail
+    counted by `kubedtn_autopilot_series_truncated`."""
+
+    GAUGE_KEYS = (
+        ("state", "Autopilot state machine position (0=observe, "
+                  "1=search, 2=stage, 3=verify, 4=hold)"),
+        ("pages", "Consecutive paging polls observed (hysteresis "
+                  "counter; resets on remediation or recovery)"),
+        ("fails", "Consecutive failed remediations (feeds fleet "
+                  "escalation)"),
+        ("hold_remaining_s", "Seconds of cooldown left before the "
+                             "tenant can page again (0 = armed)"),
+    )
+    COUNTER_SNAP = (
+        ("pages_seen", "Page-severity verdicts that entered the loop"),
+        ("searches_run", "Candidate sweeps run (one batched twin "
+                         "sweep each)"),
+        ("candidates_evaluated", "Candidate replicas scored across "
+                                 "all sweeps"),
+        ("deltas_staged", "Winning deltas staged onto the live plane"),
+        ("deltas_rolled_back", "Staged deltas the watch rolled back"),
+        ("deltas_rejected", "Winning deltas the twin gate rejected"),
+        ("quota_actions", "Admission-plane actions (quota trim / "
+                          "drain boost)"),
+        ("escalations", "Fleet rebalance escalations triggered"),
+        ("no_candidate", "Searches where nothing beat the baseline"),
+        ("dry_runs", "Actions evaluated but not staged (dry-run)"),
+        ("greens", "Remediations verified back below page severity"),
+        ("stales", "Remediations that never went green in the verify "
+                   "window"),
+        ("errors", "Remediation attempts that raised"),
+        ("time_to_green_s", "Wall seconds from page to verified "
+                            "green, summed"),
+        ("sweep_compile_s", "Wall seconds compiling candidate sweeps"),
+        ("sweep_run_s", "Wall seconds executing candidate sweeps"),
+    )
+
+    def __init__(self, autopilot, max_tenants: int = 256) -> None:
+        self._ap = autopilot
+        self._max_tenants = max_tenants
+
+    def collect(self):
+        from kubedtn_tpu.autopilot.controller import STATE_LEVELS
+
+        st = self._ap.status()
+        out = []
+        for key, doc in (("enabled", "1 = the autopilot acts on pages"),
+                         ("dry_run", "1 = evaluate and gate only, "
+                                     "stage nothing"),
+                         ("running", "1 = the sidecar poll thread is "
+                                     "alive")):
+            g = GaugeMetricFamily(f"kubedtn_autopilot_{key}", doc)
+            g.add_metric([], 1.0 if st[key] else 0.0)
+            out.append(g)
+        tenants = st["tenants"]
+        names = sorted(tenants)
+        truncated = max(0, len(names) - self._max_tenants)
+        fams = {}
+        for key, doc in self.GAUGE_KEYS:
+            fams[key] = GaugeMetricFamily(f"kubedtn_autopilot_{key}",
+                                          doc, labels=["tenant"])
+        for name in names[:self._max_tenants]:
+            t = tenants[name]
+            lab = [name]
+            vals = {
+                "state": STATE_LEVELS.get(t["state"], -1),
+                "pages": t["pages"],
+                "fails": t["fails"],
+                "hold_remaining_s": t["hold_remaining_s"],
+            }
+            for key, fam in fams.items():
+                fam.add_metric(lab, float(vals[key]))
+        out.extend(fams.values())
+        snap = st["stats"]
+        for key, doc in self.COUNTER_SNAP:
+            c = CounterMetricFamily(f"kubedtn_autopilot_{key}", doc)
+            c.add_metric([], float(snap[key]))
+            out.append(c)
+        trunc = GaugeMetricFamily(
+            "kubedtn_autopilot_series_truncated",
+            "Tenants beyond the per-tenant autopilot series cap "
+            "(0 = full coverage)")
+        trunc.add_metric([], float(truncated))
+        out.append(trunc)
+        return out
+
+
 class WhatIfStatsCollector:
     """kubedtn_whatif_* counters — observability for daemon-served
     what-if sweeps (kubedtn_tpu.twin.query): volume served (sweeps,
@@ -928,7 +1026,7 @@ def make_registry(engine=None, sim_counters_fn=None,
                   max_interfaces: int = 10_000, dataplane=None,
                   whatif_stats=None, update_stats=None, tenancy=None,
                   max_tenants: int = 256, migration_stats=None,
-                  fleet=None, slo=None, shm=None):
+                  fleet=None, slo=None, shm=None, autopilot=None):
     """Registry with the parity collectors installed."""
     registry = CollectorRegistry()
     hist = LatencyHistograms(registry)
@@ -956,4 +1054,7 @@ def make_registry(engine=None, sim_counters_fn=None,
                                             max_tenants=max_tenants))
     if shm is not None:
         registry.register(ShmStatsCollector(shm))
+    if autopilot is not None:
+        registry.register(AutopilotStatsCollector(
+            autopilot, max_tenants=max_tenants))
     return registry, hist

@@ -541,6 +541,68 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
         _field("migrations", 4, I32),
         _field("frames_lost_known", 5, B),
     ))
+    f.message_type.append(_msg(
+        "AutopilotCtlRequest",
+        # enable | disable | dry-run-on | dry-run-off
+        _field("action", 1, S),
+    ))
+    f.message_type.append(_msg(
+        "AutopilotCtlResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("enabled", 3, B), _field("dry_run", 4, B),
+    ))
+    f.message_type.append(_msg(
+        "AutopilotStatusRequest",
+        _field("tenant", 1, S),      # empty = every tenant
+        _field("history", 2, I32),   # action records to return (0=none)
+    ))
+    f.message_type.append(_msg(
+        "AutopilotAction",
+        _field("id", 1, I64),
+        _field("t", 2, D),
+        _field("tenant", 3, S),
+        _field("kind", 4, S),        # shape|reroute|quota|drain|escalate
+        _field("candidate", 5, S),
+        _field("verdict", 6, S),     # staged|green|stale|rejected|...
+        _field("reason", 7, S),
+        _field("staged", 8, B),
+        _field("rejected", 9, B),
+        _field("rolled_back", 10, B),
+        _field("dry_run", 11, B),
+        _field("candidates", 12, I32),
+        _field("plans", 13, I32),
+        _field("baseline_burn", 14, D),
+        _field("projected_burn", 15, D),
+        _field("compile_s", 16, D),
+        _field("run_s", 17, D),
+        _field("gate_s", 18, D),
+        _field("stage_s", 19, D),
+        _field("time_to_green_s", 20, D),
+    ))
+    f.message_type.append(_msg(
+        "AutopilotTenantState",
+        _field("tenant", 1, S),
+        _field("state", 2, S),       # observe|verify|hold
+        _field("pages", 3, I64),
+        _field("fails", 4, I32),
+        _field("hold_remaining_s", 5, D),
+        _field("last_action", 6, None, type_name="AutopilotAction"),
+    ))
+    f.message_type.append(_msg(
+        "AutopilotStatusResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("enabled", 3, B), _field("dry_run", 4, B),
+        _field("running", 5, B),
+        _field("states", 6, None, REP,
+               type_name="AutopilotTenantState"),
+        _field("actions", 7, None, REP, type_name="AutopilotAction"),
+        _field("pages_seen", 8, I64),
+        _field("searches_run", 9, I64),
+        _field("deltas_staged", 10, I64),
+        _field("deltas_rejected", 11, I64),
+        _field("deltas_rolled_back", 12, I64),
+        _field("escalations", 13, I64),
+    ))
     return f
 
 
@@ -570,7 +632,10 @@ for _name in ("LinkProperties", "Link", "Pod", "PodQuery",
               "HealthRequest", "HealthResponse", "PlaneStatus",
               "PlacementEntry", "FleetStatusRequest",
               "FleetStatusResponse", "FleetUpgradeRequest",
-              "UpgradeReport", "FleetUpgradeResponse"):
+              "UpgradeReport", "FleetUpgradeResponse",
+              "AutopilotCtlRequest", "AutopilotCtlResponse",
+              "AutopilotStatusRequest", "AutopilotAction",
+              "AutopilotTenantState", "AutopilotStatusResponse"):
     _MESSAGES[_name] = message_factory.GetMessageClass(
         _pool.FindMessageTypeByName(f"{PACKAGE}.{_name}"))
 
@@ -629,6 +694,12 @@ FleetStatusResponse = _MESSAGES["FleetStatusResponse"]
 FleetUpgradeRequest = _MESSAGES["FleetUpgradeRequest"]
 UpgradeReport = _MESSAGES["UpgradeReport"]
 FleetUpgradeResponse = _MESSAGES["FleetUpgradeResponse"]
+AutopilotCtlRequest = _MESSAGES["AutopilotCtlRequest"]
+AutopilotCtlResponse = _MESSAGES["AutopilotCtlResponse"]
+AutopilotStatusRequest = _MESSAGES["AutopilotStatusRequest"]
+AutopilotAction = _MESSAGES["AutopilotAction"]
+AutopilotTenantState = _MESSAGES["AutopilotTenantState"]
+AutopilotStatusResponse = _MESSAGES["AutopilotStatusResponse"]
 
 # Service method tables: name -> (request class, response class, streaming)
 LOCAL_METHODS = {
@@ -683,6 +754,13 @@ LOCAL_METHODS = {
     "Health": (HealthRequest, HealthResponse, False),
     "FleetStatus": (FleetStatusRequest, FleetStatusResponse, False),
     "FleetUpgrade": (FleetUpgradeRequest, FleetUpgradeResponse, False),
+    # Framework extensions: the SLO autopilot — the closed loop from a
+    # paging burn verdict to a twin-gated staged remediation
+    # (kubedtn_tpu.autopilot; `kdt autopilot` reads these — not in the
+    # reference IDL)
+    "AutopilotCtl": (AutopilotCtlRequest, AutopilotCtlResponse, False),
+    "AutopilotStatus": (AutopilotStatusRequest,
+                        AutopilotStatusResponse, False),
 }
 REMOTE_METHODS = {
     "Update": (RemotePod, BoolResponse, False),

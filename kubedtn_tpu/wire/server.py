@@ -477,6 +477,10 @@ class Daemon:
         # Local.ObserveSLO surface (absent = the RPC answers ok=False
         # "slo evaluation not enabled")
         self.slo = None
+        # autopilot.Autopilot installed by its attach(): the
+        # Local.AutopilotCtl / Local.AutopilotStatus surface (absent =
+        # the RPCs answer ok=False "autopilot not attached")
+        self.autopilot = None
         # optional shm.ShmIngest — the shared-memory ingest plane:
         # drain_ingress folds each attached ring's committed frames
         # into its batches (admission at the ring head, backlog into
@@ -745,6 +749,100 @@ class Daemon:
             ok=True, plane=plane_name, tenants=rows,
             windows_closed=tel.windows_closed if tel else 0,
             evaluations=snap["evaluations"])
+
+    @staticmethod
+    def _autopilot_action_msg(rec: dict) -> "pb.AutopilotAction":
+        return pb.AutopilotAction(
+            id=int(rec.get("id", 0)), t=float(rec.get("t", 0.0)),
+            tenant=rec.get("tenant", ""), kind=rec.get("kind", ""),
+            candidate=rec.get("candidate", ""),
+            verdict=rec.get("verdict", ""),
+            reason=rec.get("reason", ""),
+            staged=bool(rec.get("staged", False)),
+            rejected=bool(rec.get("rejected", False)),
+            rolled_back=bool(rec.get("rolled_back", False)),
+            dry_run=bool(rec.get("dry_run", False)),
+            candidates=int(rec.get("candidates", 0)),
+            plans=int(rec.get("plans", 0)),
+            baseline_burn=float(rec.get("baseline_burn", 0.0)),
+            projected_burn=float(rec.get("projected_burn", 0.0)),
+            compile_s=float(rec.get("compile_s", 0.0)),
+            run_s=float(rec.get("run_s", 0.0)),
+            gate_s=float(rec.get("gate_s", 0.0)),
+            stage_s=float(rec.get("stage_s", 0.0)),
+            time_to_green_s=float(rec.get("time_to_green_s", 0.0)))
+
+    def AutopilotCtl(self, request, context):
+        """Framework extension: the autopilot's switches —
+        enable/disable the loop, toggle dry-run (gate-and-record
+        without staging). kubedtn_tpu.autopilot."""
+        ap = self.autopilot
+        if ap is None:
+            return pb.AutopilotCtlResponse(
+                ok=False, error="autopilot not attached to this daemon")
+        try:
+            action = request.action or "status"
+            if action == "enable":
+                ap.enable()
+            elif action == "disable":
+                ap.disable()
+            elif action == "dry-run-on":
+                ap.set_dry_run(True)
+            elif action == "dry-run-off":
+                ap.set_dry_run(False)
+            elif action != "status":
+                return pb.AutopilotCtlResponse(
+                    ok=False, error=f"unknown action {action!r} "
+                    f"(enable|disable|dry-run-on|dry-run-off|status)")
+            return pb.AutopilotCtlResponse(
+                ok=True, enabled=ap.enabled, dry_run=ap.dry_run)
+        except Exception as e:
+            return pb.AutopilotCtlResponse(
+                ok=False, error=f"{type(e).__name__}: {e}")
+
+    def AutopilotStatus(self, request, context):
+        """Framework extension: the autopilot's per-tenant state
+        machine positions, each tenant's last action, and (with
+        `history` > 0) the action ring — the `kdt autopilot` audit
+        surface."""
+        ap = self.autopilot
+        if ap is None:
+            return pb.AutopilotStatusResponse(
+                ok=False, error="autopilot not attached to this daemon")
+        try:
+            st = ap.status()
+            states = []
+            for name, s in st["tenants"].items():
+                if request.tenant and name != request.tenant:
+                    continue
+                msg = pb.AutopilotTenantState(
+                    tenant=name, state=s["state"],
+                    pages=int(s["pages"]), fails=int(s["fails"]),
+                    hold_remaining_s=float(s["hold_remaining_s"]))
+                if s.get("last_action"):
+                    msg.last_action.CopyFrom(
+                        self._autopilot_action_msg(s["last_action"]))
+                states.append(msg)
+            actions = []
+            if request.history:
+                actions = [self._autopilot_action_msg(r)
+                           for r in ap.history(
+                               tenant=request.tenant,
+                               limit=int(request.history))]
+            snap = st["stats"]
+            return pb.AutopilotStatusResponse(
+                ok=True, enabled=st["enabled"],
+                dry_run=st["dry_run"], running=st["running"],
+                states=states, actions=actions,
+                pages_seen=int(snap["pages_seen"]),
+                searches_run=int(snap["searches_run"]),
+                deltas_staged=int(snap["deltas_staged"]),
+                deltas_rejected=int(snap["deltas_rejected"]),
+                deltas_rolled_back=int(snap["deltas_rolled_back"]),
+                escalations=int(snap["escalations"]))
+        except Exception as e:
+            return pb.AutopilotStatusResponse(
+                ok=False, error=f"{type(e).__name__}: {e}")
 
     def ObserveTrace(self, request, context):
         """Framework extension: flight-recorder event export — one

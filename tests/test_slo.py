@@ -369,6 +369,44 @@ def test_guardrails_from_slo():
     assert Guardrails.from_slo(spec, ticks=100).ticks == 100
 
 
+def test_guardrails_from_slo_property():
+    """Property: EVERY SloSpec — the three QoS defaults plus seeded
+    random specs, with and without overrides — maps to guardrails
+    that ACCEPT a no-op plan (the candidate holds the healthy
+    baseline) and REJECT a candidate whose delivery falls through
+    the spec's own floor or whose p99 breaks the spec's bound."""
+    from kubedtn_tpu.updates.gate import Guardrails
+
+    rng = np.random.default_rng(19)
+    specs = [SloSpec.for_qos(q) for q in ("gold", "silver", "bronze")]
+    for _ in range(25):
+        specs.append(SloSpec(
+            delivery_ratio_floor=float(rng.uniform(0.9, 0.9999)),
+            p99_bound_us=float(rng.uniform(5_000.0, 1_000_000.0))))
+    for spec in specs:
+        for overrides in ({}, {"ticks": 123, "seed": 9,
+                               "min_p99_slack_us": 250.0}):
+            g = Guardrails.from_slo(spec, **overrides)
+            for k, val in overrides.items():
+                assert getattr(g, k) == val
+            # the thresholds ARE the spec's promises
+            assert g.max_delivery_drop == pytest.approx(
+                1.0 - spec.delivery_ratio_floor, abs=1e-6)
+            assert g.max_p99_us == spec.p99_bound_us
+            healthy_p99 = spec.p99_bound_us * 0.5
+            # a no-op plan (candidate == healthy baseline) passes
+            ok, why = g.check(1.0, healthy_p99, 1.0, healthy_p99)
+            assert ok, (spec, why)
+            # delivery through the spec's floor is rejected
+            ok, why = g.check(spec.delivery_ratio_floor - 1e-4,
+                              healthy_p99, 1.0, healthy_p99)
+            assert not ok and "delivery" in why, (spec, why)
+            # the absolute p99 bound binds regardless of baseline
+            ok, why = g.check(1.0, spec.p99_bound_us * 1.01,
+                              1.0, spec.p99_bound_us * 1.01)
+            assert not ok and "SLO bound" in why, (spec, why)
+
+
 # -- evaluator over a live plane (tier-1 smoke, <30s) -------------------
 
 def test_evaluator_live_plane_smoke():
