@@ -910,6 +910,72 @@ def main() -> None:
                 "all_ok", "tick_errors_off", "tick_errors_on")
             if k in r}
 
+    def run_pause_observability():
+        # pause/stall observability evidence, the two numbers the
+        # savail availability budget judges: (1) ledger hook overhead
+        # on the plane-only probe, off vs on interleaved, bar < 2%;
+        # (2) a live plane under load takes a forced checkpoint
+        # (save_live barrier), real churn + compact(), and one staged
+        # update through the real stager — every pause attributed in
+        # the ledger with cause + duration + rows touched.
+        # Process-isolated like the live phases.
+        r = _isolated_scenario("pause_observability", {
+            "pairs": 4,
+            "frames_per_wire": 8_000 if degraded else 20_000,
+            "rounds": 3 if degraded else 5,
+            "load_frames_per_wire": 10_000 if degraded else 20_000})
+        extras["pause_observability"] = {
+            k: r[k] for k in (
+                "pairs", "frames_per_wire", "rounds",
+                "rounds_off_frames_per_s", "rounds_on_frames_per_s",
+                "frames_per_s_off", "frames_per_s_on",
+                "hook_overhead_pct", "hook_overhead_pct_best",
+                "meets_2pct_target", "stalled_first_attempt",
+                "load_window_s", "causes", "all_attributed",
+                "compact_moved", "staged_rounds", "dropped_events",
+                "tick_errors_off", "tick_errors_on") if k in r}
+        # standalone record: the artifact `python -m kubedtn_tpu.analysis
+        # --scale` (savail rule) gates against — wall_s is the measured
+        # load window, causes are the ledger aggregates inside it
+        try:
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_pauses.json"), "w") as f:
+                json.dump({
+                    "record": "pause_observability",
+                    "note": (
+                        "Barrier-pause attribution record "
+                        "(process-isolated plane-only probe): a live "
+                        "plane under load takes a forced live "
+                        "checkpoint, churn + compact(), and one "
+                        "staged update; every pause lands in the "
+                        "PauseLedger with cause/duration/rows, and "
+                        "the ledger's own hook overhead is measured "
+                        "off-vs-on (< 2% bar). Checked by the savail "
+                        "rule in `python -m kubedtn_tpu.analysis "
+                        "--scale` against SCALE_BUDGET.json "
+                        "`availability`. Reproduce: python bench.py "
+                        "(pause_observability phase) or python -m "
+                        "kubedtn_tpu.cli scenario pause_observability."),
+                    "host": {
+                        "platform": platform.platform(),
+                        "cpus": os.cpu_count(),
+                    },
+                    "when": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime()),
+                    "wall_s": r.get("load_window_s"),
+                    "hook_overhead_pct": r.get("hook_overhead_pct"),
+                    "hook_overhead_pct_best":
+                        r.get("hook_overhead_pct_best"),
+                    "causes": r.get("causes", {}),
+                    "forced": r.get("forced", {}),
+                    "all_attributed": r.get("all_attributed"),
+                    "tick_hist": r.get("tick_hist", {}),
+                    "tick_edges_s": r.get("tick_edges_s", []),
+                }, f, indent=1)
+        except OSError as e:
+            log(f"pause record write failed: {e!r}")
+
     def run_burn_recovery():
         # SLO-autopilot closed-loop evidence: inject loss on a gold
         # tenant until the fast burn pages, then the autopilot's whole
@@ -1142,6 +1208,7 @@ def main() -> None:
     phase("fleet_rolling_upgrade", run_fleet_rolling_upgrade)
     phase("telemetry_overhead", run_telemetry_overhead)
     phase("slo_overhead", run_slo_overhead)
+    phase("pause_observability", run_pause_observability)
     phase("burn_recovery", run_burn_recovery)
     phase("whatif_sweep", run_whatif_sweep)
     phase("reconverge_10k", run_reconverge_10k)
@@ -1172,5 +1239,109 @@ def main() -> None:
     }))
 
 
+def _index_entry(name: str, doc: dict) -> dict:
+    """Pull the cross-run key series out of one BENCH record, whatever
+    its vintage/shape: full run records ({parsed: {extras}} or
+    {extras}), partial snapshots ({phases_done, extras}), and the
+    standalone {record, result}/flat records each keep their series
+    under a different roof."""
+    entry: dict = {"file": name}
+    parsed = doc.get("parsed") if isinstance(doc.get("parsed"), dict) \
+        else None
+    body = parsed or doc
+    if isinstance(body.get("value"), (int, float)):
+        entry["link_updates_per_s"] = body["value"]
+    extras = body.get("extras") or doc.get("extras") or {}
+    result = doc.get("result") or {}
+
+    def series(src: dict, path: list, out_key: str, rnd: int = 1):
+        v = src
+        for k in path:
+            v = v.get(k) if isinstance(v, dict) else None
+            if v is None:
+                return
+        if isinstance(v, (int, float)):
+            entry[out_key] = round(v, rnd)
+
+    series(extras, ["live_soak", "sustained_frames_per_s"],
+           "soak_frames_per_s")
+    series(extras, ["live_plane", "frames_per_s"], "plane_frames_per_s")
+    series(extras, ["telemetry_overhead", "overhead_pct"],
+           "telemetry_overhead_pct", 2)
+    series(extras, ["slo_overhead", "overhead_pct"],
+           "slo_overhead_pct", 2)
+    series(extras, ["pause_observability", "hook_overhead_pct"],
+           "pause_hook_overhead_pct", 2)
+    # host-scale slopes: in-run extras or the standalone record's
+    # top-level `phases`
+    phases = ((extras.get("host_scale") or {}).get("phases")
+              or (doc.get("phases") if doc.get("record") ==
+                  "host_scale_1m" or "in_budget" in doc else None))
+    if isinstance(phases, dict):
+        slopes = {n: round(ph["slope"], 3) for n, ph in phases.items()
+                  if isinstance(ph, dict)
+                  and isinstance(ph.get("slope"), (int, float))}
+        if slopes:
+            entry["host_scale_slopes"] = slopes
+    # pause totals: the standalone pause record (or this run's extras)
+    causes = (doc.get("causes")
+              or (extras.get("pause_observability") or {}).get("causes"))
+    if isinstance(causes, dict) and causes:
+        entry["pause_seconds_by_cause"] = {
+            c: round(float(s.get("seconds", 0.0)), 4)
+            for c, s in causes.items() if isinstance(s, dict)}
+        entry["pause_seconds_total"] = round(sum(
+            entry["pause_seconds_by_cause"].values()), 4)
+    for k in ("record", "when", "note"):
+        if k in doc and k != "note":
+            entry[k] = doc[k]
+    if isinstance(doc.get("n"), int):
+        entry["run"] = doc["n"]
+    return entry
+
+
+def history() -> int:
+    """`python bench.py --history`: index every banked BENCH_*.json
+    into BENCH_INDEX.json — one entry per record with the key series
+    (soak frames/s, plane probe, host_scale slopes, pause totals,
+    overhead pcts), sorted by run — so cross-PR trajectory questions
+    read from one file instead of N shapes."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    entries = []
+    skipped = []
+    for name in sorted(os.listdir(here)):
+        if (not name.startswith("BENCH_") or not name.endswith(".json")
+                or name in ("BENCH_INDEX.json", "BENCH_partial.json")):
+            continue
+        try:
+            with open(os.path.join(here, name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            skipped.append({"file": name, "error": repr(e)})
+            continue
+        entries.append(_index_entry(name, doc))
+    # run-numbered records first in run order, then the standalone
+    # records alphabetically — "sorted by run"
+    entries.sort(key=lambda e: (0, e["run"]) if "run" in e
+                 else (1, e["file"]))
+    out = {
+        "note": ("Cross-run bench index, regenerated by `python "
+                 "bench.py --history` — key series per BENCH_* "
+                 "record; see each source file for full evidence."),
+        "generated": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "records": entries,
+        **({"skipped": skipped} if skipped else {}),
+    }
+    path = os.path.join(here, "BENCH_INDEX.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"indexed": len(entries),
+                      "skipped": len(skipped), "path": path}))
+    return 0
+
+
 if __name__ == "__main__":
+    if "--history" in sys.argv[1:]:
+        sys.exit(history())
     main()

@@ -180,10 +180,12 @@ def main(argv: list[str] | None = None) -> int:
             root, use_cache=args.cached,
             update_budgets=args.update_budgets,
             sizes=list(sizes) if sizes else None)
+        from kubedtn_tpu.analysis.core import SCALE_RULES
+
         findings = findings + pfindings
-        scost = [f for f in findings if f.rule == "scost"]
+        scost = [f for f in findings if f.rule in SCALE_RULES]
         scale_section = {
-            "rules": ["scost"],
+            "rules": list(SCALE_RULES),
             "entries": (scale_out or {}).get("entries", {}),
             "budget": (scale_out or {}).get("budget", {}),
             "probe": probe,
@@ -193,10 +195,10 @@ def main(argv: list[str] | None = None) -> int:
                 "unwaivered": sum(1 for f in scost if not f.waived),
             },
         }
-        # scost findings live in the artifact's `scale` section; the
-        # AST section keeps its v1 shape
+        # scost/savail findings live in the artifact's `scale`
+        # section; the AST section keeps its v1 shape
         ast_findings_only = [f for f in findings
-                             if f.rule != "scost"]
+                             if f.rule not in SCALE_RULES]
     else:
         ast_findings_only = findings
 

@@ -44,7 +44,14 @@ JAXPR_RULES = ("jops", "jkey", "jdtype", "jshard", "jtenant", "jcost")
 # lands in the artifact for audit — but the tree policy is FIX, not
 # waive (PR 12 fixed every active finding instead of waivering it).
 RULE_SCOST = "scost"
-SCALE_RULES = (RULE_SCOST,)
+# the dtnscale availability rule: barrier-pause budgets (pause-seconds
+# share of wall clock, single-pause ceilings, ledger hook overhead)
+# checked against the banked BENCH_pauses.json record. Artifact-level
+# like the probe slope gate — there is no source line to waive, the
+# sanctioned overrides are the SCALE_BUDGET.json `availability`
+# section's hand-edited ceilings.
+RULE_SAVAIL = "savail"
+SCALE_RULES = (RULE_SCOST, RULE_SAVAIL)
 
 # the ANALYSIS.json artifact schema. v1: flat dtnlint findings doc
 # (PRs 6-7). v2: adds `schema_version` and the dtnverify `jaxpr`

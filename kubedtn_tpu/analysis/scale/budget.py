@@ -8,6 +8,11 @@ Layout::
       "probe": {
         "sizes": [2048, 8192, 32768],    # default CLI probe sizes
         "max_slope": {"compact": 1.35, ...}
+      },
+      "availability": {                  # savail barrier-pause budgets
+        "max_share": {"checkpoint_save": 0.15, ...},
+        "max_single_pause_s": {"compact": 1.0, ...},
+        "hook_overhead_pct": 2.0
       }
     }
 
@@ -37,6 +42,29 @@ from kubedtn_tpu.analysis.scale.entrypoints import (
 BUDGET_FILE = "SCALE_BUDGET.json"
 _SLOPE_MARGIN = 0.25
 
+# availability (savail) configured defaults — ceilings on each pause
+# cause's share of bench wall clock and on any single pause, plus the
+# ledger's own instrumentation overhead. Generous on purpose: the
+# budget exists to catch a cause REGRESSING (a checkpoint that starts
+# eating half the window), not to flag the forced-barrier bench shape
+# itself. jit_compile is the outlier — a cold XLA compile is seconds
+# by design and only its recurrence (retrace churn) is pathological.
+AVAIL_DEFAULT_MAX_SHARE = {
+    "checkpoint_save": 0.15, "checkpoint_load": 0.15,
+    "compact": 0.10, "staged_update": 0.15,
+    "migration_fork": 0.10, "migration_restore": 0.10,
+    "migration_cutover": 0.05, "pipeline_flush": 0.10,
+    "shm_stall": 0.05, "jit_compile": 0.50, "gc": 0.05,
+}
+AVAIL_DEFAULT_MAX_SINGLE_S = {
+    "checkpoint_save": 2.0, "checkpoint_load": 2.0,
+    "compact": 1.0, "staged_update": 2.0,
+    "migration_fork": 2.0, "migration_restore": 2.0,
+    "migration_cutover": 1.0, "pipeline_flush": 1.0,
+    "shm_stall": 0.5, "jit_compile": 30.0, "gc": 0.5,
+}
+AVAIL_DEFAULT_HOOK_PCT = 2.0
+
 
 def load_budget(root: Path) -> dict | None:
     p = root / BUDGET_FILE
@@ -65,6 +93,31 @@ def probe_slopes(doc: dict | None) -> dict[str, float]:
             out[phase] = float(v)
         except (TypeError, ValueError):
             pass
+    return out
+
+
+def availability(doc: dict | None) -> dict:
+    """The `availability` section — barrier-pause budgets checked by
+    the savail rule against the banked BENCH_pauses.json record.
+    Missing/garbled sections degrade to the configured defaults so a
+    pre-PR-20 budget file still gates the headline ceilings."""
+    out = {
+        "max_share": dict(AVAIL_DEFAULT_MAX_SHARE),
+        "max_single_pause_s": dict(AVAIL_DEFAULT_MAX_SINGLE_S),
+        "hook_overhead_pct": AVAIL_DEFAULT_HOOK_PCT,
+    }
+    sec = (doc or {}).get("availability") or {}
+    for key in ("max_share", "max_single_pause_s"):
+        for cause, v in (sec.get(key) or {}).items():
+            try:
+                out[key][cause] = float(v)
+            except (TypeError, ValueError):
+                pass
+    try:
+        out["hook_overhead_pct"] = float(
+            sec.get("hook_overhead_pct", out["hook_overhead_pct"]))
+    except (TypeError, ValueError):
+        pass
     return out
 
 
@@ -116,6 +169,9 @@ def write_budget(root: Path, measured_slopes: dict[str, float] | None
         if phase in slopes:
             slopes[phase] = round(
                 max(slopes[phase], float(v) + _SLOPE_MARGIN), 2)
+    # availability ceilings are reviewed hand edits like entry classes:
+    # keep whatever the old file pinned, fill configured defaults in
+    avail = availability(old)
     doc = {
         "comment": (
             "dtnscale host-complexity budgets (see "
@@ -123,7 +179,10 @@ def write_budget(root: Path, measured_slopes: dict[str, float] | None
             "`entries` pins each scale-critical entry point's "
             "allowed Python-level bound class; `probe.max_slope` "
             "ceilings the empirical log-log wall-time slopes the "
-            "scaling probe fits. Checked by `python -m "
+            "scaling probe fits; `availability` ceilings each "
+            "barrier-pause cause's share of bench wall clock and "
+            "worst single pause against the banked "
+            "BENCH_pauses.json (savail rule). Checked by `python -m "
             "kubedtn_tpu.analysis --scale` (tier-1) and re-baselined "
             "by --update-budgets."),
         "classes": list(CLASS_ORDER),
@@ -131,6 +190,12 @@ def write_budget(root: Path, measured_slopes: dict[str, float] | None
         "probe": {
             "sizes": probe_sizes(old),
             "max_slope": dict(sorted(slopes.items())),
+        },
+        "availability": {
+            "max_share": dict(sorted(avail["max_share"].items())),
+            "max_single_pause_s": dict(
+                sorted(avail["max_single_pause_s"].items())),
+            "hook_overhead_pct": avail["hook_overhead_pct"],
         },
     }
     (root / BUDGET_FILE).write_text(json.dumps(doc, indent=2) + "\n")

@@ -15,11 +15,12 @@ import hashlib
 import json
 from pathlib import Path
 
-from kubedtn_tpu.analysis.core import RULE_SCOST, Finding
+from kubedtn_tpu.analysis.core import RULE_SAVAIL, RULE_SCOST, Finding
 from kubedtn_tpu.analysis.scale import budget as budget_mod
 
 CACHE_FILE = ".dtnscale-cache.json"
-_CACHE_SCHEMA = 1
+_CACHE_SCHEMA = 2
+PAUSE_BENCH_FILE = "BENCH_pauses.json"
 
 
 def _tree_hash(root: Path) -> str:
@@ -35,6 +36,11 @@ def _tree_hash(root: Path) -> str:
     budget = root / budget_mod.BUDGET_FILE
     if budget.exists():
         h.update(budget.read_bytes())
+    # the savail gate judges the banked pause record: re-banking it
+    # must miss the cache even when no source changed
+    pauses = root / PAUSE_BENCH_FILE
+    if pauses.exists():
+        h.update(pauses.read_bytes())
     return h.hexdigest()
 
 
@@ -60,6 +66,82 @@ def _save_cache(root: Path, key: str, findings, probe: dict) -> None:
         (root / CACHE_FILE).write_text(json.dumps(doc) + "\n")
     except OSError:
         pass  # the cache is an optimization, never a failure
+
+
+def _check_availability(root: Path, doc, findings: list) -> dict:
+    """savail: gate the banked BENCH_pauses.json barrier-pause record
+    against the budget's `availability` ceilings. A missing record is
+    informational (the bench has simply not been banked on this tree),
+    but a record with an unbudgeted cause, a cause over its wall-clock
+    share, a single pause over its ceiling, or ledger hook overhead
+    past the bar is a finding — availability regressions gate exactly
+    like host-complexity regressions."""
+    avail = budget_mod.availability(doc)
+    p = root / PAUSE_BENCH_FILE
+    report: dict = {"file": PAUSE_BENCH_FILE, "present": p.exists(),
+                    "ceilings": avail}
+    if not p.exists():
+        report["note"] = (
+            "no banked pause record — `python bench.py` "
+            "(pause_observability phase) banks one; informational")
+        return report
+    try:
+        rec = json.loads(p.read_text())
+    except (OSError, ValueError):
+        findings.append(Finding(
+            RULE_SAVAIL, PAUSE_BENCH_FILE, 1,
+            "banked pause record unreadable — re-bank with "
+            "`python bench.py`"))
+        return report
+    wall = float(rec.get("wall_s") or 0.0)
+    shares: dict[str, float] = {}
+    for cause, st in sorted((rec.get("causes") or {}).items()):
+        try:
+            secs = float(st.get("seconds", 0.0))
+            max_s = float(st.get("max_s", 0.0))
+        except (AttributeError, TypeError, ValueError):
+            continue
+        if secs <= 0.0:
+            continue
+        share = secs / wall if wall > 0 else 0.0
+        shares[cause] = round(share, 4)
+        limit = avail["max_share"].get(cause)
+        if limit is None:
+            findings.append(Finding(
+                RULE_SAVAIL, budget_mod.BUDGET_FILE, 1,
+                f"pause cause `{cause}` appears in the banked record "
+                f"({secs:.3f}s) but has no `availability.max_share` "
+                f"budget — new barrier causes must be budgeted "
+                f"deliberately"))
+        elif share > limit:
+            findings.append(Finding(
+                RULE_SAVAIL, PAUSE_BENCH_FILE, 1,
+                f"`{cause}` pauses ate {share:.1%} of the bench wall "
+                f"clock ({secs:.3f}s / {wall:.3f}s) > budget "
+                f"{limit:.1%} — the plane's availability under this "
+                f"barrier regressed"))
+        single = avail["max_single_pause_s"].get(cause)
+        if single is not None and max_s > single:
+            findings.append(Finding(
+                RULE_SAVAIL, PAUSE_BENCH_FILE, 1,
+                f"worst single `{cause}` pause {max_s:.3f}s > ceiling "
+                f"{single:.3f}s — one barrier hold-down this long "
+                f"stalls every tick behind it"))
+    hook = rec.get("hook_overhead_pct")
+    if hook is not None:
+        try:
+            hookf = float(hook)
+        except (TypeError, ValueError):
+            hookf = None
+        if hookf is not None and hookf > avail["hook_overhead_pct"]:
+            findings.append(Finding(
+                RULE_SAVAIL, PAUSE_BENCH_FILE, 1,
+                f"pause-ledger hook overhead {hookf:.2f}% > "
+                f"{avail['hook_overhead_pct']:.2f}% budget — the "
+                f"observability plane itself is taxing the tick path"))
+    report.update(wall_s=wall, shares=shares,
+                  hook_overhead_pct=hook)
+    return report
 
 
 def run_scale(root: Path, use_cache: bool = False,
@@ -114,6 +196,7 @@ def run_scale(root: Path, use_cache: bool = False,
             f"its budget: fitted slope {slope:.2f} > {limit:.2f} "
             f"over rows {probe['sizes']} — host work on this path "
             f"grew with plane size"))
+    probe["availability"] = _check_availability(root, doc, findings)
     if cache_key is not None:
         _save_cache(root, cache_key, findings, probe)
     return findings, probe

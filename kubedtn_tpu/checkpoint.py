@@ -329,7 +329,15 @@ def save(path: str, store: TopologyStore, engine: SimEngine,
     from kubedtn_tpu.utils import tracing
 
     with tracing.span("checkpoint-save", path=path):
-        cap = _capture(store, engine, sim, dataplane)
+        pauses = getattr(dataplane, "pauses", None)
+        if pauses is not None:
+            # stopped plane, but the pause still lands in the ledger so
+            # a restart-heavy fleet's checkpoint cost stays attributable
+            with pauses.pause("checkpoint_save", path=path,
+                              rows=int(engine._state.capacity)):
+                cap = _capture(store, engine, sim, dataplane)
+        else:
+            cap = _capture(store, engine, sim, dataplane)
         return _write_captured(path, cap)
 
 
@@ -347,8 +355,15 @@ def save_live(path: str, store: TopologyStore, engine: SimEngine,
     from kubedtn_tpu.utils import tracing
 
     with tracing.span("checkpoint-save-live", path=path):
+        # the barrier is the pause: staging/fsync/swap run off the tick
+        # path afterwards, so only the capture is attributed (cause
+        # checkpoint_save, rows = the engine's full column height — the
+        # capture is O(capacity), which is exactly why the ledger and
+        # the savail budget exist)
         cap = dataplane.stage_update_round(
-            lambda: _capture(store, engine, None, dataplane))
+            lambda: _capture(store, engine, None, dataplane),
+            cause="checkpoint_save", path=path,
+            rows=int(engine._state.capacity))
         return _write_captured(path, cap)
 
 

@@ -757,6 +757,102 @@ def cmd_slo(args) -> int:
     return 0
 
 
+def _pauses_payload(resp) -> dict:
+    """One wire ObservePausesResponse as a JSON-safe dict."""
+    return {
+        "enabled": resp.enabled,
+        "uptime_s": resp.uptime_s,
+        "total_pause_s": resp.total_pause_s,
+        "dropped_events": resp.dropped_events,
+        "tick_edges_s": list(resp.tick_edges_s),
+        "causes": [{
+            "cause": c.cause, "count": c.count, "seconds": c.seconds,
+            "max_s": c.max_s, "last_s": c.last_s,
+            "last_t_s": c.last_t_s, "rows": c.rows, "bytes": c.bytes,
+            "tick_buckets": list(c.tick_buckets),
+            "tick_count": c.tick_count, "tick_sum_s": c.tick_sum_s,
+        } for c in resp.causes],
+        "events": [{
+            "cause": e.cause, "dur_s": e.dur_s, "t_s": e.t_s,
+            "detail": e.detail,
+        } for e in resp.events],
+    }
+
+
+def _render_pauses(resp, addr: str) -> None:
+    share = (100.0 * resp.total_pause_s / resp.uptime_s
+             if resp.uptime_s > 0 else 0.0)
+    print(f"pauses via {addr} — uptime {resp.uptime_s:.1f}s, "
+          f"total pause {resp.total_pause_s:.3f}s "
+          f"({share:.2f}% of wall), "
+          f"ledger {'on' if resp.enabled else 'OFF'}"
+          + (f", {resp.dropped_events} events dropped"
+             if resp.dropped_events else ""))
+    # ranked worst cause first: cumulative seconds is the availability
+    # cost, which is what the savail budget ceilings
+    ranked = sorted(resp.causes, key=lambda c: -c.seconds)
+    print(f"{'cause':<20}{'count':>7}{'seconds':>10}{'max':>9}"
+          f"{'last':>9}{'rows':>10}{'bytes':>12}{'ticks':>7}")
+    for c in ranked:
+        if c.cause == "none" and not c.count:
+            # clean ticks carry only the histogram row below
+            print(f"{'(clean ticks)':<20}{'-':>7}{'-':>10}{'-':>9}"
+                  f"{'-':>9}{'-':>10}{'-':>12}{c.tick_count:>7}")
+            continue
+        print(f"{c.cause:<20}{c.count:>7}{c.seconds:>10.3f}"
+              f"{c.max_s:>9.3f}{c.last_s:>9.3f}{c.rows:>10}"
+              f"{c.bytes:>12}{c.tick_count:>7}")
+    for e in resp.events:
+        det = f"  {e.detail}" if e.detail else ""
+        print(f"  [{e.t_s:>9.3f}s] {e.cause:<18} "
+              f"{e.dur_s * 1000:.2f}ms{det}")
+
+
+def cmd_pauses(args) -> int:
+    """`kdt pauses [--json] [--watch] [--events N]` — the pause/stall
+    observability plane's operator surface (Local.ObservePauses): a
+    ranked worst-cause table of every tick-lock barrier the plane paid
+    (checkpoint / compact / staged update / migration / flush / shm
+    stall / jit compile / GC), each with count, cumulative and worst
+    duration, and rows/bytes touched — the answer to "why did tick
+    latency spike at 14:02"."""
+    import grpc
+
+    from kubedtn_tpu.wire import proto as pb
+    from kubedtn_tpu.wire.client import DaemonClient
+
+    client = DaemonClient(args.daemon)
+    try:
+        while True:
+            try:
+                resp = client.ObservePauses(
+                    pb.ObservePausesRequest(cause=args.cause or "",
+                                            events=args.events),
+                    timeout=args.timeout)
+            except grpc.RpcError as e:
+                print(f"pauses: daemon {args.daemon} RPC failed: "
+                      f"{_rpc_code(e)}", file=sys.stderr)
+                return 1
+            if not resp.ok:
+                print(f"pauses: {resp.error}", file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(_json_safe(_pauses_payload(resp))),
+                      flush=True)
+            else:
+                _render_pauses(resp, args.daemon)
+            if not args.watch:
+                return 0
+            try:
+                time.sleep(args.interval)
+            except KeyboardInterrupt:
+                return 0
+            if not args.json:
+                print()
+    finally:
+        client.close()
+
+
 def cmd_tenant(args) -> int:
     """`kdt tenant create|list|quota|stats` — the multi-tenant plane's
     operator surface (Local.Tenant* framework extensions): register a
@@ -1222,6 +1318,38 @@ def cmd_daemon(args) -> int:
         dataplane.attach_shm(shm_ingest)
         log.info("shm ingest on %s", fields(dir=shm_dir))
     trace_out = getattr(args, "trace_out", None)
+    trace_stop = None
+    if trace_out:
+        # Crash-safe trace capture: rotate (append + truncate buffer)
+        # on a sidecar so a SIGKILL'd daemon loses at most one rotation
+        # interval of spans, not the whole buffer. Truncate any stale
+        # file first — rotate_out appends, and a previous run's dump
+        # would otherwise corrupt the array.
+        import threading as _threading
+
+        from kubedtn_tpu.utils.tracing import default_tracer
+
+        open(trace_out, "w").close()
+        trace_stop = _threading.Event()
+
+        def _trace_rotator() -> None:
+            tr = default_tracer()
+            last = time.monotonic()
+            while not trace_stop.wait(2.0):
+                now = time.monotonic()
+                if tr.pending() >= 20_000 or (
+                        now - last >= 30.0 and tr.pending() > 0):
+                    try:
+                        n = tr.rotate_out(trace_out)
+                        if n:
+                            last = now
+                    except Exception:
+                        log.exception("trace rotation failed %s",
+                                      fields(path=trace_out))
+                        last = now  # don't hot-loop a broken path
+
+        _threading.Thread(target=_trace_rotator, daemon=True,
+                          name="trace-rotator").start()
     jax_profile = getattr(args, "jax_profile", None)
     if jax_profile:
         # opt-in XLA device profiling for the daemon's whole lifetime
@@ -1354,15 +1482,17 @@ def cmd_daemon(args) -> int:
                 log.exception("jax profiler stop failed")
         if trace_out:
             # catapult/Perfetto JSON of the daemon's structured spans
-            # (reconcile / checkpoint / what-if sweeps) — dumped on
-            # Ctrl-C AND SIGTERM (both route through this handler)
+            # (reconcile / checkpoint / barrier pauses) — the sidecar
+            # already rotated periodically; this final rotation drains
+            # whatever landed since, in the same array format
             from kubedtn_tpu.utils.tracing import default_tracer
 
+            if trace_stop is not None:
+                trace_stop.set()
             try:
-                default_tracer().export_chrome(trace_out)
+                n = default_tracer().rotate_out(trace_out)
                 log.info("trace written %s", fields(
-                    path=trace_out,
-                    spans=len(default_tracer().spans())))
+                    path=trace_out, spans=n))
             except Exception:
                 log.exception("trace export failed %s",
                               fields(path=trace_out))
@@ -1900,6 +2030,27 @@ def main(argv=None) -> int:
     slp.add_argument("--timeout", type=float, default=30.0)
     slp.set_defaults(fn=cmd_slo)
 
+    pup = sub.add_parser(
+        "pauses",
+        help="barrier-pause attribution: ranked worst-cause table of "
+             "every tick-lock pause the plane paid — checkpoint / "
+             "compact / staged update / migration / flush / shm stall "
+             "/ jit compile / GC (Local.ObservePauses)")
+    pup.add_argument("--daemon", default="127.0.0.1:51111",
+                     metavar="HOST:PORT")
+    pup.add_argument("--cause", default="",
+                     help="show only this cause")
+    pup.add_argument("--events", type=int, default=0, metavar="N",
+                     help="also list the N most recent attributed "
+                          "pause events (0 = aggregates only)")
+    pup.add_argument("--watch", action="store_true",
+                     help="refresh every --interval seconds until "
+                          "Ctrl-C")
+    pup.add_argument("--interval", type=float, default=2.0)
+    pup.add_argument("--json", action="store_true")
+    pup.add_argument("--timeout", type=float, default=30.0)
+    pup.set_defaults(fn=cmd_pauses)
+
     app = sub.add_parser(
         "autopilot",
         help="SLO autopilot: the burn-page → twin-gated staged "
@@ -1993,9 +2144,11 @@ def main(argv=None) -> int:
                          "devices (-1 = all local devices; 0 = off; "
                          "power of two)")
     dp.add_argument("--trace-out", default=None, metavar="JSON",
-                    help="dump catapult/Perfetto trace JSON (spans "
-                         "around reconcile / checkpoint / what-if "
-                         "sweeps) on stop or SIGTERM")
+                    help="stream catapult/Perfetto trace JSON (spans "
+                         "around reconcile / checkpoint / barrier "
+                         "pauses); rotated to disk periodically so a "
+                         "crash loses at most one rotation, with a "
+                         "final rotation on stop/SIGTERM")
     dp.add_argument("--jax-profile", default=None, metavar="DIR",
                     help="opt-in jax.profiler device capture for the "
                          "daemon's lifetime (TensorBoard-loadable)")

@@ -638,7 +638,10 @@ class MigrationCoordinator:
             }
             return fork, arrays
 
-        fork, arrays = src.plane.stage_update_round(_capture)
+        fork, arrays = src.plane.stage_update_round(
+            _capture, cause="migration_fork",
+            migration=self.migration_id, tenant=self.tenant,
+            rows=int(reg.rows_of(self.tenant).size))
         self._fork_arrays = arrays
         self._chaos_step("fork")
         self._commit("fork", arrays=arrays, fork=fork)
@@ -658,7 +661,10 @@ class MigrationCoordinator:
                 dst, self.tenant, fork, arrays, self.src.addr,
                 hold=True))
 
-        n_rows = dst.plane.stage_update_round(_apply)
+        n_rows = dst.plane.stage_update_round(
+            _apply, cause="migration_restore",
+            migration=self.migration_id, tenant=self.tenant,
+            rows=int(len(arrays["rows"])))
         self._chaos_step("restore")
         self._commit("restore", restored_rows=int(n_rows))
 
@@ -714,7 +720,9 @@ class MigrationCoordinator:
                 moved += self._transfer(ws, wd)
             return moved
 
-        moved = self.src.plane.stage_update_round(_cut)
+        moved = self.src.plane.stage_update_round(
+            _cut, cause="migration_cutover",
+            migration=self.migration_id, tenant=self.tenant)
         self._chaos_step("cutover")
         prev = 0
         with self._lock:

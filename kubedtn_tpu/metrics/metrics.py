@@ -967,6 +967,94 @@ class ShmStatsCollector:
         return out
 
 
+class PauseStatsCollector:
+    """kubedtn_pause_* series from the data plane's PauseLedger
+    (kubedtn_tpu.pauses) — the barrier-pause attribution scrape face:
+    per-cause pause seconds / event counts / worst + latest single
+    pause, per-cause rows and bytes touched, and the
+    tick-latency-by-cause histogram (`kubedtn_tick_latency_seconds
+    {cause}`) on the reference bucket ladder rescaled to seconds.
+
+    Cardinality guard (the SloStatsCollector truncation-guard
+    pattern): the cause taxonomy is small and fixed, but an
+    off-taxonomy cause is still recorded by the ledger — per-cause
+    series are capped at `max_causes` (name-sorted, stable across
+    scrapes) with the tail counted by
+    `kubedtn_pause_causes_truncated`."""
+
+    CAUSE_KEYS = (
+        ("seconds_total", 1, "seconds", "Cumulative pause seconds "
+         "attributed to this cause"),
+        ("events_total", 1, "count", "Pause events recorded for this "
+         "cause"),
+        ("rows_total", 1, "rows", "Cumulative rows touched under this "
+         "cause's barriers"),
+        ("bytes_total", 1, "bytes", "Cumulative bytes touched under "
+         "this cause's barriers"),
+        ("max_seconds", 0, "max_s", "Worst single pause seen for this "
+         "cause"),
+        ("last_seconds", 0, "last_s", "Most recent pause duration for "
+         "this cause"),
+    )
+
+    def __init__(self, dataplane, max_causes: int = 64) -> None:
+        self._plane = dataplane
+        self._max_causes = max_causes
+
+    def collect(self):
+        from prometheus_client.core import HistogramMetricFamily
+
+        ledger = getattr(self._plane, "pauses", None)
+        out = []
+        if ledger is None:
+            return out
+        snap = ledger.snapshot()
+        causes = sorted(snap["causes"])
+        truncated = max(0, len(causes) - self._max_causes)
+        fams = {}
+        for key, is_counter, _src, doc in self.CAUSE_KEYS:
+            fam_cls = CounterMetricFamily if is_counter \
+                else GaugeMetricFamily
+            fams[key] = fam_cls(f"kubedtn_pause_{key}", doc,
+                                labels=["cause"])
+        for cause in causes[:self._max_causes]:
+            a = snap["causes"][cause]
+            for key, _ic, src, _doc in self.CAUSE_KEYS:
+                fams[key].add_metric([cause], float(a[src]))
+        out.extend(fams.values())
+        # tick-latency-by-cause: cumulative bucket counts on the
+        # seconds ladder, "none" = ticks with no pause attributed
+        hist = HistogramMetricFamily(
+            "kubedtn_tick_latency_seconds",
+            "Tick wall latency (tick-lock wait included) by the "
+            "dominant pause cause attributed to that tick",
+            labels=["cause"])
+        edges = snap["tick_edges_s"]
+        for cause in sorted(snap["tick_hist"])[:self._max_causes]:
+            h = snap["tick_hist"][cause]
+            cum = 0
+            buckets = []
+            for i, edge in enumerate(edges):
+                cum += h["buckets"][i]
+                buckets.append((repr(float(edge)), float(cum)))
+            buckets.append(("+Inf", float(h["count"])))
+            hist.add_metric([cause], buckets, sum_value=h["sum_s"])
+        out.append(hist)
+        g = GaugeMetricFamily(
+            "kubedtn_pause_events_dropped",
+            "Pause events that fell off the bounded event ring "
+            "(aggregates never drop)")
+        g.add_metric([], float(snap["dropped_events"]))
+        out.append(g)
+        trunc = GaugeMetricFamily(
+            "kubedtn_pause_causes_truncated",
+            "Causes beyond the per-cause series cap "
+            "(0 = full coverage)")
+        trunc.add_metric([], float(truncated))
+        out.append(trunc)
+        return out
+
+
 class MetricsServer:
     """Serves the registry on an HTTP port — the daemon's :51112/metrics
     endpoint (reference daemon/main.go:57-66)."""
@@ -1035,6 +1123,9 @@ def make_registry(engine=None, sim_counters_fn=None,
             engine, sim_counters_fn, max_interfaces=max_interfaces))
     if dataplane is not None:
         registry.register(DataPlaneStatsCollector(dataplane))
+        # barrier-pause attribution (emits nothing for planes predating
+        # the ledger — getattr-guarded inside the collector)
+        registry.register(PauseStatsCollector(dataplane))
         if engine is not None:
             # emits nothing until the plane's telemetry is enabled
             registry.register(LinkTelemetryCollector(engine, dataplane))

@@ -137,6 +137,7 @@ from kubedtn_tpu import fault, native
 from kubedtn_tpu import telemetry as tele
 from kubedtn_tpu.contracts import guarded_by, requires_lock
 from kubedtn_tpu.ops import netem
+from kubedtn_tpu.pauses import PauseLedger
 from kubedtn_tpu.ops.queues import EdgeCounters, init_counters
 from kubedtn_tpu.wire.server import FrameSeg, flatten_frames
 
@@ -666,11 +667,50 @@ class _GCTuner:
     remaining full collections rare; per-tick garbage still dies young
     in gen 0/1. Refcounted: processes running several planes (tests,
     multi-daemon scenarios) restore the interpreter defaults only when
-    the LAST runner stops."""
+    the LAST runner stops.
+
+    Pause attribution: while any runner is live a gc.callbacks hook
+    times each collection and reports it (cause "gc", with the
+    generation and collected-object count) to every registered
+    PauseLedger — the ledgers the live planes own, held weakly so a
+    stopped plane never leaks through the class-level registry."""
 
     _lock = threading.Lock()
     _count = 0
     _saved: tuple | None = None
+    _ledgers: "weakref.WeakSet" = None  # built on first acquire
+    _gc_t0: float | None = None
+
+    @classmethod
+    def register_ledger(cls, ledger) -> None:
+        import weakref
+
+        with cls._lock:
+            if cls._ledgers is None:
+                cls._ledgers = weakref.WeakSet()
+            cls._ledgers.add(ledger)
+
+    @classmethod
+    def _on_gc(cls, phase: str, info: dict) -> None:
+        # gc callbacks run on whichever thread tripped the threshold —
+        # record() is thread-safe and lock-cheap, so this stays on the
+        # collection path without measurable cost (collections are rare
+        # by construction while the tuner holds the relaxed thresholds)
+        if phase == "start":
+            cls._gc_t0 = time.perf_counter()
+            return
+        t0 = cls._gc_t0
+        if t0 is None:
+            return
+        cls._gc_t0 = None
+        dur = time.perf_counter() - t0
+        ledgers = cls._ledgers
+        if not ledgers:
+            return
+        for led in list(ledgers):
+            led.record("gc", dur,
+                       generation=info.get("generation", -1),
+                       collected=info.get("collected", 0))
 
     @classmethod
     def acquire(cls) -> None:
@@ -683,6 +723,8 @@ class _GCTuner:
             gc.freeze()
             t0, t1, _t2 = cls._saved
             gc.set_threshold(t0, t1, max(_t2 * 10, 100))
+            if cls._on_gc not in gc.callbacks:
+                gc.callbacks.append(cls._on_gc)
 
     @classmethod
     def refreeze(cls) -> None:
@@ -706,6 +748,10 @@ class _GCTuner:
                 gc.set_threshold(*cls._saved)
                 cls._saved = None
             gc.unfreeze()
+            try:
+                gc.callbacks.remove(cls._on_gc)
+            except ValueError:
+                pass
 
 
 def _row_counts(res):
@@ -1359,6 +1405,16 @@ class WireDataPlane:
         # reads per tick; read via stage_breakdown()
         self.stage_s = {"drain": 0.0, "decide": 0.0, "kernel": 0.0,
                         "sync": 0.0, "schedule": 0.0, "release": 0.0}
+        # -- pause ledger (round 20) -----------------------------------
+        # every tick-lock barrier site (flush, staged updates,
+        # checkpoint, compact, migration, jit recompiles, GC) reports
+        # into this; tick() attributes each tick's wall latency to the
+        # dominant cause. The engine carries a back-reference so
+        # compact() — called through tenancy/registry, not the plane —
+        # reports into the same ledger.
+        self.pauses = PauseLedger()
+        self.engine.pauses = self.pauses
+        _GCTuner.register_ledger(self.pauses)
         self.last_now_s: float | None = None  # clock of the latest tick
         self._clock_ext = False  # latest tick ran on a caller-supplied clock
         self._ff_active = False  # fast_forward loop in progress
@@ -1663,8 +1719,14 @@ class WireDataPlane:
         shaping COMPLETED this tick (with the pipeline at depth 1 — any
         explicit-clock tick by default — that is exactly the frames
         shaped this tick, the historical contract)."""
+        # timed AROUND the lock acquisition: a tick that waited behind a
+        # checkpoint/compact/update barrier holder attributes that wait
+        # to the barrier's cause in the tick-latency-by-cause histogram
+        t0 = time.perf_counter()
         with self._tick_lock:
-            return self._tick_inner(now_s)
+            shaped = self._tick_inner(now_s)
+        self.pauses.note_tick(time.perf_counter() - t0)
+        return shaped
 
     @requires_lock("_tick_lock")
     def _complete_or_requeue(self, job: _ShapeJob) -> int:
@@ -1703,7 +1765,14 @@ class WireDataPlane:
         remap, start()'s clock rebase, stop()) crosses this barrier
         first, so stage overlap never leaks a half-applied tick."""
         with self._tick_lock:
+            if not self._inflight:
+                # nothing in flight: no barrier was paid — don't record
+                # a zero-length pause for every idle flush() call
+                self._pipe_state = None
+                self._need_resync = False
+                return 0
             shaped = 0
+            t0 = time.perf_counter()
             while self._inflight:
                 shaped += self._complete_or_requeue(
                     self._inflight.popleft())
@@ -1711,9 +1780,12 @@ class WireDataPlane:
             # the next dispatch restarts the chain from engine state
             self._pipe_state = None
             self._need_resync = False
+            self.pauses.record("pipeline_flush",
+                               time.perf_counter() - t0, rows=shaped)
             return shaped
 
-    def stage_update_round(self, apply_fn):
+    def stage_update_round(self, apply_fn, cause: str = "staged_update",
+                           **detail):
         """Planned-update staging barrier (updates.stager): complete
         every in-flight dispatch, run `apply_fn` (one round's engine
         edits — it returns whatever the stager needs), and flush the
@@ -1729,13 +1801,19 @@ class WireDataPlane:
         drop — otherwise the next tick's lazy engine.state flush would
         land the half-round mid-shaping. The stager's _apply_round
         additionally replays its journal inside the same lock hold, so
-        no tick ever shapes against the mixture."""
+        no tick ever shapes against the mixture.
+
+        `cause`/`detail` label the pause for the ledger: the stager
+        passes its plan id, migration fork/restore/cutover pass their
+        migration id and tenant so a cutover barrier never masquerades
+        as a generic staged update in the attribution tables."""
         with self._tick_lock:
-            self.flush()
-            try:
-                return apply_fn()
-            finally:
-                self.engine.flush()
+            with self.pauses.pause(cause, **detail):
+                self.flush()
+                try:
+                    return apply_fn()
+                finally:
+                    self.engine.flush()
 
     def update_stager(self, stats=None):
         """This plane's planned-update stager, created on first use
@@ -1845,7 +1923,10 @@ class WireDataPlane:
         """Schedule exported frames to release after their remaining
         delays, counted from `now_s` (default: the monotonic clock —
         pass an explicit clock when driving deterministic ticks)."""
-        with self._tick_lock:
+        entries = list(entries)
+        with self._tick_lock, \
+                self.pauses.pause("checkpoint_load",
+                                  rows=len(entries)):
             # pipeline barrier: restored entries share _pending/_bseq
             # with in-flight completions — drain them first
             self.flush()
@@ -2704,7 +2785,8 @@ class WireDataPlane:
                   self._shard_mesh is not None,
                   tuple(sorted((kind, a[1].shape)
                                for kind, a in args.items())))
-        if bucket not in self._seen_buckets:
+        new_bucket = bucket not in self._seen_buckets
+        if new_bucket:
             self._seen_buckets.add(bucket)
             self._watchdog_armed = False
         t_kernel0 = time.perf_counter()
@@ -2749,6 +2831,17 @@ class WireDataPlane:
         job.dyn_after = dyn_after
         self._pipe_state = dyn_after
         self._chain_shaped_s = now_s
+        if new_bucket:
+            # the jit call above traced+compiled synchronously for this
+            # never-seen (class-mix, padded-shape) bucket — record the
+            # compile stall per shape bucket so a churning topology that
+            # keeps minting new padded shapes is visible as jit_compile
+            # pause seconds, not mystery tick latency
+            self.pauses.record(
+                "jit_compile", time.perf_counter() - t_kernel0,
+                rows=E, shape_bucket="E%d:%s" % (E, ",".join(
+                    "%s%s" % (kind, list(a[1].shape))
+                    for kind, a in sorted(args.items()))))
         if self._exchange_probe is not None and args:
             # exchange-kernel seconds, sampled: the ring rides inside
             # the one fused dispatch, so its cost is measured by

@@ -73,7 +73,8 @@ class _RingState:
 @guarded_by("_lock", "_rings", "_retired", "frames_in", "bytes_in",
             "batches", "dequeues", "skipped_uncommitted",
             "throttled_events", "throttled_frames_last",
-            "unresolved_frames", "parked_unrealized", "rings_retired")
+            "unresolved_frames", "parked_unrealized", "rings_retired",
+            "stall_events")
 class ShmIngest:
     """Consumer driver over every ring in one directory. Attach with
     `daemon.shm = ShmIngest(dir)`; `drain_ingress` then folds ring
@@ -102,7 +103,8 @@ class ShmIngest:
         self.batches = 0       # (wire,row,lens,parts) batches emitted
         self.dequeues = 0      # native dequeue calls
         self.skipped_uncommitted = 0
-        self.throttled_events = 0
+        self.stall_events = 0  # dequeues ended at a live producer's
+        self.throttled_events = 0       # uncommitted reservation
         self.throttled_frames_last = 0  # frames parked by admission,
         self.rings_retired = 0          # last drain (gauge)
         self.unresolved_frames = 0
@@ -176,10 +178,13 @@ class ShmIngest:
         take next call. Runs on the tick thread, under no daemon lock
         — ring handoff is the segment's own atomics."""
         self.scan()
+        t0 = time.perf_counter()
         with self._lock:
             states = list(self._rings.values())
         backlog = 0
         throttled = 0
+        stalled = 0
+        drained = 0
         for st in states:
             ring = st.ring
             if ring.pending() == 0:
@@ -221,15 +226,29 @@ class ShmIngest:
                             and ring.producer_dead()):
                         skip_dead = True
                         continue
+                    if not skip_dead and ring.pending() > 0:
+                        # a LIVE producer's reservation at the ring
+                        # head: committed frames behind it stay parked
+                        # until the commit lands — a batch-dequeue
+                        # stall, reported to the pause ledger below
+                        stalled += 1
                     break
                 got += len(wires)
                 self._emit(daemon, out, blob, wires, offs, lens, traces)
+            drained += got
             residue = ring.pending()
             if residue and got >= budget:
                 # budget residue only — same exclusion rules as wires
                 backlog += max(1, residue // self.ENTRY_FRAMES)
         with self._lock:
             self.throttled_frames_last = throttled
+            self.stall_events += stalled
+        if stalled:
+            pauses = getattr(getattr(daemon, "dataplane", None),
+                             "pauses", None)
+            if pauses is not None:
+                pauses.record("shm_stall", time.perf_counter() - t0,
+                              rows=drained, rings=stalled)
         return backlog
 
     def _emit(self, daemon, out: list, blob: bytes, wires, offs, lens,
@@ -345,6 +364,7 @@ class ShmIngest:
                 "batches": self.batches,
                 "dequeues": self.dequeues,
                 "skipped_uncommitted": self.skipped_uncommitted,
+                "stall_events": self.stall_events,
                 "throttled_events": self.throttled_events,
                 "throttled_frames_last": self.throttled_frames_last,
                 "unresolved_frames": self.unresolved_frames,

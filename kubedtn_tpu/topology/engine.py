@@ -958,7 +958,13 @@ class SimEngine:
         (the data plane's per-row counters) are remapped OUTSIDE the
         engine lock — a tick racing the callback may smear at most one
         tick of counter increments across the renumbering.
+
+        The whole pass (device gather + registry rebuild + observer
+        remap) reports into the owning plane's pause ledger (cause
+        "compact" — the engine carries `pauses` as a back-reference the
+        plane sets, None for engine-only embedders).
         """
+        t_pause0 = time.perf_counter()
         with self._lock:
             self._flush_device_locked()
             items = sorted(self._rows.items())
@@ -1020,6 +1026,10 @@ class SimEngine:
                                      if r() is not None]
         for cb in live:
             cb(old_rows, n)
+        pauses = getattr(self, "pauses", None)
+        if pauses is not None:
+            pauses.record("compact", time.perf_counter() - t_pause0,
+                          rows=n, moved=moved)
         self.log.info("compact %s", _fields(action="compact", active=n,
                                             moved=moved))
         return {"active": n, "moved": moved}

@@ -306,7 +306,10 @@ class UpdateStager:
 
         with self._tick_lock:
             try:
-                ok, remote_calls = self.plane.stage_update_round(body)
+                ok, remote_calls = self.plane.stage_update_round(
+                    body, plan=topo.key,
+                    rows=len(rnd.adds) + len(rnd.dels)
+                    + len(rnd.changes))
             except Exception:
                 # a raise mid-body leaves the round half-applied (the
                 # registries moved; stage_update_round's finally put

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import threading
 import time
 from collections import defaultdict, deque
@@ -74,6 +75,22 @@ class Tracer:
                     self.dropped_spans += 1
                 self._spans.append(s)
 
+    def add_span(self, name: str, dur_s: float, **meta) -> None:
+        """Record an externally timed, already-finished span ending now
+        — the pause ledger's record() path (GC callbacks, compact,
+        jit-compile stalls measure their own duration and report after
+        the fact). Depth 0: retro spans have no live stack to nest in.
+        """
+        if not self.enabled:
+            return
+        dur_us = dur_s * 1e6
+        s = Span(name=name, start_us=self._now_us() - dur_us,
+                 dur_us=dur_us, thread=threading.get_ident(), meta=meta)
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self.dropped_spans += 1
+            self._spans.append(s)
+
     def traced(self, name: str | None = None):
         """Decorator form of span()."""
 
@@ -97,6 +114,12 @@ class Tracer:
         with self._lock:
             return list(self._spans)
 
+    def pending(self) -> int:
+        """Buffered (not yet rotated) span count — the rotation
+        sidecar's size trigger, without copying the deque."""
+        with self._lock:
+            return len(self._spans)
+
     def stats(self) -> dict[str, dict[str, float]]:
         """Aggregate per-name {count, total_ms, max_ms} — the shape the
         daemon's latency histograms consume."""
@@ -110,14 +133,51 @@ class Tracer:
             a["max_ms"] = max(a["max_ms"], ms)
         return dict(agg)
 
-    def export_chrome(self, path: str) -> None:
-        """Write catapult trace-event JSON (open in Perfetto/chrome)."""
-        events = [{
+    @staticmethod
+    def _chrome_event(s: Span) -> dict:
+        return {
             "name": s.name, "ph": "X", "ts": s.start_us, "dur": s.dur_us,
             "pid": 0, "tid": s.thread % 1_000_000, "args": s.meta,
-        } for s in self.spans()]
+        }
+
+    def export_chrome(self, path: str) -> None:
+        """Write catapult trace-event JSON (open in Perfetto/chrome)."""
+        events = [self._chrome_event(s) for s in self.spans()]
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)
+
+    def rotate_out(self, path: str) -> int:
+        """Crash-safe incremental export: APPEND the buffered spans to
+        `path` in trace-event JSON *Array* Format and truncate the
+        buffer, returning the spans written. The array format's
+        closing "]" is explicitly optional (catapult/Perfetto
+        importers parse a cut-off file), so a daemon rotating on an
+        interval bounds what a SIGKILL can lose to one rotation — the
+        dump-only-on-stop export_chrome lost the ENTIRE buffer on any
+        crash. Each rotation drains-and-clears atomically under the
+        tracer lock; spans recorded during the disk write land in the
+        next rotation. Interleave-safe with itself but callers should
+        rotate from ONE sidecar thread per file."""
+        with self._lock:
+            if not self._spans:
+                return 0
+            spans = list(self._spans)
+            self._spans.clear()
+        first = True
+        try:
+            first = os.path.getsize(path) == 0
+        except OSError:
+            pass
+        with open(path, "a") as f:
+            out = []
+            for s in spans:
+                out.append(("[\n" if first else ",\n")
+                           + json.dumps(self._chrome_event(s)))
+                first = False
+            f.write("".join(out))
+            f.flush()
+            os.fsync(f.fileno())
+        return len(spans)
 
     def reset(self) -> None:
         with self._lock:

@@ -314,6 +314,52 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
         _field("sampled", 5, I64),
     ))
     # Framework extension (absent from reference kube_dtn.proto): the
+    # pause/stall observability plane (kubedtn_tpu.pauses) — per-cause
+    # barrier-pause aggregates (checkpoint / compact / staged update /
+    # migration / flush / shm stall / jit compile / GC), the
+    # tick-latency-by-cause histograms, and the most recent attributed
+    # events; `kdt pauses` reads this. Reference clients never see
+    # these types.
+    f.message_type.append(_msg(
+        "ObservePausesRequest",
+        _field("cause", 1, S),          # empty = every cause
+        _field("events", 2, I32),       # recent events to include
+    ))
+    f.message_type.append(_msg(
+        "PauseCauseStat",
+        _field("cause", 1, S),
+        _field("count", 2, I64),
+        _field("seconds", 3, D),
+        _field("max_s", 4, D),
+        _field("last_s", 5, D),
+        _field("last_t_s", 6, D),       # ledger-relative seconds
+        _field("rows", 7, I64),
+        _field("bytes", 8, I64),
+        # this cause's tick-latency histogram (per-bin counts on the
+        # shared edges ladder the response carries once)
+        _field("tick_buckets", 9, I64, REP),
+        _field("tick_count", 10, I64),
+        _field("tick_sum_s", 11, D),
+    ))
+    f.message_type.append(_msg(
+        "PauseEvent",
+        _field("cause", 1, S),
+        _field("dur_s", 2, D),
+        _field("t_s", 3, D),
+        _field("detail", 4, S),         # compact k=v pairs
+    ))
+    f.message_type.append(_msg(
+        "ObservePausesResponse",
+        _field("ok", 1, B), _field("error", 2, S),
+        _field("enabled", 3, B),
+        _field("uptime_s", 4, D),
+        _field("total_pause_s", 5, D),
+        _field("causes", 6, None, REP, type_name="PauseCauseStat"),
+        _field("events", 7, None, REP, type_name="PauseEvent"),
+        _field("dropped_events", 8, I64),
+        _field("tick_edges_s", 9, D, REP),
+    ))
+    # Framework extension (absent from reference kube_dtn.proto): the
     # planned-update surface (kubedtn_tpu.updates) — claim/apply
     # semantics per the Kubernetes Network Driver Model. PlanUpdate
     # diffs the declared desired links against the realized state,
@@ -622,6 +668,8 @@ for _name in ("LinkProperties", "Link", "Pod", "PodQuery",
               "ObserveSLORequest", "SloTenant", "ObserveSLOResponse",
               "ObserveTraceRequest", "TraceEvent",
               "ObserveTraceResponse",
+              "ObservePausesRequest", "PauseCauseStat", "PauseEvent",
+              "ObservePausesResponse",
               "PlanUpdateRequest", "PlanRound", "PlanUpdateResponse",
               "ApplyPlanRequest", "ApplyPlanResponse",
               "TenantSpec", "TenantQuery", "TenantInfo",
@@ -669,6 +717,10 @@ ObserveSLOResponse = _MESSAGES["ObserveSLOResponse"]
 ObserveTraceRequest = _MESSAGES["ObserveTraceRequest"]
 TraceEvent = _MESSAGES["TraceEvent"]
 ObserveTraceResponse = _MESSAGES["ObserveTraceResponse"]
+ObservePausesRequest = _MESSAGES["ObservePausesRequest"]
+PauseCauseStat = _MESSAGES["PauseCauseStat"]
+PauseEvent = _MESSAGES["PauseEvent"]
+ObservePausesResponse = _MESSAGES["ObservePausesResponse"]
 PlanUpdateRequest = _MESSAGES["PlanUpdateRequest"]
 PlanRound = _MESSAGES["PlanRound"]
 PlanUpdateResponse = _MESSAGES["PlanUpdateResponse"]
@@ -723,6 +775,12 @@ LOCAL_METHODS = {
     # cli trace read these — not in the reference IDL)
     "ObserveLinks": (ObserveLinksRequest, ObserveLinksResponse, False),
     "ObserveTrace": (ObserveTraceRequest, ObserveTraceResponse, False),
+    # Framework extension: barrier-pause attribution — per-cause pause
+    # aggregates, tick-latency-by-cause histograms and recent events
+    # (kubedtn_tpu.pauses; `kdt pauses` reads this — not in the
+    # reference IDL)
+    "ObservePauses": (ObservePausesRequest, ObservePausesResponse,
+                      False),
     # Framework extension: the SLO observability plane — per-tenant
     # attainment / burn rates / estimated tails, and the fleet-merged
     # view (kubedtn_tpu.slo; `kdt slo` reads this — not in the

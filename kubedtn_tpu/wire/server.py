@@ -636,6 +636,71 @@ class Daemon:
                 p99_censored=bool(r.get("p99_censored", False)),
             ) for r in rows[:top]])
 
+    def ObservePauses(self, request, context):
+        """Framework extension: barrier-pause attribution from the
+        data plane's PauseLedger (kubedtn_tpu.pauses) — per-cause
+        pause aggregates, tick-latency-by-cause histograms and the
+        most recent attributed events (`kdt pauses` reads this)."""
+        plane = self.dataplane
+        ledger = getattr(plane, "pauses", None) if plane else None
+        if ledger is None:
+            return pb.ObservePausesResponse(
+                ok=False, error="no data plane (pause ledger) attached "
+                                "to this daemon")
+        try:
+            snap = ledger.snapshot()
+            want = request.cause
+            hist = snap["tick_hist"]
+            causes = []
+            total = 0.0
+            for c in sorted(snap["causes"]):
+                a = snap["causes"][c]
+                total += a["seconds"]
+                if want and c != want:
+                    continue
+                h = hist.get(c) or {}
+                causes.append(pb.PauseCauseStat(
+                    cause=c, count=a["count"], seconds=a["seconds"],
+                    max_s=a["max_s"], last_s=a["last_s"],
+                    last_t_s=a["last_t_s"], rows=a["rows"],
+                    bytes=a["bytes"],
+                    tick_buckets=[int(b) for b in
+                                  h.get("buckets") or ()],
+                    tick_count=int(h.get("count", 0)),
+                    tick_sum_s=float(h.get("sum_s", 0.0))))
+            # clean-tick histogram rides as the pseudo-cause "none"
+            # (count 0 on the aggregate side, by construction)
+            if not want and "none" in hist:
+                h = hist["none"]
+                causes.append(pb.PauseCauseStat(
+                    cause="none",
+                    tick_buckets=[int(b) for b in h["buckets"]],
+                    tick_count=int(h["count"]),
+                    tick_sum_s=float(h["sum_s"])))
+            n_ev = int(request.events)
+            events = []
+            if n_ev > 0:
+                for ev in ledger.events(n_ev):
+                    if want and ev.get("cause") != want:
+                        continue
+                    detail = " ".join(
+                        f"{k}={v}" for k, v in sorted(ev.items())
+                        if k not in ("cause", "dur_s", "t_s"))
+                    events.append(pb.PauseEvent(
+                        cause=ev.get("cause", ""),
+                        dur_s=float(ev.get("dur_s", 0.0)),
+                        t_s=float(ev.get("t_s", 0.0)),
+                        detail=detail))
+        except Exception as e:  # a query must never kill the daemon
+            return pb.ObservePausesResponse(
+                ok=False, error=f"{type(e).__name__}: {e}")
+        return pb.ObservePausesResponse(
+            ok=True, enabled=snap["enabled"],
+            uptime_s=snap["uptime_s"], total_pause_s=total,
+            causes=causes, events=events,
+            dropped_events=snap["dropped_events"],
+            tick_edges_s=[float(e) for e in snap["tick_edges_s"]])
+
     @staticmethod
     def _slo_tenant_msg(v: dict, plane: str = "") -> "pb.SloTenant":
         """One verdict dict (SloVerdict.to_dict / a fleet-merged row /
